@@ -1,0 +1,15 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform so the
+multi-chip sharding paths (mesh, psum, burn-in training step) compile and
+run without TPU hardware — the framework analog of the reference's
+fake-client multi-node testing strategy (SURVEY.md section 4)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

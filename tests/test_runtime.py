@@ -1,0 +1,330 @@
+"""Runtime core: fake client semantics, workqueue discipline, controller
+event flow. These mirror the guarantees the reference leans on from
+controller-runtime + client-go."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator.runtime import (
+    AlreadyExistsError,
+    ConflictError,
+    Controller,
+    FakeClient,
+    ListOptions,
+    Manager,
+    NotFoundError,
+    RateLimiter,
+    Reconciler,
+    Request,
+    Result,
+    WorkQueue,
+    enqueue_owner,
+    generation_changed,
+    label_changed,
+)
+from tpu_operator.runtime.objects import (
+    get_nested,
+    match_labels,
+    set_owner_reference,
+)
+
+
+def make_cm(name, ns="default", data=None, labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "data": data or {},
+    }
+
+
+class TestFakeClient:
+    def test_create_get_roundtrip(self):
+        c = FakeClient()
+        c.create(make_cm("a", data={"k": "v"}))
+        got = c.get("v1", "ConfigMap", "a", "default")
+        assert got["data"] == {"k": "v"}
+        assert got["metadata"]["uid"]
+        assert got["metadata"]["resourceVersion"]
+
+    def test_create_duplicate_rejected(self):
+        c = FakeClient()
+        c.create(make_cm("a"))
+        with pytest.raises(AlreadyExistsError):
+            c.create(make_cm("a"))
+
+    def test_get_missing_raises(self):
+        c = FakeClient()
+        with pytest.raises(NotFoundError):
+            c.get("v1", "ConfigMap", "nope", "default")
+
+    def test_update_conflict_on_stale_rv(self):
+        c = FakeClient()
+        c.create(make_cm("a"))
+        fresh = c.get("v1", "ConfigMap", "a", "default")
+        c.update(fresh)  # bumps RV
+        with pytest.raises(ConflictError):
+            c.update(fresh)  # stale RV now
+
+    def test_generation_bumps_only_on_spec_change(self):
+        c = FakeClient()
+        c.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
+                  "metadata": {"name": "d", "namespace": "default"},
+                  "spec": {"x": 1}})
+        ds = c.get("apps/v1", "DaemonSet", "d", "default")
+        assert ds["metadata"]["generation"] == 1
+        ds["status"] = {"numberReady": 0}
+        ds = c.update(ds)
+        assert ds["metadata"]["generation"] == 1
+        ds["spec"]["x"] = 2
+        ds = c.update(ds)
+        assert ds["metadata"]["generation"] == 2
+
+    def test_update_status_ignores_spec(self):
+        c = FakeClient()
+        c.create(make_cm("a", data={"k": "v"}))
+        obj = c.get("v1", "ConfigMap", "a", "default")
+        obj["data"] = {"k": "CHANGED"}
+        obj["status"] = {"ok": True}
+        c.update_status(obj)
+        got = c.get("v1", "ConfigMap", "a", "default")
+        assert got["data"] == {"k": "v"}
+        assert got["status"] == {"ok": True}
+
+    def test_list_label_selector(self):
+        c = FakeClient()
+        c.create(make_cm("a", labels={"app": "x"}))
+        c.create(make_cm("b", labels={"app": "y"}))
+        got = c.list("v1", "ConfigMap",
+                     ListOptions(label_selector={"app": "x"}))
+        assert [o["metadata"]["name"] for o in got] == ["a"]
+
+    def test_match_expressions(self):
+        labels = {"tpu.graft.dev/present": "true", "zone": "a"}
+        assert match_labels(labels, {"matchExpressions": [
+            {"key": "tpu.graft.dev/present", "operator": "Exists"}]})
+        assert not match_labels(labels, {"matchExpressions": [
+            {"key": "zone", "operator": "NotIn", "values": ["a"]}]})
+
+    def test_patch_merges_and_deletes(self):
+        c = FakeClient()
+        c.create(make_cm("a", labels={"keep": "1", "drop": "1"}))
+        c.patch("v1", "ConfigMap", "a",
+                {"metadata": {"labels": {"drop": None, "new": "2"}}}, "default")
+        got = c.get("v1", "ConfigMap", "a", "default")
+        assert got["metadata"]["labels"] == {"keep": "1", "new": "2"}
+
+    def test_owner_gc_cascades(self):
+        c = FakeClient()
+        owner = c.create(make_cm("owner"))
+        child = make_cm("child")
+        set_owner_reference(child, owner)
+        c.create(child)
+        c.delete("v1", "ConfigMap", "owner", "default")
+        assert c.get_or_none("v1", "ConfigMap", "child", "default") is None
+
+    def test_create_without_namespace_defaults_consistently(self):
+        # regression: the store key must use the defaulted namespace
+        c = FakeClient()
+        c.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "x"}, "data": {}})
+        got = c.get("v1", "ConfigMap", "x", "default")
+        assert got["metadata"]["namespace"] == "default"
+        assert [o["metadata"]["name"]
+                for o in c.list("v1", "ConfigMap", ListOptions(namespace="default"))] == ["x"]
+
+    def test_selector_param_rendering(self):
+        from tpu_operator.runtime.kubeclient import HTTPClient
+        sel = {"matchLabels": {"a": "1"},
+               "matchExpressions": [
+                   {"key": "p", "operator": "Exists"},
+                   {"key": "q", "operator": "NotIn", "values": ["x", "y"]},
+                   {"key": "r", "operator": "DoesNotExist"}]}
+        assert HTTPClient._selector_param(sel) == "a=1,p,q notin (x,y),!r"
+
+    def test_watch_replays_and_streams(self):
+        c = FakeClient()
+        c.create(make_cm("pre"))
+        events = []
+        cancel = c.watch("v1", "ConfigMap", lambda e: events.append((e.type, e.obj["metadata"]["name"])))
+        c.create(make_cm("post"))
+        cancel()
+        c.create(make_cm("after-cancel"))
+        assert ("ADDED", "pre") in events
+        assert ("ADDED", "post") in events
+        assert all(n != "after-cancel" for _, n in events)
+
+
+class TestKubeletSim:
+    def test_daemonset_scheduling_and_readiness(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={"tpu.graft.dev/present": "true"})
+        c.add_node("cpu-0", labels={})
+        c.create({
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "ds", "namespace": "default"},
+            "spec": {"template": {
+                "metadata": {"labels": {"app": "ds"}},
+                "spec": {"nodeSelector": {"tpu.graft.dev/present": "true"}},
+            }},
+        })
+        c.simulate_kubelet(ready=True)
+        ds = c.get("apps/v1", "DaemonSet", "ds", "default")
+        st = ds["status"]
+        assert st["desiredNumberScheduled"] == 1
+        assert st["numberAvailable"] == 1
+        pods = c.list("v1", "Pod", ListOptions(label_selector={"app": "ds"}))
+        assert len(pods) == 1
+        assert pods[0]["spec"]["nodeName"] == "tpu-0"
+
+    def test_stale_hash_leaves_updated_zero(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={"tpu.graft.dev/present": "true"})
+        c.create({
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "ds", "namespace": "default"},
+            "spec": {"template": {"metadata": {"labels": {"app": "ds"}},
+                                   "spec": {}}},
+        })
+        c.simulate_kubelet(ready=True, stale_hash=True)
+        ds = c.get("apps/v1", "DaemonSet", "ds", "default")
+        assert ds["status"]["updatedNumberScheduled"] == 0
+
+
+class TestWorkQueue:
+    def test_dedup(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        assert q.get(0.1) == "a"
+        q.done("a")
+        assert q.get(0.05) is None
+
+    def test_requeue_while_processing(self):
+        q = WorkQueue()
+        q.add("a")
+        item = q.get(0.1)
+        q.add("a")  # while processing -> dirty
+        assert q.get(0.01) is None  # not yet re-queued
+        q.done(item)
+        assert q.get(0.1) == "a"
+
+    def test_rate_limiter_backoff_caps(self):
+        rl = RateLimiter(base=0.1, max_delay=3.0)
+        delays = [rl.when("x") for _ in range(10)]
+        assert delays[0] == pytest.approx(0.1)
+        assert delays[1] == pytest.approx(0.2)
+        assert max(delays) == 3.0
+        rl.forget("x")
+        assert rl.when("x") == pytest.approx(0.1)
+
+    def test_add_after_delivers_later(self):
+        q = WorkQueue()
+        q.add_after("x", 0.05)
+        assert q.get(0.01) is None
+        assert q.get(0.5) == "x"
+
+
+class CountingReconciler(Reconciler):
+    name = "counting"
+
+    def __init__(self, client, watched=("v1", "ConfigMap")):
+        self.client = client
+        self.watched = watched
+        self.seen = []
+        self.lock = threading.Lock()
+
+    def reconcile(self, request: Request) -> Result:
+        with self.lock:
+            self.seen.append(request)
+        return Result()
+
+    def setup_controller(self, controller: Controller, manager: Manager):
+        controller.watch(*self.watched, predicate=generation_changed)
+
+
+class TestController:
+    def test_events_drive_reconcile(self):
+        c = FakeClient()
+        mgr = Manager(c)
+        rec = CountingReconciler(c)
+        mgr.add_reconciler(rec)
+        mgr.start()
+        try:
+            c.create(make_cm("a"))
+            assert mgr.wait_idle(5)
+            time.sleep(0.05)
+            assert Request(name="a", namespace="default") in rec.seen
+        finally:
+            mgr.stop()
+
+    def test_generation_changed_filters_status_updates(self):
+        c = FakeClient()
+        mgr = Manager(c)
+        rec = CountingReconciler(c)
+        mgr.add_reconciler(rec)
+        mgr.start()
+        try:
+            c.create(make_cm("a"))
+            mgr.wait_idle(5)
+            n = len(rec.seen)
+            obj = c.get("v1", "ConfigMap", "a", "default")
+            obj["status"] = {"tick": 1}
+            c.update_status(obj)  # no generation change
+            mgr.wait_idle(5)
+            time.sleep(0.05)
+            assert len(rec.seen) == n
+        finally:
+            mgr.stop()
+
+    def test_enqueue_owner_maps_to_parent(self):
+        c = FakeClient()
+        mgr = Manager(c)
+
+        class OwnerRec(Reconciler):
+            name = "owner-rec"
+
+            def __init__(self):
+                self.seen = []
+
+            def reconcile(self, request):
+                self.seen.append(request)
+                return Result()
+
+            def setup_controller(self, controller, manager):
+                controller.watch(
+                    "apps/v1", "DaemonSet",
+                    mapper=enqueue_owner("tpu.graft.dev/v1", "TPUClusterPolicy"))
+
+        rec = OwnerRec()
+        mgr.add_reconciler(rec)
+        mgr.start()
+        try:
+            ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+                  "metadata": {"name": "child", "namespace": "default",
+                               "ownerReferences": [{
+                                   "apiVersion": "tpu.graft.dev/v1",
+                                   "kind": "TPUClusterPolicy",
+                                   "name": "policy", "uid": "u1",
+                                   "controller": True}]},
+                  "spec": {}}
+            c.create(ds)
+            mgr.wait_idle(5)
+            time.sleep(0.05)
+            assert Request(name="policy") in rec.seen
+        finally:
+            mgr.stop()
+
+    def test_label_changed_predicate(self):
+        fired = []
+        pred = label_changed("tpu.graft.dev/present", "cloud.google.com/gke-tpu-*")
+        from tpu_operator.runtime import WatchEvent
+        old = {"metadata": {"labels": {"x": "1"}}}
+        new_irrelevant = WatchEvent("MODIFIED", {"metadata": {"labels": {"x": "2"}}})
+        assert not pred(new_irrelevant, old)
+        new_relevant = WatchEvent("MODIFIED", {"metadata": {"labels": {
+            "cloud.google.com/gke-tpu-topology": "2x2"}}})
+        assert pred(new_relevant, old)
+        assert fired == []

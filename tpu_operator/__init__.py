@@ -1,0 +1,33 @@
+"""tpu-operator: a TPU-native Kubernetes operator framework.
+
+A ground-up rebuild of the capabilities of the NVIDIA gpu-operator
+(reference: /root/reference, github.com/NVIDIA/gpu-operator v24.3.0) for
+Google TPU node pools, designed TPU-first:
+
+- The CUDA operand stack (driver kmod, container-toolkit, device plugin,
+  DCGM, MIG manager) is replaced by a TPU operand stack (libtpu installer,
+  TPU runtime hookup, TPU device plugin, libtpu metrics exporter,
+  topology/slice manager).
+- The validation plane proves each layer with real XLA programs: a bf16
+  matmul sized for the MXU (single chip) and a psum ring allreduce over the
+  ICI mesh (multi chip), instead of the CUDA ``vectorAdd`` sample.
+- One state engine only, modeled on the reference's *destination*
+  architecture (internal/state + internal/render, "engine B"), not the
+  legacy 4876-line object_controls.go path.
+
+Package map (SURVEY.md section 2 inventory -> here):
+
+- ``runtime/``      mini controller-runtime: clients, workqueue, manager
+- ``api/``          TPUClusterPolicy + TPUDriver CRD types, conditions
+- ``controllers/``  ClusterPolicy / TPUDriver / Upgrade reconcilers, clusterinfo
+- ``state/``        State interface, apply/readiness skeleton, node pools
+- ``render/``       template renderer over manifests/
+- ``validator/``    per-node validation plane + barrier protocol
+- ``deviceplugin/`` kubelet device plugin (google.com/tpu)
+- ``workloads/``    JAX/XLA validation workloads (matmul, collectives, burn-in)
+- ``parallel/``     device mesh + sharding helpers for the workloads
+- ``metrics/``      operator + node prometheus metrics
+- ``cli/``          tpu-operator / tpu-validator / tpuop-cfg entrypoints
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Shared Prometheus registry for the operator process.
+
+The reference registers 17 series on the controller-runtime registry
+(controllers/operator_metrics.go:29-201); our operator metrics live on one
+dedicated CollectorRegistry served at /metrics by the manager.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, generate_latest
+
+REGISTRY = CollectorRegistry()
+
+
+def render_prometheus() -> str:
+    return generate_latest(REGISTRY).decode("utf-8")

@@ -1,0 +1,25 @@
+from .client import (  # noqa: F401
+    AlreadyExistsError,
+    ApiError,
+    Client,
+    ConflictError,
+    InvalidError,
+    ListOptions,
+    NotFoundError,
+    WatchEvent,
+)
+from .fake import FakeClient  # noqa: F401
+from .manager import (  # noqa: F401
+    Controller,
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+    any_event,
+    enqueue_constant,
+    enqueue_object,
+    enqueue_owner,
+    generation_changed,
+    label_changed,
+)
+from .workqueue import RateLimiter, WorkQueue  # noqa: F401

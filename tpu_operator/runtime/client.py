@@ -1,0 +1,183 @@
+"""Client abstraction over the Kubernetes API.
+
+Two implementations exist:
+
+- ``tpu_operator.runtime.fake.FakeClient`` — an in-memory apiserver with
+  resourceVersions, label selectors, watches and a kubelet/DaemonSet
+  simulator. This is the test substrate (the analog of controller-runtime's
+  fake client used throughout controllers/object_controls_test.go in the
+  reference).
+- ``tpu_operator.runtime.kubeclient.HTTPClient`` — a real apiserver client
+  over HTTPS (kubeconfig or in-cluster service account).
+
+Objects are plain dicts shaped like Kubernetes JSON. All methods raise
+``ApiError`` subclasses on failure, mirroring apierrors.IsNotFound-style
+handling in the reference controllers.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Mapping, Optional
+
+
+class ApiError(Exception):
+    """Base error for API operations; carries an HTTP-ish status code."""
+
+    code = 500
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        if code is not None:
+            self.code = code
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+
+
+class ConflictError(ApiError):
+    """resourceVersion conflict on update."""
+
+    code = 409
+
+
+class InvalidError(ApiError):
+    code = 422
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: dict
+
+
+@dataclass
+class ListOptions:
+    namespace: Optional[str] = None
+    label_selector: Optional[Mapping] = None  # LabelSelector or matchLabels dict
+    field_selector: Optional[Mapping[str, str]] = None  # only metadata.name/.namespace
+
+
+class Client(abc.ABC):
+    """Minimal typed-by-convention CRUD + watch client."""
+
+    @abc.abstractmethod
+    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def list(self, api_version: str, kind: str, opts: Optional[ListOptions] = None) -> list:
+        ...
+
+    @abc.abstractmethod
+    def create(self, obj: dict) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def update(self, obj: dict) -> dict:
+        """Full replace; enforces resourceVersion if present on ``obj``."""
+        ...
+
+    @abc.abstractmethod
+    def update_status(self, obj: dict) -> dict:
+        """Status-subresource write (spec changes are ignored)."""
+        ...
+
+    @abc.abstractmethod
+    def patch(self, api_version: str, kind: str, name: str,
+              patch: dict, namespace: Optional[str] = None) -> dict:
+        """Strategic-merge-ish patch: dicts merge recursively, None deletes,
+        lists replace."""
+        ...
+
+    @abc.abstractmethod
+    def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def watch(self, api_version: str, kind: str,
+              handler: Callable[[WatchEvent], None]) -> Callable[[], None]:
+        """Register ``handler`` for events on a kind; returns an unsubscribe
+        callable. Handlers receive ADDED events for pre-existing objects."""
+        ...
+
+    # -- convenience -------------------------------------------------------
+
+    def get_or_none(self, api_version: str, kind: str, name: str,
+                    namespace: Optional[str] = None) -> Optional[dict]:
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def apply(self, obj: dict) -> dict:
+        """Create-or-replace (last-write-wins), used by bootstrap paths. The
+        state engine uses its own hash-gated create-or-update instead
+        (state/skel.py), mirroring state_skel.go:223-285."""
+        from .objects import name_of, namespace_of
+
+        existing = self.get_or_none(
+            obj.get("apiVersion", ""), obj.get("kind", ""), name_of(obj),
+            namespace_of(obj) or None)
+        if existing is None:
+            return self.create(obj)
+        merged = dict(obj)
+        meta = dict(merged.get("metadata") or {})
+        meta["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        meta.setdefault("uid", existing["metadata"].get("uid"))
+        merged["metadata"] = meta
+        return self.update(merged)
+
+
+def merge_patch(base: dict, patch: Mapping) -> dict:
+    """RFC7386-style merge used by Client.patch implementations."""
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, Mapping) and isinstance(out.get(k), Mapping):
+            out[k] = merge_patch(dict(out[k]), v)
+        else:
+            out[k] = v
+    return out
+
+
+@dataclass
+class WatchHub:
+    """Shared fan-out of watch events to subscribers, keyed by kind."""
+
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _subs: dict = field(default_factory=dict)  # (api_version, kind) -> list[handler]
+
+    def subscribe(self, api_version: str, kind: str,
+                  handler: Callable[[WatchEvent], None]) -> Callable[[], None]:
+        key = (api_version, kind)
+        with self._lock:
+            self._subs.setdefault(key, []).append(handler)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[key].remove(handler)
+                except (KeyError, ValueError):
+                    pass
+
+        return unsubscribe
+
+    def publish(self, event: WatchEvent) -> None:
+        key = (event.obj.get("apiVersion", ""), event.obj.get("kind", ""))
+        with self._lock:
+            handlers = list(self._subs.get(key, ()))
+        for h in handlers:
+            h(event)
+
+    def handlers_for(self, api_version: str, kind: str) -> Iterable[Callable]:
+        with self._lock:
+            return list(self._subs.get((api_version, kind), ()))

@@ -1,0 +1,303 @@
+"""In-memory fake apiserver + kubelet/DaemonSet simulator.
+
+This is the test substrate for the whole framework — the analog of the
+controller-runtime fake client the reference builds its "mock cluster" unit
+tier on (controllers/object_controls_test.go:147-231, SURVEY.md section 4):
+fabricated Node objects carry real GKE TPU labels, reconcilers run unmodified
+against this client, and DaemonSet "readiness" is driven structurally by
+``simulate_kubelet`` rather than by running pods.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Callable, Mapping, Optional
+
+from .client import (
+    AlreadyExistsError,
+    Client,
+    ConflictError,
+    ListOptions,
+    NotFoundError,
+    WatchEvent,
+    WatchHub,
+    merge_patch,
+)
+from .objects import (
+    deepcopy_obj,
+    get_nested,
+    is_namespaced,
+    labels_of,
+    match_labels,
+    match_node_selector_terms,
+    name_of,
+    namespace_of,
+    obj_key,
+    set_nested,
+)
+from ..utils.hash import object_hash
+
+
+class FakeClient(Client):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[tuple, dict] = {}
+        self._rv = 0
+        self.hub = WatchHub()
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _key(self, api_version: str, kind: str, name: str, namespace: Optional[str]) -> tuple:
+        ns = namespace or "" if is_namespaced(kind) else ""
+        return (api_version, kind, ns, name)
+
+    def _publish(self, type_: str, obj: dict) -> None:
+        self.hub.publish(WatchEvent(type_, deepcopy_obj(obj)))
+
+    # -- CRUD --------------------------------------------------------------
+
+    def get(self, api_version, kind, name, namespace=None):
+        with self._lock:
+            obj = self._store.get(self._key(api_version, kind, name, namespace))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            return deepcopy_obj(obj)
+
+    def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        opts = opts or ListOptions()
+        out = []
+        with self._lock:
+            for (av, k, ns, _), obj in self._store.items():
+                if av != api_version or k != kind:
+                    continue
+                if opts.namespace and ns != opts.namespace:
+                    continue
+                if opts.label_selector is not None and not match_labels(
+                        labels_of(obj), opts.label_selector):
+                    continue
+                if opts.field_selector:
+                    fs = opts.field_selector
+                    if "metadata.name" in fs and name_of(obj) != fs["metadata.name"]:
+                        continue
+                    if "metadata.namespace" in fs and ns != fs["metadata.namespace"]:
+                        continue
+                out.append(deepcopy_obj(obj))
+        out.sort(key=obj_key)
+        return out
+
+    def create(self, obj):
+        obj = deepcopy_obj(obj)
+        if not name_of(obj):
+            raise ValueError("object has no metadata.name")
+        meta = obj.setdefault("metadata", {})
+        if is_namespaced(obj.get("kind", "")):
+            meta.setdefault("namespace", "default")
+        key = self._key(obj.get("apiVersion", ""), obj.get("kind", ""),
+                        name_of(obj), namespace_of(obj) or None)
+        with self._lock:
+            if key in self._store:
+                raise AlreadyExistsError(f"{key[1]} {key[2]}/{key[3]} already exists")
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("generation", 1)
+            meta.setdefault("creationTimestamp", "1970-01-01T00:00:00Z")
+            self._store[key] = obj
+        self._publish("ADDED", obj)
+        return deepcopy_obj(obj)
+
+    def update(self, obj):
+        obj = deepcopy_obj(obj)
+        key = self._key(obj.get("apiVersion", ""), obj.get("kind", ""),
+                        name_of(obj), namespace_of(obj) or None)
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            claimed = get_nested(obj, "metadata", "resourceVersion")
+            actual = get_nested(cur, "metadata", "resourceVersion")
+            if claimed is not None and claimed != actual:
+                raise ConflictError(
+                    f"resourceVersion conflict on {key[1]} {key[3]}: "
+                    f"have {claimed}, want {actual}")
+            meta = obj.setdefault("metadata", {})
+            meta["uid"] = get_nested(cur, "metadata", "uid")
+            meta["creationTimestamp"] = get_nested(cur, "metadata", "creationTimestamp")
+            meta["resourceVersion"] = self._next_rv()
+            if obj.get("spec") != cur.get("spec"):
+                meta["generation"] = (get_nested(cur, "metadata", "generation", default=1) or 1) + 1
+            else:
+                meta["generation"] = get_nested(cur, "metadata", "generation", default=1)
+            self._store[key] = obj
+        self._publish("MODIFIED", obj)
+        return deepcopy_obj(obj)
+
+    def update_status(self, obj):
+        key = self._key(obj.get("apiVersion", ""), obj.get("kind", ""),
+                        name_of(obj), namespace_of(obj) or None)
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            cur = deepcopy_obj(cur)
+            cur["status"] = deepcopy_obj(obj.get("status") or {})
+            cur["metadata"]["resourceVersion"] = self._next_rv()
+            self._store[key] = cur
+        self._publish("MODIFIED", cur)
+        return deepcopy_obj(cur)
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        key = self._key(api_version, kind, name, namespace)
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is None:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            merged = merge_patch(deepcopy_obj(cur), patch)
+            merged["metadata"]["resourceVersion"] = self._next_rv()
+            if merged.get("spec") != cur.get("spec"):
+                merged["metadata"]["generation"] = (
+                    get_nested(cur, "metadata", "generation", default=1) or 1) + 1
+            self._store[key] = merged
+        self._publish("MODIFIED", merged)
+        return deepcopy_obj(merged)
+
+    def delete(self, api_version, kind, name, namespace=None):
+        key = self._key(api_version, kind, name, namespace)
+        with self._lock:
+            obj = self._store.pop(key, None)
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+        self._publish("DELETED", obj)
+        # ownerReference garbage collection (background-policy approximation)
+        uid = get_nested(obj, "metadata", "uid")
+        if uid:
+            with self._lock:
+                owned = [
+                    o for o in self._store.values()
+                    if any(r.get("uid") == uid for r in
+                           get_nested(o, "metadata", "ownerReferences", default=[]) or [])
+                ]
+            for o in owned:
+                try:
+                    self.delete(o.get("apiVersion", ""), o.get("kind", ""),
+                                name_of(o), namespace_of(o) or None)
+                except NotFoundError:
+                    pass
+
+    def watch(self, api_version, kind, handler):
+        # Hold the store lock across replay + subscribe so a concurrent
+        # create can't land between them and lose its ADDED event. (A
+        # duplicate ADDED is possible and harmless — the workqueue dedups.)
+        with self._lock:
+            existing = self.list(api_version, kind)
+            cancel = self.hub.subscribe(api_version, kind, handler)
+        for obj in existing:
+            handler(WatchEvent("ADDED", obj))
+        return cancel
+
+    # -- cluster simulation ------------------------------------------------
+
+    def add_node(self, name: str, labels: Optional[Mapping[str, str]] = None,
+                 allocatable: Optional[Mapping[str, str]] = None,
+                 runtime: str = "containerd://1.7.0") -> dict:
+        """Fabricate a Node (the fake analog of a GKE TPU VM joining)."""
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": name, "labels": dict(labels or {})},
+            "spec": {},
+            "status": {
+                "allocatable": dict(allocatable or {}),
+                "capacity": dict(allocatable or {}),
+                "nodeInfo": {"containerRuntimeVersion": runtime},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        return self.create(node)
+
+    def _ds_scheduled_nodes(self, ds: Mapping) -> list:
+        """Nodes a DaemonSet's pods land on, honoring nodeSelector + required
+        node affinity (the scheduling surface the operator actually uses)."""
+        tmpl_spec = get_nested(ds, "spec", "template", "spec", default={}) or {}
+        node_selector = tmpl_spec.get("nodeSelector") or {}
+        terms = get_nested(
+            tmpl_spec, "affinity", "nodeAffinity",
+            "requiredDuringSchedulingIgnoredDuringExecution", "nodeSelectorTerms",
+            default=[]) or []
+        out = []
+        for node in self.list("v1", "Node"):
+            nl = labels_of(node)
+            if not match_labels(nl, node_selector):
+                continue
+            if terms and not match_node_selector_terms(nl, terms):
+                continue
+            out.append(node)
+        return out
+
+    def simulate_kubelet(self, ready: bool = True, stale_hash: bool = False) -> None:
+        """Advance every DaemonSet's status as a scheduler+kubelet would.
+
+        ``ready=True`` marks all scheduled pods available; ``stale_hash=True``
+        leaves pods labeled with an outdated controller-revision-hash, which
+        the OnDelete readiness check must treat as not-ready (mirrors
+        object_controls.go:3526-3602 semantics).
+        """
+        for ds in self.list("apps/v1", "DaemonSet"):
+            nodes = self._ds_scheduled_nodes(ds)
+            desired = len(nodes)
+            revision = object_hash(get_nested(ds, "spec", "template", default={}))
+            pod_hash = "stale" if stale_hash else revision
+            ns = namespace_of(ds) or "default"
+            tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
+                                     default={}) or {}
+            for node in nodes:
+                pod_name = f"{name_of(ds)}-{name_of(node)}"
+                pod = {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": pod_name,
+                        "namespace": ns,
+                        "labels": {**tmpl_labels,
+                                   "controller-revision-hash": pod_hash},
+                        "ownerReferences": [{
+                            "apiVersion": "apps/v1", "kind": "DaemonSet",
+                            "name": name_of(ds),
+                            "uid": get_nested(ds, "metadata", "uid"),
+                            "controller": True,
+                        }],
+                    },
+                    "spec": {"nodeName": name_of(node)},
+                    "status": {"phase": "Running" if ready else "Pending",
+                               "conditions": [{"type": "Ready",
+                                               "status": "True" if ready else "False"}]},
+                }
+                existing = self.get_or_none("v1", "Pod", pod_name, ns)
+                if existing is None:
+                    self.create(pod)
+                else:
+                    existing.update({k: pod[k] for k in ("spec", "status")})
+                    set_nested(existing, pod["metadata"]["labels"], "metadata", "labels")
+                    self.update(existing)
+            status = {
+                "desiredNumberScheduled": desired,
+                "currentNumberScheduled": desired,
+                "numberMisscheduled": 0,
+                "numberReady": desired if ready else 0,
+                "numberAvailable": desired if ready else 0,
+                "updatedNumberScheduled": desired if not stale_hash else 0,
+                "observedGeneration": get_nested(ds, "metadata", "generation", default=1),
+            }
+            ds["status"] = status
+            self.update_status(ds)
+
+    def simulate_pod_phase(self, name: str, namespace: str, phase: str) -> None:
+        """Flip a standalone pod's phase (used to drive validator workload
+        pods to Succeeded, the analog of validator/main.go:1173 waitForPod)."""
+        pod = self.get("v1", "Pod", name, namespace)
+        set_nested(pod, phase, "status", "phase")
+        self.update_status(pod)

@@ -1,0 +1,326 @@
+"""Real Kubernetes apiserver client over HTTPS.
+
+The framework's equivalent of client-go as used by the reference manager
+(cmd/gpu-operator/main.go:123 GetConfigOrDie): in-cluster service-account
+config when running as a pod, kubeconfig otherwise. Built on ``requests``
+so it carries no generated clientset — CRs and built-ins use the same
+dynamic path mapping (the framework treats everything as unstructured,
+like the reference's engine B).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+import threading
+from typing import Callable, Optional
+
+import requests
+import yaml
+
+from .client import (
+    AlreadyExistsError,
+    ApiError,
+    Client,
+    ConflictError,
+    InvalidError,
+    ListOptions,
+    NotFoundError,
+    WatchEvent,
+)
+from .objects import is_namespaced
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Irregular plurals; everything else is lowercase(kind) + "s" / "es".
+_PLURALS = {
+    "Endpoints": "endpoints",
+    "NetworkPolicy": "networkpolicies",
+    "PodSecurityPolicy": "podsecuritypolicies",
+    "Ingress": "ingresses",
+    "RuntimeClass": "runtimeclasses",
+    "PriorityClass": "priorityclasses",
+    "CustomResourceDefinition": "customresourcedefinitions",
+    "TPUClusterPolicy": "tpuclusterpolicies",
+}
+
+
+def plural_of(kind: str) -> str:
+    if kind in _PLURALS:
+        return _PLURALS[kind]
+    lower = kind.lower()
+    if lower.endswith(("s", "x", "z", "ch", "sh")):
+        return lower + "es"
+    if lower.endswith("y") and lower[-2] not in "aeiou":
+        return lower[:-1] + "ies"
+    return lower + "s"
+
+
+class KubeConfig:
+    def __init__(self, server: str, token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 client_cert: Optional[tuple] = None,
+                 namespace: str = "default"):
+        self.server = server.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.client_cert = client_cert
+        self.namespace = namespace
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        ns_file = os.path.join(SA_DIR, "namespace")
+        ns = "default"
+        if os.path.exists(ns_file):
+            with open(ns_file) as f:
+                ns = f.read().strip()
+        return cls(server=f"https://{host}:{port}", token=token,
+                   ca_file=os.path.join(SA_DIR, "ca.crt"), namespace=ns)
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None,
+                        context: Optional[str] = None) -> "KubeConfig":
+        path = path or os.environ.get("KUBECONFIG",
+                                      os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context")
+        ctx = next(c["context"] for c in cfg["contexts"] if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in cfg["clusters"]
+                       if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+
+        def materialize(data_key: str, file_key: str) -> Optional[str]:
+            if file_key in cluster or file_key in user:
+                return cluster.get(file_key) or user.get(file_key)
+            blob = cluster.get(data_key) or user.get(data_key)
+            if not blob:
+                return None
+            f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            f.write(base64.b64decode(blob))
+            f.close()
+            return f.name
+
+        ca = (cluster.get("certificate-authority")
+              or materialize("certificate-authority-data", "certificate-authority"))
+        cert = (user.get("client-certificate")
+                or materialize("client-certificate-data", "client-certificate"))
+        key = (user.get("client-key")
+               or materialize("client-key-data", "client-key"))
+        return cls(server=cluster["server"], token=user.get("token"),
+                   ca_file=ca,
+                   client_cert=(cert, key) if cert and key else None,
+                   namespace=ctx.get("namespace", "default"))
+
+    @classmethod
+    def load(cls) -> "KubeConfig":
+        if "KUBERNETES_SERVICE_HOST" in os.environ and os.path.exists(SA_DIR):
+            return cls.in_cluster()
+        return cls.from_kubeconfig()
+
+
+class HTTPClient(Client):
+    def __init__(self, config: Optional[KubeConfig] = None):
+        self.config = config or KubeConfig.load()
+        self.session = requests.Session()
+        if self.config.token:
+            self.session.headers["Authorization"] = f"Bearer {self.config.token}"
+        if self.config.ca_file:
+            self.session.verify = self.config.ca_file
+        if self.config.client_cert:
+            self.session.cert = self.config.client_cert
+        self._stop = threading.Event()
+
+    # -- path construction -------------------------------------------------
+
+    def _base(self, api_version: str) -> str:
+        if "/" in api_version:
+            return f"{self.config.server}/apis/{api_version}"
+        return f"{self.config.server}/api/{api_version}"
+
+    def _url(self, api_version: str, kind: str, name: Optional[str],
+             namespace: Optional[str], subresource: str = "") -> str:
+        parts = [self._base(api_version)]
+        if is_namespaced(kind):
+            parts.append(f"namespaces/{namespace or self.config.namespace}")
+        parts.append(plural_of(kind))
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    @staticmethod
+    def _raise_for(resp: requests.Response, what: str):
+        if resp.status_code < 400:
+            return
+        msg = f"{what}: {resp.status_code} {resp.text[:500]}"
+        if resp.status_code == 404:
+            raise NotFoundError(msg)
+        if resp.status_code == 409:
+            body = {}
+            try:
+                body = resp.json()
+            except Exception:
+                pass
+            if body.get("reason") == "AlreadyExists":
+                raise AlreadyExistsError(msg)
+            raise ConflictError(msg)
+        if resp.status_code == 422:
+            raise InvalidError(msg)
+        raise ApiError(msg, code=resp.status_code)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def get(self, api_version, kind, name, namespace=None):
+        resp = self.session.get(self._url(api_version, kind, name, namespace))
+        self._raise_for(resp, f"get {kind}/{name}")
+        return resp.json()
+
+    @staticmethod
+    def _selector_param(selector) -> str:
+        """Render a LabelSelector (matchLabels + matchExpressions) or plain
+        matchLabels dict into the apiserver's set-based selector syntax."""
+        if "matchLabels" in selector or "matchExpressions" in selector:
+            match = selector.get("matchLabels") or {}
+            exprs = selector.get("matchExpressions") or []
+        else:
+            match, exprs = selector, []
+        parts = [f"{k}={v}" for k, v in match.items()]
+        for e in exprs:
+            key, op = e.get("key"), e.get("operator")
+            values = ",".join(e.get("values") or [])
+            if op == "In":
+                parts.append(f"{key} in ({values})")
+            elif op == "NotIn":
+                parts.append(f"{key} notin ({values})")
+            elif op == "Exists":
+                parts.append(key)
+            elif op == "DoesNotExist":
+                parts.append(f"!{key}")
+            else:
+                raise ValueError(f"unknown matchExpressions operator: {op!r}")
+        return ",".join(parts)
+
+    def _list_raw(self, api_version, kind, opts: Optional[ListOptions] = None):
+        """List returning (items, collection resourceVersion)."""
+        opts = opts or ListOptions()
+        params = {}
+        if opts.label_selector:
+            params["labelSelector"] = self._selector_param(opts.label_selector)
+        if opts.field_selector:
+            params["fieldSelector"] = ",".join(
+                f"{k}={v}" for k, v in opts.field_selector.items())
+        url = self._url(api_version, kind, None, opts.namespace)
+        if not opts.namespace and is_namespaced(kind):
+            # all-namespaces list
+            url = f"{self._base(api_version)}/{plural_of(kind)}"
+        resp = self.session.get(url, params=params)
+        self._raise_for(resp, f"list {kind}")
+        body = resp.json()
+        items = body.get("items", [])
+        for item in items:  # k8s omits these on list items
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items, (body.get("metadata") or {}).get("resourceVersion")
+
+    def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        return self._list_raw(api_version, kind, opts)[0]
+
+    def create(self, obj):
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        ns = obj.get("metadata", {}).get("namespace")
+        resp = self.session.post(self._url(av, kind, None, ns), json=obj)
+        self._raise_for(resp, f"create {kind}")
+        return resp.json()
+
+    def update(self, obj):
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        meta = obj.get("metadata", {})
+        resp = self.session.put(
+            self._url(av, kind, meta.get("name"), meta.get("namespace")), json=obj)
+        self._raise_for(resp, f"update {kind}/{meta.get('name')}")
+        return resp.json()
+
+    def update_status(self, obj):
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        meta = obj.get("metadata", {})
+        resp = self.session.put(
+            self._url(av, kind, meta.get("name"), meta.get("namespace"), "status"),
+            json=obj)
+        self._raise_for(resp, f"update status {kind}/{meta.get('name')}")
+        return resp.json()
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        resp = self.session.patch(
+            self._url(api_version, kind, name, namespace),
+            data=json.dumps(patch),
+            headers={"Content-Type": "application/merge-patch+json"})
+        self._raise_for(resp, f"patch {kind}/{name}")
+        return resp.json()
+
+    def delete(self, api_version, kind, name, namespace=None):
+        resp = self.session.delete(self._url(api_version, kind, name, namespace))
+        self._raise_for(resp, f"delete {kind}/{name}")
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, api_version, kind, handler: Callable[[WatchEvent], None]):
+        """List+watch in a daemon thread with automatic re-list on stream
+        drop (informer-lite). Returns an unsubscribe callable."""
+        stop = threading.Event()
+
+        import logging
+
+        log = logging.getLogger("tpu_operator.kubeclient")
+
+        def run():
+            while not stop.is_set() and not self._stop.is_set():
+                try:
+                    items, rv = self._list_raw(api_version, kind)
+                    for obj in items:
+                        handler(WatchEvent("ADDED", obj))
+                    url = self._url(api_version, kind, None, None)
+                    if is_namespaced(kind):
+                        url = f"{self._base(api_version)}/{plural_of(kind)}"
+                    params = {"watch": "true",
+                              "allowWatchBookmarks": "true"}
+                    if rv:
+                        params["resourceVersion"] = rv
+                    with self.session.get(url, params=params, stream=True,
+                                          timeout=(10, 300)) as resp:
+                        self._raise_for(resp, f"watch {kind}")
+                        for line in resp.iter_lines():
+                            if stop.is_set():
+                                return
+                            if not line:
+                                continue
+                            evt = json.loads(line)
+                            etype = evt.get("type", "MODIFIED")
+                            if etype == "BOOKMARK":
+                                continue
+                            if etype == "ERROR":
+                                # e.g. 410 Gone: resourceVersion too old —
+                                # break out and re-list from scratch
+                                log.warning("watch %s error event: %s",
+                                            kind, evt.get("object"))
+                                break
+                            obj = evt.get("object", {})
+                            obj.setdefault("apiVersion", api_version)
+                            obj.setdefault("kind", kind)
+                            handler(WatchEvent(etype, obj))
+                except Exception as e:
+                    log.warning("watch %s failed (%s: %s); re-listing in 2s",
+                                kind, type(e).__name__, e)
+                    if stop.wait(2.0):
+                        return
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"watch-{kind}").start()
+        return stop.set

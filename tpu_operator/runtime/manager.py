@@ -1,0 +1,269 @@
+"""Controller manager: the mini controller-runtime.
+
+Plays the role of ctrl.NewManager + builder wiring in the reference's
+entrypoint (cmd/gpu-operator/main.go:72-220): reconcilers register watches
+with predicates, events map to requests on a rate-limited workqueue, worker
+threads drive Reconcile, and the manager serves /healthz and /metrics.
+
+Deliberate simplifications, matching how the reference actually runs:
+MaxConcurrentReconciles is 1 per controller (clusterpolicy_controller.go:357
+sets the same), and caches are read-through (every Get/List hits the client,
+which for the fake client is in-memory anyway).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Iterable, Optional
+
+from .client import Client, WatchEvent
+from .objects import get_nested, name_of, namespace_of
+from .workqueue import RateLimiter, WorkQueue
+
+log = logging.getLogger("tpu_operator.manager")
+
+
+@dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+    def __str__(self):
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler:
+    """Implement ``reconcile(request) -> Result`` plus
+    ``setup_controller(controller, manager)`` to register watches — the
+    analog of SetupWithManager in the reference controllers."""
+
+    name = "reconciler"
+
+    def reconcile(self, request: Request) -> Result:  # pragma: no cover
+        raise NotImplementedError
+
+    def setup_controller(self, controller: "Controller",
+                         manager: "Manager") -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+# -- predicates (controller-runtime predicate.Funcs analog) -----------------
+
+
+def generation_changed(event: WatchEvent, old: Optional[dict]) -> bool:
+    """True when spec generation changed (GenerationChangedPredicate,
+    used on the primary CR watch, clusterpolicy_controller.go:366)."""
+    if event.type in ("ADDED", "DELETED"):
+        return True
+    if old is None:
+        return True
+    return (get_nested(event.obj, "metadata", "generation")
+            != get_nested(old, "metadata", "generation"))
+
+
+def any_event(event: WatchEvent, old: Optional[dict]) -> bool:
+    return True
+
+
+def label_changed(*keys_or_prefixes: str):
+    """Predicate firing when any of the given label keys (or ``prefix*``
+    wildcards) change — the analog of the GPU-node label predicates in
+    addWatchNewGPUNode (clusterpolicy_controller.go:256-341)."""
+
+    def relevant(labels: dict) -> dict:
+        out = {}
+        for k, v in (labels or {}).items():
+            for pat in keys_or_prefixes:
+                if (pat.endswith("*") and k.startswith(pat[:-1])) or k == pat:
+                    out[k] = v
+        return out
+
+    def pred(event: WatchEvent, old: Optional[dict]) -> bool:
+        if event.type in ("ADDED", "DELETED"):
+            return True
+        new_labels = get_nested(event.obj, "metadata", "labels", default={}) or {}
+        old_labels = get_nested(old or {}, "metadata", "labels", default={}) or {}
+        return relevant(new_labels) != relevant(old_labels)
+
+    return pred
+
+
+def enqueue_object(event: WatchEvent) -> Iterable[Request]:
+    yield Request(name=name_of(event.obj), namespace=namespace_of(event.obj))
+
+
+def enqueue_owner(api_version: str, kind: str):
+    """Map an owned object's event to its controller owner's request
+    (handler.EnqueueRequestForOwner analog, clusterpolicy_controller.go:385).
+    Owner references are same-namespace, so namespaced owner kinds inherit
+    the event object's namespace; cluster-scoped owners get none."""
+    from .objects import is_namespaced
+
+    def mapper(event: WatchEvent) -> Iterable[Request]:
+        ns = namespace_of(event.obj) if is_namespaced(kind) else ""
+        for ref in get_nested(event.obj, "metadata", "ownerReferences",
+                              default=[]) or []:
+            if ref.get("apiVersion") == api_version and ref.get("kind") == kind:
+                yield Request(name=ref.get("name", ""), namespace=ns)
+
+    return mapper
+
+
+def enqueue_constant(name: str, namespace: str = ""):
+    def mapper(event: WatchEvent) -> Iterable[Request]:
+        yield Request(name=name, namespace=namespace)
+
+    return mapper
+
+
+class Controller:
+    """One reconciler + its watches + its queue + its worker thread."""
+
+    def __init__(self, name: str, reconciler: Reconciler, client: Client,
+                 rate_limiter: Optional[RateLimiter] = None):
+        self.name = name
+        self.reconciler = reconciler
+        self.client = client
+        self.queue = WorkQueue(rate_limiter or RateLimiter(0.1, 3.0))
+        self._watch_cancels: list[Callable[[], None]] = []
+        self._last_seen: dict[tuple, dict] = {}
+        self._threads: list[threading.Thread] = []
+        self._stopped = threading.Event()
+        self.reconcile_errors = 0
+        self.reconcile_total = 0
+
+    def watch(self, api_version: str, kind: str,
+              predicate: Callable[[WatchEvent, Optional[dict]], bool] = any_event,
+              mapper: Callable[[WatchEvent], Iterable[Request]] = enqueue_object) -> None:
+        def handler(event: WatchEvent):
+            key = (api_version, kind, namespace_of(event.obj), name_of(event.obj))
+            old = self._last_seen.get(key)
+            if event.type == "DELETED":
+                self._last_seen.pop(key, None)
+            else:
+                self._last_seen[key] = event.obj
+            try:
+                if not predicate(event, old):
+                    return
+                for req in mapper(event):
+                    self.queue.add(req)
+            except Exception:  # watch handlers must never kill the stream
+                log.exception("[%s] watch handler failed for %s/%s",
+                              self.name, kind, name_of(event.obj))
+
+        self._watch_cancels.append(self.client.watch(api_version, kind, handler))
+
+    def _worker(self):
+        while not self._stopped.is_set():
+            req = self.queue.get(timeout=0.5)
+            if req is None:
+                continue
+            try:
+                self.reconcile_total += 1
+                result = self.reconciler.reconcile(req)
+                if result and result.requeue_after > 0:
+                    self.queue.forget(req)
+                    self.queue.add_after(req, result.requeue_after)
+                elif result and result.requeue:
+                    # keep the failure count: repeated requeue=True must back
+                    # off toward the 3s cap, like controller-runtime
+                    self.queue.add_rate_limited(req)
+                else:
+                    self.queue.forget(req)
+            except Exception:
+                self.reconcile_errors += 1
+                log.exception("[%s] reconcile %s failed", self.name, req)
+                self.queue.add_rate_limited(req)
+            finally:
+                self.queue.done(req)
+
+    def start(self):
+        t = threading.Thread(target=self._worker, name=f"ctrl-{self.name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stopped.set()
+        self.queue.shutdown()
+        for cancel in self._watch_cancels:
+            cancel()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: wait until the queue fully drains (incl. delayed)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.queue._cond:
+                busy = (self.queue._queue or self.queue._processing
+                        or self.queue._delayed)
+            if not busy:
+                return True
+            time.sleep(0.01)
+        return False
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    manager: "Manager" = None  # type: ignore
+
+    def do_GET(self):
+        if self.path in ("/healthz", "/readyz"):
+            body, code = b"ok", 200
+        elif self.path == "/metrics":
+            from ..metrics.registry import render_prometheus
+            body, code = render_prometheus().encode(), 200
+        else:
+            body, code = b"not found", 404
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class Manager:
+    """Holds the client, the controllers, and the serving endpoints."""
+
+    def __init__(self, client: Client, namespace: str = "tpu-operator",
+                 health_port: Optional[int] = None):
+        self.client = client
+        self.namespace = namespace
+        self.controllers: list[Controller] = []
+        self.health_port = health_port
+        self._http: Optional[ThreadingHTTPServer] = None
+
+    def add_reconciler(self, reconciler: Reconciler,
+                       rate_limiter: Optional[RateLimiter] = None) -> Controller:
+        ctrl = Controller(reconciler.name, reconciler, self.client, rate_limiter)
+        self.controllers.append(ctrl)
+        reconciler.setup_controller(ctrl, self)  # type: ignore[attr-defined]
+        return ctrl
+
+    def start(self):
+        if self.health_port is not None:
+            handler = type("H", (_HealthHandler,), {"manager": self})
+            self._http = ThreadingHTTPServer(("0.0.0.0", self.health_port), handler)
+            threading.Thread(target=self._http.serve_forever, daemon=True).start()
+        for ctrl in self.controllers:
+            ctrl.start()
+
+    def stop(self):
+        for ctrl in self.controllers:
+            ctrl.stop()
+        if self._http:
+            self._http.shutdown()
+            self._http.server_close()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        return all(c.wait_idle(timeout) for c in self.controllers)

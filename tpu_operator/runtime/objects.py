@@ -1,0 +1,214 @@
+"""Unstructured Kubernetes object helpers.
+
+The state engine manipulates operand manifests as plain nested dicts (the
+analog of ``unstructured.Unstructured`` used by the reference's engine B,
+internal/state/state_skel.go). This module provides the small vocabulary the
+rest of the framework needs: nested access, metadata accessors, GVK keys,
+label-selector matching, and owner references.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping
+
+
+def deepcopy_obj(obj: dict) -> dict:
+    return copy.deepcopy(obj)
+
+
+def get_nested(obj: Mapping, *path: str, default: Any = None) -> Any:
+    """Walk ``path`` through nested mappings, returning ``default`` on miss."""
+    cur: Any = obj
+    for key in path:
+        if not isinstance(cur, Mapping) or key not in cur:
+            return default
+        cur = cur[key]
+    return cur
+
+
+def set_nested(obj: dict, value: Any, *path: str) -> None:
+    """Set a nested value, creating intermediate dicts."""
+    cur = obj
+    for key in path[:-1]:
+        nxt = cur.get(key)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[key] = nxt
+        cur = nxt
+    cur[path[-1]] = value
+
+
+def pop_nested(obj: dict, *path: str) -> Any:
+    cur: Any = obj
+    for key in path[:-1]:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    if isinstance(cur, dict):
+        return cur.pop(path[-1], None)
+    return None
+
+
+@dataclass(frozen=True)
+class GVK:
+    """group/version + kind; the type key of every stored object."""
+
+    api_version: str
+    kind: str
+
+    @staticmethod
+    def of(obj: Mapping) -> "GVK":
+        return GVK(obj.get("apiVersion", ""), obj.get("kind", ""))
+
+    @property
+    def group(self) -> str:
+        return self.api_version.split("/")[0] if "/" in self.api_version else ""
+
+    @property
+    def version(self) -> str:
+        return self.api_version.split("/")[-1]
+
+    def __str__(self) -> str:  # e.g. "apps/v1/DaemonSet"
+        return f"{self.api_version}/{self.kind}"
+
+
+# Kinds that are cluster-scoped (no namespace) in the fake/real clients.
+CLUSTER_SCOPED_KINDS = {
+    "Node",
+    "Namespace",
+    "ClusterRole",
+    "ClusterRoleBinding",
+    "CustomResourceDefinition",
+    "RuntimeClass",
+    "PriorityClass",
+    "TPUClusterPolicy",
+    "TPUDriver",
+}
+
+
+def is_namespaced(kind: str) -> bool:
+    return kind not in CLUSTER_SCOPED_KINDS
+
+
+def name_of(obj: Mapping) -> str:
+    return get_nested(obj, "metadata", "name", default="")
+
+
+def namespace_of(obj: Mapping) -> str:
+    return get_nested(obj, "metadata", "namespace", default="")
+
+
+def labels_of(obj: Mapping) -> dict:
+    return get_nested(obj, "metadata", "labels", default={}) or {}
+
+
+def annotations_of(obj: Mapping) -> dict:
+    return get_nested(obj, "metadata", "annotations", default={}) or {}
+
+
+def set_label(obj: dict, key: str, value: str) -> None:
+    set_nested(obj, value, "metadata", "labels", key)
+
+
+def set_annotation(obj: dict, key: str, value: str) -> None:
+    set_nested(obj, value, "metadata", "annotations", key)
+
+
+def obj_key(obj: Mapping) -> tuple:
+    """(apiVersion, kind, namespace, name) — unique identity in a cluster."""
+    return (
+        obj.get("apiVersion", ""),
+        obj.get("kind", ""),
+        namespace_of(obj),
+        name_of(obj),
+    )
+
+
+def set_owner_reference(obj: dict, owner: Mapping, controller: bool = True) -> None:
+    """Stamp ``obj`` with a controller owner reference to ``owner``.
+
+    Plays the role of controllerutil.SetControllerReference in the reference
+    (controllers/object_controls.go:4242).
+    """
+    ref = {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": name_of(owner),
+        "uid": get_nested(owner, "metadata", "uid", default=""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+    refs = [
+        r
+        for r in get_nested(obj, "metadata", "ownerReferences", default=[]) or []
+        if not (r.get("controller") and controller)
+    ]
+    refs.append(ref)
+    set_nested(obj, refs, "metadata", "ownerReferences")
+
+
+def owner_uids(obj: Mapping) -> set:
+    return {
+        r.get("uid")
+        for r in get_nested(obj, "metadata", "ownerReferences", default=[]) or []
+        if r.get("uid")
+    }
+
+
+def is_owned_by(obj: Mapping, owner: Mapping) -> bool:
+    return get_nested(owner, "metadata", "uid", default=None) in owner_uids(obj)
+
+
+# ---------------------------------------------------------------------------
+# Label selectors (matchLabels + matchExpressions), used by the fake client's
+# LIST, by DaemonSet node scheduling simulation, and by node-pool filters.
+# ---------------------------------------------------------------------------
+
+
+def match_labels(labels: Mapping[str, str], selector: Mapping | None) -> bool:
+    """Evaluate a LabelSelector ({matchLabels, matchExpressions}) or a plain
+    matchLabels-style dict against ``labels``."""
+    if not selector:
+        return True
+    if "matchLabels" in selector or "matchExpressions" in selector:
+        wanted = selector.get("matchLabels") or {}
+        exprs = selector.get("matchExpressions") or []
+    else:
+        wanted = selector
+        exprs = []
+    for k, v in wanted.items():
+        if labels.get(k) != v:
+            return False
+    for expr in exprs:
+        key, op = expr.get("key"), expr.get("operator")
+        values = expr.get("values") or []
+        present = key in labels
+        if op == "In":
+            if not present or labels[key] not in values:
+                return False
+        elif op == "NotIn":
+            if present and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if not present:
+                return False
+        elif op == "DoesNotExist":
+            if present:
+                return False
+        else:
+            raise ValueError(f"unknown matchExpressions operator: {op!r}")
+    return True
+
+
+def match_node_selector_terms(labels: Mapping[str, str], terms: Iterable[Mapping]) -> bool:
+    """nodeAffinity requiredDuringScheduling terms: OR of ANDed expressions."""
+    terms = list(terms)
+    if not terms:
+        return True
+    for term in terms:
+        exprs = term.get("matchExpressions") or []
+        if match_labels(labels, {"matchExpressions": exprs}):
+            return True
+    return False

@@ -1,0 +1,145 @@
+"""Rate-limited reconcile workqueue.
+
+Mirrors the queue discipline the reference configures on its controllers
+(controllers/clusterpolicy_controller.go:51-52,357): per-item exponential
+backoff from 100 ms to 3 s, de-duplication of queued keys, and delayed
+re-adds for requeue-after results.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Optional
+
+
+class RateLimiter:
+    """Per-item exponential backoff: base * 2**failures, capped at max."""
+
+    def __init__(self, base: float = 0.1, max_delay: float = 3.0):
+        self.base = base
+        self.max_delay = max_delay
+        self._failures: dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base * (2 ** n), self.max_delay)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def retries(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue:
+    """Thread-safe delaying queue with dedup of pending items.
+
+    Semantics match client-go's workqueue closely enough for our manager:
+    an item queued while being processed is re-queued when done; duplicate
+    adds collapse.
+    """
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+        self.rate_limiter = rate_limiter or RateLimiter()
+        self._cond = threading.Condition()
+        self._queue: list[Any] = []
+        self._pending: set = set()
+        self._processing: set = set()
+        self._dirty: set = set()
+        self._delayed: list[tuple[float, int, Any]] = []
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._pending:
+                return
+            self._pending.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self.rate_limiter.forget(item)
+
+    def _promote_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the queue; return wait until next."""
+        now = time.monotonic()
+        wait = None
+        while self._delayed:
+            due, _, item = self._delayed[0]
+            if due <= now:
+                heapq.heappop(self._delayed)
+                if item not in self._pending and item not in self._processing:
+                    self._pending.add(item)
+                    self._queue.append(item)
+                elif item in self._processing:
+                    self._dirty.add(item)
+            else:
+                wait = due - now
+                break
+        return wait
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Block for the next item; None on shutdown or timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                wait = self._promote_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._pending.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._pending:
+                    self._pending.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue) + len(self._delayed)
