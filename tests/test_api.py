@@ -1,0 +1,163 @@
+"""API layer: conversion machinery, spec defaults, conditions, CRD gen,
+image resolution."""
+
+import os
+
+import pytest
+import yaml
+
+from tpu_operator.api import (
+    TPUClusterPolicySpec,
+    TPUDriverSpec,
+    new_cluster_policy,
+)
+from tpu_operator.api.conditions import (
+    COND_ERROR,
+    COND_READY,
+    get_condition,
+    set_condition,
+    set_error,
+    set_ready,
+)
+from tpu_operator.api.convert import from_dict, schema_of, to_dict
+from tpu_operator.api.crd import all_crds, cluster_policy_crd, tpu_driver_crd
+from tpu_operator.api.image import env_var_for, image_path
+from tpu_operator.api.labels import accelerator_generation, deploy_label
+from tpu_operator.runtime import FakeClient
+
+
+class TestConvert:
+    def test_roundtrip_spec(self):
+        raw = {
+            "libtpu": {"enabled": True, "repository": "gcr.io/x",
+                       "image": "libtpu-installer", "version": "1.2.3",
+                       "installDir": "/opt/libtpu"},
+            "devicePlugin": {"enabled": False},
+            "validator": {"matmulSize": 2048,
+                          "iciBandwidthThreshold": 0.9},
+        }
+        spec = TPUClusterPolicySpec.from_obj({"spec": raw})
+        assert spec.libtpu.install_dir == "/opt/libtpu"
+        assert spec.libtpu.is_enabled()
+        assert not spec.device_plugin.is_enabled()
+        assert spec.validator.matmul_size == 2048
+        assert spec.validator.ici_bandwidth_threshold == 0.9
+        wire = to_dict(spec)
+        assert wire["libtpu"]["installDir"] == "/opt/libtpu"
+        assert wire["validator"]["iciBandwidthThreshold"] == 0.9
+
+    def test_unknown_fields_ignored(self):
+        spec = TPUClusterPolicySpec.from_obj(
+            {"spec": {"libtpu": {"futureKnob": 1}}})
+        assert spec.libtpu is not None
+
+    def test_defaults_fill_missing_sections(self):
+        spec = TPUClusterPolicySpec.from_obj({"spec": {}})
+        assert spec.device_plugin.resource_name == "google.com/tpu"
+        assert spec.host_paths.validation_dir == "/run/tpu/validations"
+        assert spec.daemonsets.priority_class_name == "system-node-critical"
+        # explicit null sections normalize too
+        spec2 = TPUClusterPolicySpec.from_obj({"spec": {"libtpu": None}})
+        assert spec2.libtpu.channel == "stable"
+
+    def test_component_enabled_default(self):
+        spec = TPUClusterPolicySpec.from_obj({"spec": {}})
+        assert spec.libtpu.is_enabled()
+        assert not spec.metrics_exporter.is_enabled(default=False)
+
+
+class TestConditions:
+    def test_set_ready_and_flip(self):
+        c = FakeClient()
+        cr = c.create(new_cluster_policy())
+        set_ready(c, cr, "all operands ready")
+        got = c.get(cr["apiVersion"], cr["kind"], "tpu-cluster-policy")
+        ready = get_condition(got, COND_READY)
+        assert ready["status"] == "True"
+        t0 = ready["lastTransitionTime"]
+        set_error(c, got, "Boom", "bad")
+        got = c.get(cr["apiVersion"], cr["kind"], "tpu-cluster-policy")
+        assert get_condition(got, COND_READY)["status"] == "False"
+        assert get_condition(got, COND_ERROR)["status"] == "True"
+
+    def test_set_condition_reports_change(self):
+        cr = {"metadata": {"generation": 1}}
+        assert set_condition(cr, COND_READY, "True", "R")
+        assert not set_condition(cr, COND_READY, "True", "R")
+        assert set_condition(cr, COND_READY, "False", "R")
+
+
+class TestCRDs:
+    def test_crds_render_valid_yaml(self):
+        for crd in all_crds():
+            text = yaml.safe_dump(crd)
+            back = yaml.safe_load(text)
+            assert back["kind"] == "CustomResourceDefinition"
+
+    def test_cluster_policy_schema_shape(self):
+        crd = cluster_policy_crd()
+        v = crd["spec"]["versions"][0]
+        assert v["subresources"] == {"status": {}}
+        props = v["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        for key in ("libtpu", "tpuRuntime", "devicePlugin", "metricsExporter",
+                    "nodeStatusExporter", "topologyManager", "validator",
+                    "upgradePolicy", "hostPaths", "daemonsets", "operator"):
+            assert key in props, key
+        assert props["libtpu"]["properties"]["installDir"]["type"] == "string"
+
+    def test_driver_type_immutable_cel(self):
+        crd = tpu_driver_crd()
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        rules = schema["properties"]["spec"]["properties"]["driverType"][
+            "x-kubernetes-validations"]
+        assert rules[0]["rule"] == "self == oldSelf"
+
+
+class TestImage:
+    def test_image_path_joins(self):
+        assert image_path("libtpu", "gcr.io/proj", "libtpu", "1.0") == \
+            "gcr.io/proj/libtpu:1.0"
+
+    def test_digest_uses_at(self):
+        assert "@sha256:" in image_path("libtpu", "gcr.io/p", "i",
+                                        "sha256:" + "a" * 64)
+
+    def test_env_fallback(self):
+        os.environ[env_var_for("metrics-exporter")] = "gcr.io/fallback/me:9"
+        try:
+            assert image_path("metrics-exporter", None, None, None) == \
+                "gcr.io/fallback/me:9"
+        finally:
+            del os.environ[env_var_for("metrics-exporter")]
+
+    def test_unresolvable_raises(self):
+        with pytest.raises(ValueError):
+            image_path("nope", None, None, None)
+
+    def test_fully_qualified_passthrough(self):
+        assert image_path("x", None, "gcr.io/p/i:tag", None) == "gcr.io/p/i:tag"
+
+
+class TestLabels:
+    def test_generation_mapping(self):
+        assert accelerator_generation("tpu-v4-podslice") == "v4"
+        assert accelerator_generation("tpu-v5-lite-podslice") == "v5e"
+        assert accelerator_generation("tpu-v5p-slice") == "v5p"
+        assert accelerator_generation("tpu-v6e-slice") == "v6e"
+
+    def test_deploy_label(self):
+        assert deploy_label("libtpu-driver") == "tpu.graft.dev/deploy.libtpu-driver"
+
+
+class TestTPUDriverSpec:
+    def test_defaults(self):
+        spec = TPUDriverSpec.from_obj({"spec": {}})
+        assert spec.driver_type == "libtpu"
+        assert spec.channel == "stable"
+
+    def test_node_selector_roundtrip(self):
+        spec = TPUDriverSpec.from_obj(
+            {"spec": {"nodeSelector": {"pool": "v5p"},
+                      "upgradePolicy": {"maxUnavailable": "50%"}}})
+        assert spec.node_selector == {"pool": "v5p"}
+        assert spec.upgrade_policy.max_unavailable == "50%"

@@ -1,0 +1,223 @@
+"""ClusterPolicy reconcile FSM on a fake cluster — the BASELINE.json
+config #1 tier ("ClusterPolicy reconcile on kind cluster, no accelerator"),
+mirroring the reference's mock-cluster tests
+(controllers/object_controls_test.go:147-231)."""
+
+import time
+
+import pytest
+
+from tpu_operator.api import (
+    KIND_CLUSTER_POLICY,
+    V1,
+    new_cluster_policy,
+)
+from tpu_operator.api import labels as L
+from tpu_operator.api.conditions import COND_READY, get_condition
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.controllers.state_manager import (
+    StateManager,
+    desired_node_labels,
+    is_tpu_node,
+)
+from tpu_operator.runtime import FakeClient, ListOptions, Manager, Request
+
+
+V5P_LABELS = {
+    L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+    L.GKE_TPU_TOPOLOGY: "2x2x1",
+    L.GKE_ACCELERATOR_COUNT: "4",
+}
+
+
+def make_cluster(n_tpu=1, n_cpu=1):
+    c = FakeClient()
+    for i in range(n_tpu):
+        c.add_node(f"tpu-{i}", labels=dict(V5P_LABELS),
+                   allocatable={"google.com/tpu": "4"})
+    for i in range(n_cpu):
+        c.add_node(f"cpu-{i}")
+    return c
+
+
+class TestNodeLabelling:
+    def test_detects_tpu_by_label_and_capacity(self):
+        c = make_cluster()
+        nodes = {n["metadata"]["name"]: n for n in c.list("v1", "Node")}
+        assert is_tpu_node(nodes["tpu-0"])
+        assert not is_tpu_node(nodes["cpu-0"])
+
+    def test_desired_labels_container_config(self):
+        c = make_cluster()
+        node = c.get("v1", "Node", "tpu-0")
+        want = desired_node_labels(node)
+        assert want[L.TPU_PRESENT] == "true"
+        assert want[L.TPU_GENERATION] == "v5p"
+        assert want[L.TPU_CHIP_COUNT] == "4"
+        assert want[L.deploy_label("libtpu-driver")] == "true"
+        assert want[L.deploy_label("tpu-device-plugin")] == "true"
+        assert want[L.deploy_label("metrics-exporter")] == "true"
+
+    def test_isolated_config_drops_observability_states(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5P_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"})
+        want = desired_node_labels(c.get("v1", "Node", "tpu-0"))
+        assert want[L.deploy_label("libtpu-driver")] == "true"
+        assert L.deploy_label("metrics-exporter") not in want or \
+            want[L.deploy_label("metrics-exporter")] is None
+
+    def test_label_tpu_nodes_stamps_and_counts(self):
+        c = make_cluster(n_tpu=2)
+        sm = StateManager(client=c, namespace="tpu-operator")
+        assert sm.label_tpu_nodes() == 2
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][L.TPU_PRESENT] == "true"
+        cpu = c.get("v1", "Node", "cpu-0")
+        assert L.TPU_PRESENT not in cpu["metadata"]["labels"]
+
+    def test_labels_removed_when_node_loses_tpu(self):
+        c = make_cluster()
+        sm = StateManager(client=c, namespace="tpu-operator")
+        sm.label_tpu_nodes()
+        # simulate node losing its accelerator (pool recreate)
+        node = c.get("v1", "Node", "tpu-0")
+        del node["metadata"]["labels"][L.GKE_TPU_ACCELERATOR]
+        node["status"]["allocatable"] = {}
+        c.update(node)
+        sm.label_tpu_nodes()
+        node = c.get("v1", "Node", "tpu-0")
+        assert L.TPU_PRESENT not in node["metadata"]["labels"]
+        assert not any(k.startswith(L.DEPLOY_PREFIX)
+                       for k in node["metadata"]["labels"])
+
+
+def reconcile_once(client, name="tpu-cluster-policy"):
+    rec = ClusterPolicyReconciler(client=client, namespace="tpu-operator")
+    return rec, rec.reconcile(Request(name=name))
+
+
+class TestReconcile:
+    def test_full_convergence_to_ready(self):
+        c = make_cluster()
+        cr = c.create(new_cluster_policy())
+        rec, result = reconcile_once(c)
+        # first pass: states applied, DaemonSets pending
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "notReady"
+        assert result.requeue_after == 5.0
+        ds_names = {d["metadata"]["name"]
+                    for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-libtpu-driver-daemonset" in ds_names
+        assert "tpu-operator-validator" in ds_names
+        assert "tpu-device-plugin-daemonset" in ds_names
+        # kubelet schedules pods and they go ready
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "ready"
+        assert get_condition(got, COND_READY)["status"] == "True"
+
+    def test_no_tpu_nodes_polls_45s(self):
+        c = FakeClient()
+        c.add_node("cpu-0")
+        c.create(new_cluster_policy())
+        _, result = reconcile_once(c)
+        assert result.requeue_after == 45.0
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "notReady"
+        assert get_condition(got, COND_READY)["reason"] == "NoTPUNodes"
+
+    def test_singleton_duplicate_ignored(self):
+        c = make_cluster()
+        c.create(new_cluster_policy("first"))
+        time.sleep(0.01)
+        second = new_cluster_policy("zz-second")
+        second["metadata"]["creationTimestamp"] = "2099-01-01T00:00:00Z"
+        c.create(second)
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="zz-second"))
+        got = c.get(V1, KIND_CLUSTER_POLICY, "zz-second")
+        assert got["status"]["state"] == "ignored"
+
+    def test_disabled_operand_deleted_and_skipped(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        rec, _ = reconcile_once(c)
+        assert any(d["metadata"]["name"] == "libtpu-metrics-exporter"
+                   for d in c.list("apps/v1", "DaemonSet"))
+        # disable the metrics exporter
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"] = {"metricsExporter": {"enabled": False}}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert not any(d["metadata"]["name"] == "libtpu-metrics-exporter"
+                       for d in c.list("apps/v1", "DaemonSet"))
+
+    def test_hash_skip_avoids_rewrites(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        rec, _ = reconcile_once(c)
+        ds_before = c.get("apps/v1", "DaemonSet",
+                          "tpu-libtpu-driver-daemonset", "tpu-operator")
+        rv_before = ds_before["metadata"]["resourceVersion"]
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds_after = c.get("apps/v1", "DaemonSet",
+                         "tpu-libtpu-driver-daemonset", "tpu-operator")
+        assert ds_after["metadata"]["resourceVersion"] == rv_before
+
+    def test_spec_change_updates_daemonset(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        rec, _ = reconcile_once(c)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"] = {"libtpu": {"installDir": "/opt/custom"}}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds = c.get("apps/v1", "DaemonSet",
+                   "tpu-libtpu-driver-daemonset", "tpu-operator")
+        mounts = ds["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+        assert any(m["mountPath"] == "/opt/custom" for m in mounts)
+
+    def test_stale_revision_blocks_ready(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        rec, _ = reconcile_once(c)
+        c.simulate_kubelet(ready=True, stale_hash=True)
+        result = rec.reconcile(Request(name="tpu-cluster-policy"))
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "notReady"
+        assert result.requeue_after == 5.0
+
+    def test_owner_references_set_for_gc(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        reconcile_once(c)
+        ds = c.get("apps/v1", "DaemonSet",
+                   "tpu-libtpu-driver-daemonset", "tpu-operator")
+        refs = ds["metadata"]["ownerReferences"]
+        assert refs[0]["kind"] == KIND_CLUSTER_POLICY
+
+    def test_event_driven_end_to_end(self):
+        """Full async path: manager + watches, no manual reconcile calls."""
+        c = make_cluster()
+        mgr = Manager(c, namespace="tpu-operator")
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        mgr.add_reconciler(rec)
+        mgr.start()
+        try:
+            c.create(new_cluster_policy())
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                # a real kubelet acts continuously; re-simulate each poll so
+                # DaemonSets created on later reconciles also gain status
+                c.simulate_kubelet(ready=True)
+                got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+                if got.get("status", {}).get("state") == "ready":
+                    break
+                time.sleep(0.1)
+            assert got["status"]["state"] == "ready"
+        finally:
+            mgr.stop()

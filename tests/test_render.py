@@ -1,0 +1,115 @@
+"""Template engine + renderer (internal/render/render_test.go analog)."""
+
+import pathlib
+
+import pytest
+
+from tpu_operator.render import (
+    MissingKeyError,
+    Renderer,
+    TemplateError,
+    render_string,
+)
+
+
+class TestEngine:
+    def test_field_access(self):
+        assert render_string("v={{ .A.B }}", {"A": {"B": 3}}) == "v=3"
+
+    def test_missing_key_errors(self):
+        with pytest.raises(MissingKeyError):
+            render_string("{{ .A.Missing }}", {"A": {}})
+
+    def test_if_else(self):
+        t = "{{ if .On }}yes{{ else }}no{{ end }}"
+        assert render_string(t, {"On": True}) == "yes"
+        assert render_string(t, {"On": False}) == "no"
+        assert render_string(t, {"On": []}) == "no"  # go truthiness
+
+    def test_else_if(self):
+        t = "{{ if eq .X 1 }}one{{ else if eq .X 2 }}two{{ else }}many{{ end }}"
+        assert render_string(t, {"X": 2}) == "two"
+        assert render_string(t, {"X": 9}) == "many"
+
+    def test_range_rebinds_dot_and_dollar(self):
+        t = "{{ range .Items }}{{ . }}:{{ $.Sep }} {{ end }}"
+        assert render_string(t, {"Items": [1, 2], "Sep": ";"}) == "1:; 2:; "
+
+    def test_pipes_and_funcs(self):
+        assert render_string('{{ .N | quote }}', {"N": "ab"}) == '"ab"'
+        assert render_string('{{ default "d" .Missing2 }}',
+                             {"Missing2": None}) == "d"
+        assert render_string('{{ .S | upper | quote }}', {"S": "x"}) == '"X"'
+
+    def test_indent_nindent_toyaml(self):
+        data = {"Sel": {"app": "x", "tier": "db"}}
+        out = render_string("sel:{{ .Sel | toYaml | nindent 2 }}", data)
+        assert out == "sel:\n  app: x\n  tier: db"
+
+    def test_whitespace_trim(self):
+        t = "a\n{{- if .On }}\nb\n{{- end }}\nc"
+        assert render_string(t, {"On": True}) == "a\nb\nc"
+        assert render_string(t, {"On": False}) == "a\nc"
+
+    def test_comments_dropped(self):
+        assert render_string("a{{/* hidden */}}b", {}) == "ab"
+
+    def test_nested_blocks(self):
+        t = ("{{ range .Pools }}{{ if .on }}[{{ .name }}]{{ end }}{{ end }}")
+        data = {"Pools": [{"on": True, "name": "a"},
+                          {"on": False, "name": "b"},
+                          {"on": True, "name": "c"}]}
+        assert render_string(t, data) == "[a][c]"
+
+    def test_and_or_not(self):
+        assert render_string("{{ if and .A .B }}y{{ else }}n{{ end }}",
+                             {"A": 1, "B": ""}) == "n"
+        assert render_string("{{ if or .A .B }}y{{ else }}n{{ end }}",
+                             {"A": "", "B": "x"}) == "y"
+        assert render_string("{{ if not .A }}y{{ else }}n{{ end }}",
+                             {"A": ""}) == "y"
+
+    def test_parens(self):
+        t = '{{ if and (eq .A 1) (not .B) }}y{{ else }}n{{ end }}'
+        assert render_string(t, {"A": 1, "B": False}) == "y"
+        assert render_string(t, {"A": 2, "B": False}) == "n"
+
+    def test_unbalanced_end_raises(self):
+        with pytest.raises(TemplateError):
+            render_string("{{ end }}", {})
+        with pytest.raises(TemplateError):
+            render_string("{{ if .X }}a", {"X": 1})
+
+    def test_booleans_render_go_style(self):
+        assert render_string("{{ .B }}", {"B": True}) == "true"
+
+    def test_printf_and_ternary(self):
+        assert render_string('{{ printf "%s-%d" .A .B }}', {"A": "x", "B": 7}) == "x-7"
+        assert render_string('{{ ternary "a" "b" .C }}', {"C": True}) == "a"
+
+
+class TestRenderer:
+    def test_renders_dir_in_order(self, tmp_path: pathlib.Path):
+        (tmp_path / "0200_b.yaml").write_text(
+            "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: {{ .Name }}-b\n")
+        (tmp_path / "0100_a.yaml").write_text(
+            "apiVersion: v1\nkind: ServiceAccount\nmetadata:\n  name: {{ .Name }}-a\n")
+        objs = Renderer(tmp_path).render_objects({"Name": "x"})
+        assert [o["kind"] for o in objs] == ["ServiceAccount", "ConfigMap"]
+        assert objs[0]["metadata"]["name"] == "x-a"
+
+    def test_conditional_doc_dropped(self, tmp_path: pathlib.Path):
+        (tmp_path / "0100_opt.yaml").write_text(
+            "{{ if .On }}\napiVersion: v1\nkind: ConfigMap\n"
+            "metadata:\n  name: opt\n{{ end }}\n")
+        assert Renderer(tmp_path).render_objects({"On": False}) == []
+        assert len(Renderer(tmp_path).render_objects({"On": True})) == 1
+
+    def test_invalid_yaml_raises_with_context(self, tmp_path: pathlib.Path):
+        (tmp_path / "0100_bad.yaml").write_text("kind: [unclosed\n")
+        with pytest.raises(TemplateError):
+            Renderer(tmp_path).render_objects({})
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            Renderer(tmp_path / "nope")

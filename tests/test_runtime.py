@@ -64,9 +64,22 @@ class TestFakeClient:
         c = FakeClient()
         c.create(make_cm("a"))
         fresh = c.get("v1", "ConfigMap", "a", "default")
-        c.update(fresh)  # bumps RV
+        changed = dict(fresh, data={"k": "new"})
+        c.update(changed)  # bumps RV
+        changed2 = dict(fresh, data={"k": "other"})
         with pytest.raises(ConflictError):
-            c.update(fresh)  # stale RV now
+            c.update(changed2)  # stale RV now
+
+    def test_noop_update_emits_no_event(self):
+        c = FakeClient()
+        c.create(make_cm("a", data={"k": "v"}))
+        events = []
+        c.watch("v1", "ConfigMap", lambda e: events.append(e.type))
+        n = len(events)
+        obj = c.get("v1", "ConfigMap", "a", "default")
+        c.update(obj)             # identical content
+        c.update_status(obj)      # identical (empty) status
+        assert len(events) == n
 
     def test_generation_bumps_only_on_spec_change(self):
         c = FakeClient()

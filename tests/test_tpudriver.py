@@ -1,0 +1,163 @@
+"""TPUDriver controller: node-pool partitioning, per-pool DaemonSets,
+nodeSelector conflict validation (nvidiadriver_controller.go tier)."""
+
+import pytest
+
+from tpu_operator.api import (
+    KIND_TPU_DRIVER,
+    V1ALPHA1,
+    new_cluster_policy,
+    new_tpu_driver,
+)
+from tpu_operator.api import labels as L
+from tpu_operator.api.conditions import COND_ERROR, COND_READY, get_condition
+from tpu_operator.controllers.tpudriver_controller import TPUDriverReconciler
+from tpu_operator.controllers.validation import (
+    ValidationError,
+    validate_node_selectors,
+)
+from tpu_operator.runtime import FakeClient, ListOptions, Request
+from tpu_operator.state.nodepool import NodePool, get_node_pools
+
+
+def v5p_node(c, name, topology="2x2x1", extra=None):
+    return c.add_node(name, labels={
+        L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+        L.GKE_TPU_TOPOLOGY: topology,
+        L.GKE_ACCELERATOR_COUNT: "4", **(extra or {})},
+        allocatable={"google.com/tpu": "4"})
+
+
+def v5e_node(c, name, extra=None):
+    return c.add_node(name, labels={
+        L.GKE_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        L.GKE_TPU_TOPOLOGY: "2x4", **(extra or {})},
+        allocatable={"google.com/tpu": "8"})
+
+
+class TestNodePools:
+    def test_partition_by_generation_and_topology(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        v5p_node(c, "b")
+        v5e_node(c, "e0")
+        pools = get_node_pools(c.list("v1", "Node"))
+        assert [(p.name, p.nodes) for p in pools] == [
+            ("v5e-2x4", ["e0"]), ("v5p-2x2x1", ["a", "b"])]
+
+    def test_restrict_selector(self):
+        c = FakeClient()
+        v5p_node(c, "a", extra={"pool": "x"})
+        v5e_node(c, "e0")
+        pools = get_node_pools(c.list("v1", "Node"), restrict={"pool": "x"})
+        assert len(pools) == 1 and pools[0].nodes == ["a"]
+
+    def test_multi_host_detection(self):
+        assert not NodePool("tpu-v5p-slice", "2x2x1").multi_host
+        assert NodePool("tpu-v5p-slice", "4x4x4").multi_host  # 64 chips
+        assert not NodePool("tpu-v5-lite-podslice", "2x4").multi_host  # 8/host
+        assert NodePool("tpu-v5-lite-podslice", "4x4").multi_host
+
+    def test_cpu_nodes_ignored(self):
+        c = FakeClient()
+        c.add_node("cpu-0")
+        assert get_node_pools(c.list("v1", "Node")) == []
+
+
+class TestValidation:
+    def test_disjoint_selectors_ok(self):
+        c = FakeClient()
+        v5p_node(c, "a", extra={"pool": "x"})
+        v5e_node(c, "e", extra={"pool": "y"})
+        c.create(new_tpu_driver("dx", {"nodeSelector": {"pool": "x"}}))
+        cr = c.create(new_tpu_driver("dy", {"nodeSelector": {"pool": "y"}}))
+        validate_node_selectors(c, cr)  # no raise
+
+    def test_overlap_rejected(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        c.create(new_tpu_driver("d1", {"nodeSelector": {}}))
+        cr = c.create(new_tpu_driver("d2", {
+            "nodeSelector": {L.GKE_TPU_TOPOLOGY: "2x2x1"}}))
+        with pytest.raises(ValidationError):
+            validate_node_selectors(c, cr)
+
+
+class TestTPUDriverReconcile:
+    def _setup(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        v5e_node(c, "e0")
+        c.create(new_cluster_policy())
+        rec = TPUDriverReconciler(client=c, namespace="tpu-operator")
+        return c, rec
+
+    def test_per_pool_daemonsets(self):
+        c, rec = self._setup()
+        c.create(new_tpu_driver("flavors"))
+        result = rec.reconcile(Request(name="flavors"))
+        names = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-libtpu-driver-v5p-2x2x1" in names
+        assert "tpu-libtpu-driver-v5e-2x4" in names
+        assert result.requeue_after == 5.0  # pods pending
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="flavors"))
+        got = c.get(V1ALPHA1, KIND_TPU_DRIVER, "flavors")
+        assert got["status"]["state"] == "ready"
+        assert get_condition(got, COND_READY)["status"] == "True"
+
+    def test_pool_selector_on_daemonset(self):
+        c, rec = self._setup()
+        c.create(new_tpu_driver("flavors"))
+        rec.reconcile(Request(name="flavors"))
+        ds = c.get("apps/v1", "DaemonSet", "tpu-libtpu-driver-v5p-2x2x1",
+                   "tpu-operator")
+        sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel[L.GKE_TPU_ACCELERATOR] == "tpu-v5p-slice"
+        assert sel[L.GKE_TPU_TOPOLOGY] == "2x2x1"
+        assert sel[L.deploy_label("libtpu-driver")] == "true"
+        assert ds["spec"]["updateStrategy"]["type"] == "OnDelete"
+
+    def test_stale_pool_cleanup(self):
+        c, rec = self._setup()
+        c.create(new_tpu_driver("flavors"))
+        rec.reconcile(Request(name="flavors"))
+        # the v5e pool disappears (nodepool deleted)
+        c.delete("v1", "Node", "e0")
+        rec.reconcile(Request(name="flavors"))
+        names = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-libtpu-driver-v5e-2x4" not in names
+        assert "tpu-libtpu-driver-v5p-2x2x1" in names
+
+    def test_conflict_sets_error_condition(self):
+        c, rec = self._setup()
+        c.create(new_tpu_driver("one"))
+        c.create(new_tpu_driver("two"))
+        rec.reconcile(Request(name="two"))
+        got = c.get(V1ALPHA1, KIND_TPU_DRIVER, "two")
+        assert get_condition(got, COND_ERROR)["status"] == "True"
+        assert "disjoint" in get_condition(got, COND_ERROR)["message"]
+
+    def test_requires_cluster_policy(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        rec = TPUDriverReconciler(client=c, namespace="tpu-operator")
+        c.create(new_tpu_driver("solo"))
+        rec.reconcile(Request(name="solo"))
+        got = c.get(V1ALPHA1, KIND_TPU_DRIVER, "solo")
+        assert get_condition(got, COND_ERROR)["reason"] == "MissingClusterPolicy"
+
+    def test_policy_driver_state_stands_down_in_crd_mode(self):
+        from tpu_operator.controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+        )
+        c, rec = self._setup()
+        prec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        assert any(d["metadata"]["name"] == "tpu-libtpu-driver-daemonset"
+                   for d in c.list("apps/v1", "DaemonSet"))
+        # creating a TPUDriver CR flips the policy state to CRD mode
+        c.create(new_tpu_driver("flavors"))
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        assert not any(d["metadata"]["name"] == "tpu-libtpu-driver-daemonset"
+                       for d in c.list("apps/v1", "DaemonSet"))

@@ -1,0 +1,140 @@
+"""Upgrade controller: per-node FSM, budget, drain semantics
+(upgrade_controller.go tier)."""
+
+from tpu_operator.api import V1, KIND_CLUSTER_POLICY, new_cluster_policy
+from tpu_operator.api import labels as L
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.controllers.upgrade_controller import (
+    STATE_DONE,
+    STATE_UPGRADE_REQUIRED,
+    STATE_VALIDATION,
+    UpgradeReconciler,
+)
+from tpu_operator.runtime import FakeClient, ListOptions, Request
+from tpu_operator.runtime.objects import get_nested, labels_of
+
+
+def build_converged_cluster(n_nodes=2, auto_upgrade=True):
+    """Fake cluster with the driver DS deployed and ready on every node."""
+    c = FakeClient()
+    for i in range(n_nodes):
+        c.add_node(f"tpu-{i}", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x1",
+            L.GKE_ACCELERATOR_COUNT: "4"},
+            allocatable={"google.com/tpu": "4"})
+    c.create(new_cluster_policy(spec={
+        "upgradePolicy": {"autoUpgrade": auto_upgrade,
+                          "maxParallelUpgrades": 1}}))
+    prec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    prec.reconcile(Request(name="tpu-cluster-policy"))
+    c.simulate_kubelet(ready=True)
+    prec.reconcile(Request(name="tpu-cluster-policy"))
+    return c, prec
+
+
+def change_driver_spec(c, prec):
+    """Bump the libtpu config so the driver DS template changes; OnDelete
+    keeps existing pods on the old revision."""
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    spec = cr.get("spec") or {}
+    spec["libtpu"] = {"installDir": "/opt/new-libtpu"}
+    cr["spec"] = spec
+    c.update(cr)
+    prec.reconcile(Request(name="tpu-cluster-policy"))
+    c.simulate_kubelet(ready=True)
+
+
+def driver_pods(c):
+    return c.list("v1", "Pod", ListOptions(
+        label_selector={"tpu.graft.dev/component": "libtpu-driver"}))
+
+
+class TestUpgradeFSM:
+    def test_noop_when_current(self):
+        c, _ = build_converged_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        result = rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert result.requeue_after == 120.0
+        for node in c.list("v1", "Node"):
+            assert labels_of(node).get(L.UPGRADE_STATE) in (None, STATE_DONE)
+
+    def test_auto_upgrade_off_strips_labels(self):
+        c, _ = build_converged_cluster(auto_upgrade=False)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"labels": {L.UPGRADE_STATE: "upgrade-required"}}})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))
+
+    def test_single_node_full_upgrade_cycle(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        # pod still on old revision (OnDelete)
+        [pod] = driver_pods(c)
+        old_hash = labels_of(pod)["controller-revision-hash"]
+        # pass 1: cordon + drain + delete driver pod -> validation wait
+        result = rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_VALIDATION
+        assert get_nested(node, "spec", "unschedulable") is True
+        assert driver_pods(c) == []  # driver pod deleted
+        assert result.requeue_after == 5.0
+        # kubelet recreates the pod on the new revision
+        c.simulate_kubelet(ready=True)
+        [pod] = driver_pods(c)
+        assert labels_of(pod)["controller-revision-hash"] != old_hash
+        # pass 2: validation passes -> uncordon -> done
+        result = rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_DONE
+        assert not get_nested(node, "spec", "unschedulable", default=False)
+        assert result.requeue_after == 120.0
+
+    def test_parallel_budget_respected(self):
+        c, prec = build_converged_cluster(n_nodes=3)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        states = [labels_of(n).get(L.UPGRADE_STATE)
+                  for n in c.list("v1", "Node")]
+        # maxParallelUpgrades=1: exactly one node advanced past
+        # upgrade-required
+        assert states.count(STATE_UPGRADE_REQUIRED) == 2
+        assert states.count(STATE_VALIDATION) == 1
+
+    def test_drain_evicts_tpu_workloads_but_respects_skip_label(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        for name, skip in (("train-job", False), ("sacred-job", True)):
+            labels = {L.UPGRADE_SKIP_DRAIN: "true"} if skip else {}
+            c.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": name, "namespace": "default",
+                                   "labels": labels},
+                      "spec": {"nodeName": "tpu-0",
+                               "containers": [{
+                                   "name": "t",
+                                   "resources": {"requests":
+                                                 {"google.com/tpu": "4"}}}]},
+                      "status": {"phase": "Running"}})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert c.get_or_none("v1", "Pod", "train-job", "default") is None
+        assert c.get_or_none("v1", "Pod", "sacred-job", "default") is not None
+
+    def test_eventual_full_fleet_upgrade(self):
+        c, prec = build_converged_cluster(n_nodes=3)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        for _ in range(12):  # budget 1 -> a few passes per node
+            rec.reconcile(Request(name="tpu-cluster-policy"))
+            c.simulate_kubelet(ready=True)
+        states = {labels_of(n).get(L.UPGRADE_STATE)
+                  for n in c.list("v1", "Node")}
+        assert states == {STATE_DONE}
+        # and all driver pods are on the new revision + nodes schedulable
+        for node in c.list("v1", "Node"):
+            assert not get_nested(node, "spec", "unschedulable", default=False)

@@ -1,0 +1,17 @@
+from .clusterpolicy import (  # noqa: F401
+    GROUP,
+    KIND_CLUSTER_POLICY,
+    STATE_DISABLED,
+    STATE_IGNORED,
+    STATE_NOT_READY,
+    STATE_READY,
+    V1,
+    TPUClusterPolicySpec,
+    new_cluster_policy,
+)
+from .tpudriver import (  # noqa: F401
+    KIND_TPU_DRIVER,
+    V1ALPHA1,
+    TPUDriverSpec,
+    new_tpu_driver,
+)

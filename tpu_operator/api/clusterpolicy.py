@@ -1,0 +1,228 @@
+"""TPUClusterPolicy: the singleton, cluster-scoped, whole-stack CRD.
+
+The TPU-native analog of ClusterPolicy
+(reference api/nvidia/v1/clusterpolicy_types.go:42-99): one sub-spec per
+operand, a coarse status state enum (clusterpolicy_types.go:1658-1670) and
+conditions (1672-1681). The CUDA operand set maps to TPU as laid out in
+SURVEY.md section 2.4: libtpu installer, TPU runtime hookup, TPU device
+plugin, libtpu metrics exporter, node-status exporter, topology/slice
+manager, and a JAX-workload validator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .convert import field, from_dict, to_dict
+
+GROUP = "tpu.graft.dev"
+V1 = f"{GROUP}/v1"
+
+KIND_CLUSTER_POLICY = "TPUClusterPolicy"
+
+# status.state values (clusterpolicy_types.go:1658-1670 analog)
+STATE_IGNORED = "ignored"
+STATE_READY = "ready"
+STATE_NOT_READY = "notReady"
+STATE_DISABLED = "disabled"
+
+
+@dataclass
+class ComponentSpec:
+    """Config surface shared by every operand (enable flag + image +
+    scheduling + env), mirroring the per-operand field set repeated through
+    clusterpolicy_types.go."""
+
+    enabled: Optional[bool] = field(description="Deploy this operand")
+    repository: Optional[str] = field(description="Image registry+path prefix")
+    image: Optional[str] = field(description="Image name")
+    version: Optional[str] = field(description="Image tag or sha256: digest")
+    image_pull_policy: Optional[str] = field(description="IfNotPresent|Always|Never")
+    image_pull_secrets: Optional[List[str]] = None
+    args: Optional[List[str]] = None
+    env: Optional[List[Any]] = field(description="corev1 EnvVar list")
+    resources: Optional[Any] = field(description="corev1 ResourceRequirements")
+
+    def is_enabled(self, default: bool = True) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
+
+
+@dataclass
+class OperatorSpec:
+    """Operator-global knobs (OperatorSpec analog,
+    clusterpolicy_types.go Operator section)."""
+
+    runtime_class: Optional[str] = field(
+        default="tpu", description="RuntimeClass registered by pre-requisites")
+    init_container: Optional[ComponentSpec] = None
+    labels: Optional[Dict[str, str]] = None
+    annotations: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class DaemonsetsSpec:
+    """Defaults applied to every operand DaemonSet
+    (DaemonsetsSpec analog)."""
+
+    labels: Optional[Dict[str, str]] = None
+    annotations: Optional[Dict[str, str]] = None
+    tolerations: Optional[List[Any]] = None
+    priority_class_name: Optional[str] = field(default="system-node-critical")
+    update_strategy: Optional[str] = field(
+        default="RollingUpdate", description="RollingUpdate|OnDelete")
+    rolling_update_max_unavailable: Optional[str] = field(
+        name="rollingUpdateMaxUnavailable", default="1")
+
+
+@dataclass
+class LibtpuSpec(ComponentSpec):
+    """state-libtpu-driver: install/verify libtpu + TPU runtime on the node
+    (the driver-container slot, SURVEY.md 2.4 row 1)."""
+
+    install_dir: Optional[str] = field(
+        default="/home/kubernetes/bin", description="Host dir for libtpu.so")
+    channel: Optional[str] = field(
+        default="stable", description="stable|nightly|custom")
+
+
+@dataclass
+class TPURuntimeSpec(ComponentSpec):
+    """state-tpu-runtime: device exposure + env hookup
+    (container-toolkit slot)."""
+
+    device_path_glob: Optional[str] = field(
+        name="devicePathGlob", default="/dev/accel*")
+
+
+@dataclass
+class DevicePluginSpec(ComponentSpec):
+    """state-tpu-device-plugin: advertise google.com/tpu to kubelet
+    (k8s-device-plugin slot)."""
+
+    resource_name: Optional[str] = field(default="google.com/tpu")
+    sharing_policy: Optional[str] = field(
+        default="exclusive", description="exclusive|time-shared")
+
+
+@dataclass
+class MetricsExporterSpec(ComponentSpec):
+    """state-metrics-exporter: libtpu runtime metrics -> Prometheus
+    (DCGM + dcgm-exporter slot)."""
+
+    port: Optional[int] = field(default=9400)
+    service_monitor: Optional[bool] = field(default=False)
+    collection_interval_seconds: Optional[int] = field(default=15)
+
+
+@dataclass
+class NodeStatusExporterSpec(ComponentSpec):
+    """state-node-status-exporter: per-node validation status gauges."""
+
+    port: Optional[int] = field(default=9401)
+
+
+@dataclass
+class TopologyManagerSpec(ComponentSpec):
+    """state-topology-manager: slice shaping from node labels (the
+    MIG-manager slot; config label tpu.graft.dev/slice.config)."""
+
+    config_map: Optional[str] = field(
+        default="default-slice-config",
+        description="ConfigMap of named slice profiles")
+    default_profile: Optional[str] = field(default="full")
+
+
+@dataclass
+class ValidatorSpec(ComponentSpec):
+    """state-operator-validation: the readiness gate (validator/ slot)."""
+
+    plugin: Optional[ComponentSpec] = None
+    driver: Optional[ComponentSpec] = None
+    jax: Optional[ComponentSpec] = None
+    ici: Optional[ComponentSpec] = None
+    matmul_size: Optional[int] = field(
+        default=4096, description="N for the NxN bf16 matmul MXU proof")
+    ici_bandwidth_threshold: Optional[float] = field(
+        name="iciBandwidthThreshold", default=0.8,
+        description="Fraction of theoretical ICI bandwidth required")
+
+
+@dataclass
+class DriverUpgradePolicySpec:
+    """Rolling libtpu upgrade policy (UpgradePolicy analog,
+    upgrade_controller.go:103-121 gates)."""
+
+    auto_upgrade: Optional[bool] = field(default=False)
+    max_parallel_upgrades: Optional[int] = field(default=1)
+    max_unavailable: Optional[str] = field(default="25%")
+    wait_for_completion_timeout_seconds: Optional[int] = field(default=0)
+    pod_deletion_timeout_seconds: Optional[int] = field(default=300)
+    drain_enable: Optional[bool] = field(name="drainEnable", default=True)
+    drain_timeout_seconds: Optional[int] = field(default=300)
+    drain_delete_emptydir: Optional[bool] = field(
+        name="drainDeleteEmptyDir", default=False)
+
+
+@dataclass
+class HostPathsSpec:
+    """Host filesystem anchor points (HostPathsSpec analog)."""
+
+    root_fs: Optional[str] = field(name="rootFS", default="/")
+    validation_dir: Optional[str] = field(
+        default="/run/tpu/validations",
+        description="hostPath dir for the status-file barrier protocol")
+    dev_dir: Optional[str] = field(default="/dev")
+
+
+@dataclass
+class TPUClusterPolicySpec:
+    operator: Optional[OperatorSpec] = field(default_factory=OperatorSpec)
+    daemonsets: Optional[DaemonsetsSpec] = field(default_factory=DaemonsetsSpec)
+    libtpu: Optional[LibtpuSpec] = field(default_factory=LibtpuSpec)
+    tpu_runtime: Optional[TPURuntimeSpec] = field(
+        name="tpuRuntime", default_factory=TPURuntimeSpec)
+    device_plugin: Optional[DevicePluginSpec] = field(default_factory=DevicePluginSpec)
+    metrics_exporter: Optional[MetricsExporterSpec] = field(
+        default_factory=MetricsExporterSpec)
+    node_status_exporter: Optional[NodeStatusExporterSpec] = field(
+        default_factory=NodeStatusExporterSpec)
+    topology_manager: Optional[TopologyManagerSpec] = field(
+        default_factory=TopologyManagerSpec)
+    validator: Optional[ValidatorSpec] = field(default_factory=ValidatorSpec)
+    upgrade_policy: Optional[DriverUpgradePolicySpec] = field(
+        default_factory=DriverUpgradePolicySpec)
+    host_paths: Optional[HostPathsSpec] = field(default_factory=HostPathsSpec)
+
+    @classmethod
+    def from_obj(cls, cr: dict) -> "TPUClusterPolicySpec":
+        spec = from_dict(cls, cr.get("spec") or {})
+        # default_factory only fires for absent keys at the dataclass level;
+        # normalize explicit nulls too
+        for f_name, factory in (("operator", OperatorSpec),
+                                ("daemonsets", DaemonsetsSpec),
+                                ("libtpu", LibtpuSpec),
+                                ("tpu_runtime", TPURuntimeSpec),
+                                ("device_plugin", DevicePluginSpec),
+                                ("metrics_exporter", MetricsExporterSpec),
+                                ("node_status_exporter", NodeStatusExporterSpec),
+                                ("topology_manager", TopologyManagerSpec),
+                                ("validator", ValidatorSpec),
+                                ("upgrade_policy", DriverUpgradePolicySpec),
+                                ("host_paths", HostPathsSpec)):
+            if getattr(spec, f_name) is None:
+                setattr(spec, f_name, factory())
+        return spec
+
+    def to_obj(self) -> dict:
+        return to_dict(self)
+
+
+def new_cluster_policy(name: str = "tpu-cluster-policy",
+                       spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": V1,
+        "kind": KIND_CLUSTER_POLICY,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

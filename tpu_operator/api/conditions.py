@@ -1,0 +1,79 @@
+"""metav1.Condition handling for CR status.
+
+Mirrors internal/conditions/conditions.go:31-35 (Updater with
+SetConditionsReady / SetConditionsError) and the condition constants used
+by both reconcilers.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from ..runtime.client import Client
+from ..runtime.objects import get_nested, set_nested
+
+COND_READY = "Ready"
+COND_ERROR = "Error"
+
+REASON_RECONCILED = "Reconciled"
+REASON_ERROR = "ReconcileFailed"
+REASON_OPERANDS_NOT_READY = "OperandsNotReady"
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def set_condition(cr: dict, type_: str, status: str, reason: str,
+                  message: str = "") -> bool:
+    """Upsert a condition on ``cr.status.conditions``; returns True when the
+    condition materially changed (lastTransitionTime only moves on a status
+    flip, per metav1 semantics)."""
+    conds = get_nested(cr, "status", "conditions", default=None)
+    if conds is None:
+        conds = []
+        set_nested(cr, conds, "status", "conditions")
+    gen = get_nested(cr, "metadata", "generation", default=0)
+    for c in conds:
+        if c.get("type") == type_:
+            changed = (c.get("status") != status or c.get("reason") != reason
+                       or c.get("message") != message
+                       or c.get("observedGeneration") != gen)
+            if c.get("status") != status:
+                c["lastTransitionTime"] = _now()
+            c.update({"status": status, "reason": reason, "message": message,
+                      "observedGeneration": gen})
+            return changed
+    conds.append({"type": type_, "status": status, "reason": reason,
+                  "message": message, "observedGeneration": gen,
+                  "lastTransitionTime": _now()})
+    return True
+
+
+def set_ready(client: Client, cr: dict, message: str = "") -> None:
+    """Ready=True, Error=False (conditions.Updater.SetConditionsReady)."""
+    set_condition(cr, COND_READY, "True", REASON_RECONCILED, message)
+    set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
+    client.update_status(cr)
+
+
+def set_not_ready(client: Client, cr: dict, reason: str, message: str) -> None:
+    set_condition(cr, COND_READY, "False", reason, message)
+    set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
+    client.update_status(cr)
+
+
+def set_error(client: Client, cr: dict, reason: str, message: str) -> None:
+    """Ready=False, Error=True (SetConditionsError)."""
+    set_condition(cr, COND_READY, "False", reason, message)
+    set_condition(cr, COND_ERROR, "True", reason, message)
+    client.update_status(cr)
+
+
+def get_condition(cr: dict, type_: str) -> Optional[dict]:
+    for c in get_nested(cr, "status", "conditions", default=[]) or []:
+        if c.get("type") == type_:
+            return c
+    return None

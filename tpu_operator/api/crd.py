@@ -1,0 +1,84 @@
+"""CustomResourceDefinition generation.
+
+The reference ships generated CRD manifests under config/crd (kubebuilder
+codegen); here the CRDs are derived directly from the dataclass schemas so
+they can never drift from the types (the failure mode the reference guards
+with `make validate-generated-assets`, Makefile:241-243).
+"""
+
+from __future__ import annotations
+
+from .clusterpolicy import GROUP, KIND_CLUSTER_POLICY, TPUClusterPolicySpec
+from .convert import schema_of
+from .tpudriver import KIND_TPU_DRIVER, TPUDriverSpec
+
+
+def _status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "state": {"type": "string",
+                      "enum": ["ignored", "ready", "notReady", "disabled"]},
+            "namespace": {"type": "string"},
+            "conditions": {"type": "array",
+                           "items": {"type": "object",
+                                     "x-kubernetes-preserve-unknown-fields": True}},
+        },
+    }
+
+
+def _crd(kind: str, plural: str, singular: str, version: str,
+         spec_schema: dict, short_names: list,
+         extra_printer_cols: list | None = None) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": kind, "plural": plural, "singular": singular,
+                      "shortNames": short_names},
+            "scope": "Cluster",
+            "versions": [{
+                "name": version,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"name": "Status", "type": "string",
+                     "jsonPath": ".status.state"},
+                    {"name": "Age", "type": "date",
+                     "jsonPath": ".metadata.creationTimestamp"},
+                ] + (extra_printer_cols or []),
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": spec_schema,
+                        "status": _status_schema(),
+                    },
+                }},
+            }],
+        },
+    }
+
+
+def cluster_policy_crd() -> dict:
+    return _crd(KIND_CLUSTER_POLICY, "tpuclusterpolicies", "tpuclusterpolicy",
+                "v1", schema_of(TPUClusterPolicySpec), ["tcp", "tpucp"])
+
+
+def tpu_driver_crd() -> dict:
+    schema = schema_of(TPUDriverSpec)
+    # driverType is immutable, like the reference's CEL XValidation rules on
+    # NVIDIADriver (nvidiadriver_types.go:40-186)
+    schema["properties"]["driverType"]["x-kubernetes-validations"] = [
+        {"rule": "self == oldSelf",
+         "message": "driverType is immutable"}]
+    return _crd(KIND_TPU_DRIVER, "tpudrivers", "tpudriver", "v1alpha1",
+                schema, ["tpud"],
+                [{"name": "Channel", "type": "string",
+                  "jsonPath": ".spec.channel"}])
+
+
+def all_crds() -> list:
+    return [cluster_policy_crd(), tpu_driver_crd()]

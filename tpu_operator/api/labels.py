@@ -1,0 +1,71 @@
+"""Well-known labels, annotations and file paths.
+
+The consts slot (internal/consts/consts.go analog). Node discovery keys are
+the real GKE TPU node labels — they play the role NFD's nvidia.com/gpu
+labels play in labelGPUNodes (controllers/state_manager.go:479-581).
+"""
+
+# --- GKE-provided TPU node labels (discovery inputs) -----------------------
+GKE_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"  # e.g. tpu-v5p-slice
+GKE_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"        # e.g. 2x2x1
+GKE_ACCELERATOR_COUNT = "cloud.google.com/gke-accelerator-count"
+
+# --- labels stamped by this operator --------------------------------------
+DOMAIN = "tpu.graft.dev"
+TPU_PRESENT = f"{DOMAIN}/present"                 # nvidia.com/gpu.present analog
+DEPLOY_PREFIX = f"{DOMAIN}/deploy."               # nvidia.com/gpu.deploy.<state> analog
+WORKLOAD_CONFIG = f"{DOMAIN}/workload.config"     # container | isolated
+SLICE_CONFIG = f"{DOMAIN}/slice.config"           # nvidia.com/mig.config analog
+SLICE_CONFIG_STATE = f"{DOMAIN}/slice.config.state"  # pending|success|failed
+TPU_GENERATION = f"{DOMAIN}/tpu.generation"       # v4 | v5e | v5p | v6e
+TPU_CHIP_COUNT = f"{DOMAIN}/tpu.chips"
+UPGRADE_STATE = f"{DOMAIN}/upgrade.state"         # upgrade controller FSM label
+UPGRADE_SKIP_DRAIN = f"{DOMAIN}/upgrade.skip-drain"
+
+# --- annotations ----------------------------------------------------------
+LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog
+STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
+
+# --- extended resources ---------------------------------------------------
+TPU_RESOURCE = "google.com/tpu"
+
+# --- barrier protocol -----------------------------------------------------
+DEFAULT_VALIDATION_DIR = "/run/tpu/validations"
+
+# deploy-label sets per workload config (state_manager.go:86-111 analog).
+# TPU has no vGPU/passthrough split; "isolated" nodes get only driver+plugin
+# (for dedicated inference pools that run their own telemetry).
+CONTAINER_WORKLOAD_STATES = (
+    "libtpu-driver",
+    "tpu-runtime",
+    "operator-validation",
+    "tpu-device-plugin",
+    "metrics-exporter",
+    "node-status-exporter",
+    "topology-manager",
+)
+ISOLATED_WORKLOAD_STATES = (
+    "libtpu-driver",
+    "operator-validation",
+    "tpu-device-plugin",
+)
+WORKLOAD_STATE_SETS = {
+    "container": CONTAINER_WORKLOAD_STATES,
+    "isolated": ISOLATED_WORKLOAD_STATES,
+}
+
+
+def deploy_label(state: str) -> str:
+    return DEPLOY_PREFIX + state
+
+
+def accelerator_generation(accelerator_label: str) -> str:
+    """Map a GKE accelerator label value to a TPU generation.
+
+    tpu-v4-podslice -> v4, tpu-v5-lite-podslice -> v5e,
+    tpu-v5p-slice -> v5p, tpu-v6e-slice -> v6e.
+    """
+    v = accelerator_label.removeprefix("tpu-")
+    if v.startswith("v5-lite"):
+        return "v5e"
+    return v.split("-")[0] if v else ""

@@ -1,0 +1,57 @@
+"""TPUDriver: per-node-pool libtpu flavor CRD.
+
+The analog of NVIDIADriver (api/nvidia/v1alpha1/nvidiadriver_types.go:40):
+where the reference selects a kernel-driver flavor (gpu|vgpu, precompiled,
+open modules) per node pool, the TPU version selects a libtpu build
+(stable/nightly/pinned image) per TPU-generation node pool. Multiple CRs
+must not select the same node (internal/validator/validator.go:31-110
+analog lives in controllers/validation.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .clusterpolicy import GROUP, DriverUpgradePolicySpec
+from .convert import field, from_dict, to_dict
+
+V1ALPHA1 = f"{GROUP}/v1alpha1"
+KIND_TPU_DRIVER = "TPUDriver"
+
+
+@dataclass
+class TPUDriverSpec:
+    driver_type: Optional[str] = field(
+        default="libtpu", description="libtpu (container) | host (preinstalled)")
+    repository: Optional[str] = None
+    image: Optional[str] = field(default="libtpu-installer")
+    version: Optional[str] = field(description="libtpu build tag or digest")
+    channel: Optional[str] = field(
+        default="stable", description="stable|nightly|custom")
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: Optional[List[str]] = None
+    node_selector: Optional[Dict[str, str]] = field(
+        description="Selects the TPU node pool this flavor applies to")
+    tolerations: Optional[List[Any]] = None
+    priority_class_name: Optional[str] = None
+    env: Optional[List[Any]] = None
+    resources: Optional[Any] = None
+    install_dir: Optional[str] = field(default="/home/kubernetes/bin")
+    upgrade_policy: Optional[DriverUpgradePolicySpec] = None
+
+    @classmethod
+    def from_obj(cls, cr: dict) -> "TPUDriverSpec":
+        return from_dict(cls, cr.get("spec") or {})
+
+    def to_obj(self) -> dict:
+        return to_dict(self)
+
+
+def new_tpu_driver(name: str, spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": V1ALPHA1,
+        "kind": KIND_TPU_DRIVER,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

@@ -1,0 +1,128 @@
+"""tpu-operator manager entrypoint (cmd/gpu-operator/main.go:72-220 analog).
+
+Run against a real cluster (in-cluster config or kubeconfig):
+
+    python -m tpu_operator.cli.operator --health-port 8080
+
+Or drive a complete self-contained demo cluster (the fake apiserver plus a
+simulated kubelet), which is also how ``/verify`` exercises the control
+plane end-to-end without Kubernetes:
+
+    python -m tpu_operator.cli.operator --fake-cluster --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="TPU-native cluster operator controller manager")
+    p.add_argument("--namespace",
+                   default=os.environ.get("OPERATOR_NAMESPACE", "tpu-operator"),
+                   help="namespace operands are deployed into")
+    p.add_argument("--health-port", type=int, default=None,
+                   help="serve /healthz and /metrics on this port")
+    p.add_argument("--fake-cluster", action="store_true",
+                   help="run against an in-memory cluster with a simulated "
+                        "kubelet (demo/verification mode)")
+    p.add_argument("--fake-tpu-nodes", type=int, default=2,
+                   help="TPU node count for --fake-cluster")
+    p.add_argument("--once", action="store_true",
+                   help="exit once the policy reaches ready (fake mode)")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+    log = logging.getLogger("tpu_operator")
+
+    from ..api import KIND_CLUSTER_POLICY, V1, new_cluster_policy
+    from ..api import labels as L
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.tpudriver_controller import TPUDriverReconciler
+    from ..controllers.upgrade_controller import UpgradeReconciler
+    from ..runtime import Manager
+
+    if args.fake_cluster:
+        from ..runtime import FakeClient
+        client = FakeClient()
+        for i in range(args.fake_tpu_nodes):
+            client.add_node(
+                f"tpu-node-{i}",
+                labels={L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+                        L.GKE_TPU_TOPOLOGY: "2x2x1",
+                        L.GKE_ACCELERATOR_COUNT: "4"},
+                allocatable={"google.com/tpu": "4"})
+        client.create(new_cluster_policy())
+
+        stop = threading.Event()
+
+        def kubelet_loop():
+            while not stop.is_set():
+                try:
+                    client.simulate_kubelet(ready=True)
+                except Exception:
+                    log.exception("kubelet sim failed")
+                stop.wait(0.2)
+
+        threading.Thread(target=kubelet_loop, daemon=True).start()
+    else:
+        from ..runtime.kubeclient import HTTPClient, KubeConfig
+        cfg = (KubeConfig.from_kubeconfig(args.kubeconfig)
+               if args.kubeconfig else KubeConfig.load())
+        client = HTTPClient(cfg)
+        stop = None
+
+    mgr = Manager(client, namespace=args.namespace,
+                  health_port=args.health_port)
+    mgr.add_reconciler(
+        ClusterPolicyReconciler(client=client, namespace=args.namespace))
+    mgr.add_reconciler(
+        TPUDriverReconciler(client=client, namespace=args.namespace))
+    mgr.add_reconciler(
+        UpgradeReconciler(client=client, namespace=args.namespace))
+    mgr.start()
+    log.info("tpu-operator started (namespace=%s, fake=%s)",
+             args.namespace, args.fake_cluster)
+
+    try:
+        start = time.monotonic()
+        while True:
+            if args.fake_cluster:
+                try:
+                    crs = client.list(V1, KIND_CLUSTER_POLICY)
+                except Exception:
+                    crs = []
+                if crs:
+                    state = (crs[0].get("status") or {}).get("state", "unknown")
+                    log.info("policy %s state=%s (t=%.1fs)",
+                             crs[0]["metadata"]["name"], state,
+                             time.monotonic() - start)
+                    if args.once and state == "ready":
+                        log.info("reached ready in %.2fs — exiting (--once)",
+                                 time.monotonic() - start)
+                        return 0
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if stop:
+            stop.set()
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Cluster facts provider (controllers/clusterinfo/clusterinfo.go:42-454
+analog). The OpenShift-specific getters (RHCOS versions, DTK images, proxy)
+have no TPU/GKE analog and are dropped per SURVEY.md section 7; the TPU
+additions are topology/generation summaries used by the topology manager
+and the bench harness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from ..api import labels as L
+from ..runtime.client import Client
+from ..runtime.objects import get_nested, labels_of
+
+
+@dataclass
+class ClusterInfo:
+    client: Client
+
+    def get_kubernetes_version(self) -> str:
+        for node in self.client.list("v1", "Node"):
+            v = get_nested(node, "status", "nodeInfo", "kubeletVersion",
+                           default="")
+            if v:
+                return v
+        return "unknown"
+
+    def get_container_runtime(self) -> str:
+        for node in self.client.list("v1", "Node"):
+            rt = get_nested(node, "status", "nodeInfo",
+                            "containerRuntimeVersion", default="")
+            if rt:
+                return rt.split(":")[0]
+        return "containerd"
+
+    def get_kernel_versions(self) -> List[str]:
+        out = set()
+        for node in self.client.list("v1", "Node"):
+            kv = get_nested(node, "status", "nodeInfo", "kernelVersion",
+                            default="")
+            if kv:
+                out.add(kv)
+        return sorted(out)
+
+    def get_tpu_topologies(self) -> Dict[str, int]:
+        """topology string -> node count, across TPU nodes."""
+        out: Dict[str, int] = {}
+        for node in self.client.list("v1", "Node"):
+            nl = labels_of(node)
+            if L.GKE_TPU_ACCELERATOR not in nl:
+                continue
+            topo = nl.get(L.GKE_TPU_TOPOLOGY, "unknown")
+            out[topo] = out.get(topo, 0) + 1
+        return out
+
+    def get_tpu_generations(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for node in self.client.list("v1", "Node"):
+            nl = labels_of(node)
+            accel = nl.get(L.GKE_TPU_ACCELERATOR)
+            if not accel:
+                continue
+            gen = L.accelerator_generation(accel)
+            out[gen] = out.get(gen, 0) + 1
+        return out

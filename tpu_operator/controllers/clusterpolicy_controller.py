@@ -1,0 +1,155 @@
+"""TPUClusterPolicy reconciler — the main loop.
+
+Mirrors ClusterPolicyReconciler (controllers/clusterpolicy_controller.go:
+94-422): singleton enforcement (oldest CR wins, duplicates -> ``ignored``,
+:121-126), node labelling, state drive, coarse status + conditions, 5 s
+requeue while operands converge, 45 s poll while no TPU nodes exist.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable, Optional
+
+from ..api import conditions
+from ..api import labels as L
+from ..api.clusterpolicy import (
+    KIND_CLUSTER_POLICY,
+    STATE_IGNORED,
+    STATE_NOT_READY,
+    STATE_READY,
+    V1,
+    TPUClusterPolicySpec,
+)
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime import (
+    Controller,
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+    WatchEvent,
+    enqueue_owner,
+    generation_changed,
+    label_changed,
+)
+from ..runtime.objects import get_nested, name_of, set_nested
+from ..state.state import SyncStatus
+from .state_manager import StateManager
+
+log = logging.getLogger("tpu_operator.clusterpolicy")
+
+REQUEUE_NOT_READY_S = 5.0    # clusterpolicy_controller.go:165,193
+REQUEUE_NO_TPU_NODES_S = 45.0  # :199 (NFD-missing poll analog)
+
+
+class ClusterPolicyReconciler(Reconciler):
+    name = "tpuclusterpolicy"
+
+    def __init__(self, client, namespace: Optional[str] = None,
+                 state_manager: Optional[StateManager] = None):
+        self.client = client
+        self.namespace = namespace or os.environ.get(
+            "OPERATOR_NAMESPACE", "tpu-operator")
+        self.state_manager = state_manager or StateManager(
+            client=client, namespace=self.namespace)
+
+    # -- wiring (SetupWithManager analog, clusterpolicy_controller.go:355) --
+
+    def setup_controller(self, controller: Controller, manager: Manager):
+        controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed)
+        # node events: TPU labels appearing/changing re-trigger every policy
+        controller.watch(
+            "v1", "Node",
+            predicate=label_changed(L.GKE_TPU_ACCELERATOR, L.GKE_TPU_TOPOLOGY,
+                                    L.WORKLOAD_CONFIG, L.SLICE_CONFIG,
+                                    L.DEPLOY_PREFIX + "*"),
+            mapper=self._enqueue_all_policies)
+        # owned DaemonSets feed readiness back into the loop
+        controller.watch("apps/v1", "DaemonSet",
+                         mapper=enqueue_owner(V1, KIND_CLUSTER_POLICY))
+
+    def _enqueue_all_policies(self, event: WatchEvent) -> Iterable[Request]:
+        for cr in self.client.list(V1, KIND_CLUSTER_POLICY):
+            yield Request(name=name_of(cr))
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, request: Request) -> Result:
+        cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
+        if cr is None:
+            return Result()
+
+        # singleton: the oldest CR by (creationTimestamp, name) wins
+        all_crs = self.client.list(V1, KIND_CLUSTER_POLICY)
+        all_crs.sort(key=lambda c: (
+            get_nested(c, "metadata", "creationTimestamp", default=""),
+            name_of(c)))
+        if all_crs and name_of(all_crs[0]) != request.name:
+            self._set_state(cr, STATE_IGNORED)
+            conditions.set_error(
+                self.client, cr, "DuplicateResource",
+                f"only one {KIND_CLUSTER_POLICY} is allowed; "
+                f"{name_of(all_crs[0])!r} is active")
+            return Result()
+
+        spec = TPUClusterPolicySpec.from_obj(cr)
+
+        tpu_nodes = self.state_manager.label_tpu_nodes()
+        OPERATOR_METRICS.tpu_nodes.set(tpu_nodes)
+        if tpu_nodes == 0:
+            self._set_state(cr, STATE_NOT_READY)
+            conditions.set_not_ready(
+                self.client, cr, "NoTPUNodes",
+                "no nodes with cloud.google.com/gke-tpu-accelerator labels "
+                "or google.com/tpu capacity found")
+            OPERATOR_METRICS.reconcile_total.inc()
+            return Result(requeue_after=REQUEUE_NO_TPU_NODES_S)
+
+        extra = {"tpudriver_crd_mode": self._tpudriver_crd_mode()}
+        results = self.state_manager.sync(cr, spec, extra)
+
+        not_ready = {n: r for n, r in results.items() if not r.ready}
+        errors = {n: r for n, r in results.items()
+                  if r.status == SyncStatus.ERROR}
+        for state_name, r in results.items():
+            OPERATOR_METRICS.operand_ready.labels(state=state_name).set(
+                1 if r.ready else 0)
+        OPERATOR_METRICS.reconcile_total.inc()
+
+        if errors:
+            self._set_state(cr, STATE_NOT_READY)
+            conditions.set_error(
+                self.client, cr, conditions.REASON_ERROR,
+                "; ".join(f"{n}: {r.message}" for n, r in errors.items()))
+            OPERATOR_METRICS.reconcile_failures.inc()
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+        if not_ready:
+            self._set_state(cr, STATE_NOT_READY)
+            conditions.set_not_ready(
+                self.client, cr, conditions.REASON_OPERANDS_NOT_READY,
+                "; ".join(f"{n}: {r.message}" for n, r in not_ready.items()))
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+
+        self._set_state(cr, STATE_READY)
+        conditions.set_ready(self.client, cr,
+                             f"all {len(results)} states ready "
+                             f"on {tpu_nodes} TPU node(s)")
+        log.info("policy %s ready (%d states, %d TPU nodes)",
+                 request.name, len(results), tpu_nodes)
+        return Result()
+
+    def _tpudriver_crd_mode(self) -> bool:
+        """When TPUDriver CRs exist, they own driver rollout and the
+        policy's libtpu-driver state stands down (state_manager.go:951-961
+        skip-and-clean analog)."""
+        from ..api.tpudriver import KIND_TPU_DRIVER, V1ALPHA1
+        try:
+            return len(self.client.list(V1ALPHA1, KIND_TPU_DRIVER)) > 0
+        except Exception:
+            return False
+
+    def _set_state(self, cr: dict, state: str) -> None:
+        set_nested(cr, state, "status", "state")
+        set_nested(cr, self.namespace, "status", "namespace")

@@ -1,0 +1,123 @@
+"""Node discovery/labelling + ordered state drive.
+
+The ClusterPolicyController core (controllers/state_manager.go:143-1034
+analog): discovers TPU nodes from their GKE-provided labels (the role NFD
+labels play for the reference, labelGPUNodes :479-581), stamps per-state
+deploy labels routed by workload config (:86-111, :363-421), and drives the
+ordered operand states each reconcile (step() :941-979 — except that, like
+the reference, operand *startup* ordering is enforced on-node by the
+validation barrier, not by pausing the FSM between states).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import labels as L
+from ..api.clusterpolicy import TPUClusterPolicySpec
+from ..runtime.client import Client
+from ..runtime.objects import get_nested, labels_of, name_of
+from ..state.operands import build_states
+from ..state.state import State, SyncContext, SyncResult, SyncStatus
+
+log = logging.getLogger("tpu_operator.state_manager")
+
+
+def is_tpu_node(node: dict) -> bool:
+    """A node is a TPU node when GKE stamped an accelerator label on it or
+    it exposes google.com/tpu capacity (gpu-node detection analog,
+    state_manager.go hasGPULabels)."""
+    nl = labels_of(node)
+    if L.GKE_TPU_ACCELERATOR in nl:
+        return True
+    cap = get_nested(node, "status", "allocatable", default={}) or {}
+    return L.TPU_RESOURCE in cap
+
+
+def desired_node_labels(node: dict) -> Dict[str, Optional[str]]:
+    """Labels this operator wants on a TPU node; None means remove."""
+    nl = labels_of(node)
+    out: Dict[str, Optional[str]] = {}
+    if not is_tpu_node(node):
+        # strip everything we ever stamped (removeAllGPUStateLabels analog)
+        for k in list(nl):
+            if k.startswith(L.DEPLOY_PREFIX) or k in (
+                    L.TPU_PRESENT, L.TPU_GENERATION, L.TPU_CHIP_COUNT,
+                    L.WORKLOAD_CONFIG):
+                out[k] = None
+        return out
+    out[L.TPU_PRESENT] = "true"
+    accel = nl.get(L.GKE_TPU_ACCELERATOR, "")
+    if accel:
+        out[L.TPU_GENERATION] = L.accelerator_generation(accel)
+    chips = nl.get(L.GKE_ACCELERATOR_COUNT) or str(
+        get_nested(node, "status", "allocatable", L.TPU_RESOURCE, default="") or "")
+    if chips:
+        out[L.TPU_CHIP_COUNT] = chips
+    config = nl.get(L.WORKLOAD_CONFIG, "container")
+    if config not in L.WORKLOAD_STATE_SETS:
+        log.warning("node %s: unknown workload config %r, using 'container'",
+                    name_of(node), config)
+        config = "container"
+    wanted_states = set(L.WORKLOAD_STATE_SETS[config])
+    for state in set(L.CONTAINER_WORKLOAD_STATES) | set(L.ISOLATED_WORKLOAD_STATES):
+        key = L.deploy_label(state)
+        if state in wanted_states:
+            out[key] = "true"
+        elif key in nl:
+            out[key] = None
+    return out
+
+
+@dataclass
+class StateManager:
+    client: Client
+    namespace: str
+    states: List[State] = field(default_factory=build_states)
+
+    def label_tpu_nodes(self) -> int:
+        """Stamp discovery + deploy labels on every node; returns the TPU
+        node count (labelGPUNodes analog — one LIST + patches only for
+        drifted nodes)."""
+        count = 0
+        for node in self.client.list("v1", "Node"):
+            want = desired_node_labels(node)
+            if is_tpu_node(node):
+                count += 1
+            have = labels_of(node)
+            delta = {k: v for k, v in want.items() if have.get(k) != v
+                     and not (v is None and k not in have)}
+            if delta:
+                self.client.patch("v1", "Node", name_of(node),
+                                  {"metadata": {"labels": delta}})
+                log.info("labeled node %s: %s", name_of(node), delta)
+        return count
+
+    def detect_runtime(self) -> str:
+        """containerd/docker/cri-o from node status (getRuntime analog,
+        state_manager.go:714-751)."""
+        for node in self.client.list("v1", "Node"):
+            rt = get_nested(node, "status", "nodeInfo",
+                            "containerRuntimeVersion", default="")
+            if rt:
+                return rt.split(":")[0]
+        return "containerd"
+
+    def sync(self, policy: dict, spec: TPUClusterPolicySpec,
+             extra: Optional[dict] = None) -> Dict[str, SyncResult]:
+        """Drive every state once; returns per-state results (step() loop
+        analog, clusterpolicy_controller.go:155-179)."""
+        ctx = SyncContext(client=self.client, policy=policy, spec=spec,
+                          namespace=self.namespace,
+                          cluster={"runtime": self.detect_runtime()},
+                          extra=extra or {})
+        results: Dict[str, SyncResult] = {}
+        for state in self.states:
+            try:
+                results[state.name] = state.sync(ctx)
+            except Exception as e:  # a broken state must not wedge the rest
+                log.exception("state %s sync failed", state.name)
+                results[state.name] = SyncResult(SyncStatus.ERROR, str(e))
+        return results

@@ -1,0 +1,142 @@
+"""TPUDriver reconciler — per-node-pool libtpu rollout (engine B path).
+
+Mirrors NVIDIADriverReconciler (controllers/nvidiadriver_controller.go:
+75-408 + internal/state/driver.go:106-692): validates the CR against
+sibling CRs, partitions the CR's nodes into (generation x topology) pools,
+renders one driver DaemonSet per pool from the same manifest dir the
+ClusterPolicy state uses, cleans up stale pool DaemonSets, and reports
+aggregate readiness through status + conditions.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api import conditions
+from ..api import labels as L
+from ..api.clusterpolicy import (
+    KIND_CLUSTER_POLICY,
+    STATE_NOT_READY,
+    STATE_READY,
+    V1,
+    TPUClusterPolicySpec,
+)
+from ..api.tpudriver import KIND_TPU_DRIVER, V1ALPHA1, TPUDriverSpec
+from ..render import Renderer
+from ..runtime import (
+    Controller,
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+    enqueue_object,
+    enqueue_owner,
+    generation_changed,
+)
+from ..runtime.objects import name_of, set_nested
+from ..state.nodepool import get_node_pools
+from ..state.operands import MANIFESTS_ROOT, common_data, resolve_image
+from ..state.skel import apply_objects, objects_ready
+from ..state.state import SyncContext
+from .validation import ValidationError, validate_node_selectors
+
+log = logging.getLogger("tpu_operator.tpudriver")
+
+REQUEUE_NOT_READY_S = 5.0  # nvidiadriver_controller.go:175-206 analog
+
+
+class TPUDriverReconciler(Reconciler):
+    name = "tpudriver"
+
+    def __init__(self, client, namespace: str = "tpu-operator",
+                 manifests_root=None):
+        self.client = client
+        self.namespace = namespace
+        self.manifests_root = manifests_root or MANIFESTS_ROOT
+
+    def setup_controller(self, controller: Controller, manager: Manager):
+        controller.watch(V1ALPHA1, KIND_TPU_DRIVER,
+                         predicate=generation_changed)
+        controller.watch("apps/v1", "DaemonSet",
+                         mapper=enqueue_owner(V1ALPHA1, KIND_TPU_DRIVER))
+
+    def _state_label(self, cr_name: str) -> str:
+        return f"tpu-driver-{cr_name}"
+
+    def reconcile(self, request: Request) -> Result:
+        cr = self.client.get_or_none(V1ALPHA1, KIND_TPU_DRIVER, request.name)
+        if cr is None:
+            # deleted: owned DaemonSets go with it via ownerRef GC
+            return Result()
+
+        # a ClusterPolicy must exist to supply stack-wide defaults
+        # (nvidiadriver_controller.go:80-125)
+        policies = self.client.list(V1, KIND_CLUSTER_POLICY)
+        if not policies:
+            conditions.set_error(self.client, cr, "MissingClusterPolicy",
+                                 "no TPUClusterPolicy found; create one first")
+            set_nested(cr, STATE_NOT_READY, "status", "state")
+            self.client.update_status(cr)
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+        policy_spec = TPUClusterPolicySpec.from_obj(policies[0])
+
+        try:
+            validate_node_selectors(self.client, cr)
+        except ValidationError as e:
+            conditions.set_error(self.client, cr, "Conflict", str(e))
+            set_nested(cr, STATE_NOT_READY, "status", "state")
+            self.client.update_status(cr)
+            return Result()  # user must fix the CR; no requeue loop
+
+        spec = TPUDriverSpec.from_obj(cr)
+        nodes = self.client.list("v1", "Node")
+        pools = get_node_pools(nodes, restrict=spec.node_selector)
+
+        ctx = SyncContext(client=self.client, policy=cr, spec=policy_spec,
+                          namespace=self.namespace)
+        renderer = Renderer(self.manifests_root / "state-libtpu-driver")
+        desired = []
+        for pool in pools:
+            data = common_data(ctx, policy_spec.libtpu, "libtpu-driver",
+                               "libtpu-installer")
+            data["Image"] = resolve_image("libtpu-driver", spec,
+                                          "libtpu-installer")
+            data["UpdateStrategy"] = "OnDelete"
+            data["InstallDir"] = spec.install_dir or "/home/kubernetes/bin"
+            data["Channel"] = spec.channel or "stable"
+            data["Name"] = f"tpu-libtpu-driver-{pool.name}"
+            data["NodeSelector"] = {data["DeployLabel"]: "true",
+                                    **pool.selector}
+            desired.extend(renderer.render_objects(data))
+
+        state_label = self._state_label(request.name)
+        applied = apply_objects(self.client, cr, state_label, desired,
+                                self.namespace)
+        if not pools:
+            conditions.set_not_ready(self.client, cr, "NoMatchingNodes",
+                                     "nodeSelector matches no TPU nodes")
+            set_nested(cr, STATE_NOT_READY, "status", "state")
+            self.client.update_status(cr)
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+
+        ok, msg = objects_ready(self.client, applied)
+        if not ok:
+            set_nested(cr, STATE_NOT_READY, "status", "state")
+            self.client.update_status(cr)
+            conditions.set_not_ready(
+                self.client,
+                self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),
+                conditions.REASON_OPERANDS_NOT_READY, msg)
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+
+        set_nested(cr, STATE_READY, "status", "state")
+        self.client.update_status(cr)
+        conditions.set_ready(
+            self.client,
+            self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),
+            f"libtpu ready on {len(pools)} pool(s): "
+            + ", ".join(p.name for p in pools))
+        log.info("TPUDriver %s ready across pools %s", request.name,
+                 [p.name for p in pools])
+        return Result()

@@ -1,0 +1,265 @@
+"""Rolling libtpu upgrade orchestration.
+
+The UpgradeReconciler analog (controllers/upgrade_controller.go:81-353 +
+the vendored NVIDIA/k8s-operator-libs/pkg/upgrade state machine): because
+driver DaemonSets roll with ``OnDelete``, nothing upgrades until this
+controller walks each node through a safety FSM persisted in the
+``tpu.graft.dev/upgrade.state`` node label:
+
+    upgrade-required -> cordon-required -> drain-required ->
+    pod-restart-required -> validation-required -> uncordon-required -> done
+
+Concurrency is bounded by upgradePolicy.maxParallelUpgrades; TPU-consuming
+pods are evicted during drain unless they carry the skip-drain label
+(upgrade_controller.go:127-187 semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..api import labels as L
+from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, TPUClusterPolicySpec
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime import (
+    Controller,
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+    WatchEvent,
+    any_event,
+    generation_changed,
+)
+from ..runtime.client import ListOptions, NotFoundError
+from ..runtime.objects import get_nested, labels_of, name_of, namespace_of
+from ..utils.hash import object_hash
+
+log = logging.getLogger("tpu_operator.upgrade")
+
+REQUEUE_PERIODIC_S = 120.0  # upgrade_controller.go:59,197
+REQUEUE_ACTIVE_S = 5.0
+
+STATE_DONE = "done"
+STATE_UPGRADE_REQUIRED = "upgrade-required"
+STATE_CORDON = "cordon-required"
+STATE_DRAIN = "drain-required"
+STATE_POD_RESTART = "pod-restart-required"
+STATE_VALIDATION = "validation-required"
+STATE_UNCORDON = "uncordon-required"
+STATE_FAILED = "failed"
+
+# states that count against the parallel-upgrade budget
+IN_PROGRESS_STATES = {STATE_CORDON, STATE_DRAIN, STATE_POD_RESTART,
+                      STATE_VALIDATION, STATE_UNCORDON}
+
+
+def desired_revision(client, ds: dict) -> str:
+    """Current pod-template revision for a DaemonSet: the newest owned
+    ControllerRevision when the control plane maintains them, else a local
+    template hash (which is exactly what the fake kubelet stamps)."""
+    try:
+        revs = [r for r in client.list("apps/v1", "ControllerRevision",
+                                       ListOptions(namespace=namespace_of(ds)))
+                if any(ref.get("uid") == get_nested(ds, "metadata", "uid")
+                       for ref in get_nested(r, "metadata", "ownerReferences",
+                                             default=[]) or [])]
+    except NotFoundError:
+        revs = []
+    if revs:
+        newest = max(revs, key=lambda r: r.get("revision", 0))
+        return get_nested(newest, "metadata", "labels",
+                          "controller-revision-hash",
+                          default=name_of(newest).rsplit("-", 1)[-1])
+    return object_hash(get_nested(ds, "spec", "template", default={}))
+
+
+class UpgradeReconciler(Reconciler):
+    name = "tpu-upgrade"
+
+    def __init__(self, client, namespace: str = "tpu-operator"):
+        self.client = client
+        self.namespace = namespace
+
+    def setup_controller(self, controller: Controller, manager: Manager):
+        controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed,
+                         mapper=self._enqueue_policy)
+        controller.watch("apps/v1", "DaemonSet", predicate=any_event,
+                         mapper=self._enqueue_policy)
+
+    def _enqueue_policy(self, event: WatchEvent):
+        for cr in self.client.list(V1, KIND_CLUSTER_POLICY):
+            yield Request(name=name_of(cr))
+
+    # -- helpers -----------------------------------------------------------
+
+    def _driver_daemonsets(self) -> List[dict]:
+        return self.client.list(
+            "apps/v1", "DaemonSet",
+            ListOptions(namespace=self.namespace,
+                        label_selector={"tpu.graft.dev/component":
+                                        "libtpu-driver"}))
+
+    def _driver_pod_on(self, node_name: str) -> Optional[dict]:
+        for pod in self.client.list(
+                "v1", "Pod",
+                ListOptions(namespace=self.namespace,
+                            label_selector={"tpu.graft.dev/component":
+                                            "libtpu-driver"})):
+            if get_nested(pod, "spec", "nodeName") == node_name:
+                return pod
+        return None
+
+    def _tpu_workload_pods_on(self, node_name: str) -> List[dict]:
+        """Pods consuming google.com/tpu on the node — the drain set
+        (the reference drains with a GPU-pod selector, main.go:105-117)."""
+        out = []
+        for pod in self.client.list("v1", "Pod"):
+            if get_nested(pod, "spec", "nodeName") != node_name:
+                continue
+            if labels_of(pod).get(L.UPGRADE_SKIP_DRAIN) == "true":
+                continue
+            if labels_of(pod).get("tpu.graft.dev/component") == "libtpu-driver":
+                continue
+            # daemon pods are not drained (kubectl drain --ignore-daemonsets)
+            owners = get_nested(pod, "metadata", "ownerReferences",
+                                default=[]) or []
+            if any(o.get("kind") == "DaemonSet" for o in owners):
+                continue
+            requests = {}
+            for ctr in get_nested(pod, "spec", "containers", default=[]) or []:
+                requests.update(get_nested(ctr, "resources", "requests",
+                                           default={}) or {})
+            if L.TPU_RESOURCE in requests:
+                out.append(pod)
+        return out
+
+    def _set_node_state(self, node: dict, state: Optional[str]) -> None:
+        self.client.patch("v1", "Node", name_of(node),
+                          {"metadata": {"labels": {L.UPGRADE_STATE: state}}})
+
+    def _cordon(self, node: dict, on: bool) -> None:
+        self.client.patch("v1", "Node", name_of(node),
+                          {"spec": {"unschedulable": True if on else None}})
+
+    def remove_upgrade_state_labels(self) -> None:
+        """Auto-upgrade disabled: strip FSM labels
+        (removeNodeUpgradeStateLabels analog, upgrade_controller.go:103-121)."""
+        for node in self.client.list("v1", "Node"):
+            if L.UPGRADE_STATE in labels_of(node):
+                self._set_node_state(node, None)
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, request: Request) -> Result:
+        cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
+        if cr is None:
+            return Result()
+        spec = TPUClusterPolicySpec.from_obj(cr)
+        policy = spec.upgrade_policy
+        if not policy.auto_upgrade:
+            self.remove_upgrade_state_labels()
+            return Result()
+
+        daemonsets = self._driver_daemonsets()
+        if not daemonsets:
+            return Result(requeue_after=REQUEUE_PERIODIC_S)
+
+        # classify every node that runs (or should run) a driver pod
+        node_states: Dict[str, str] = {}
+        nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
+        revisions = {name_of(ds): desired_revision(self.client, ds)
+                     for ds in daemonsets}
+        in_progress = sum(
+            1 for n in nodes.values()
+            if labels_of(n).get(L.UPGRADE_STATE) in IN_PROGRESS_STATES)
+        budget = max(1, policy.max_parallel_upgrades or 1)
+
+        for node_name, node in sorted(nodes.items()):
+            pod = self._driver_pod_on(node_name)
+            if pod is None:
+                continue
+            ds_name = next((o.get("name") for o in
+                            get_nested(pod, "metadata", "ownerReferences",
+                                       default=[]) or []
+                            if o.get("kind") == "DaemonSet"), None)
+            want = revisions.get(ds_name)
+            have = labels_of(pod).get("controller-revision-hash")
+            state = labels_of(node).get(L.UPGRADE_STATE)
+            pod_ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                            for c in get_nested(pod, "status", "conditions",
+                                                default=[]) or [])
+
+            if want is None:
+                continue
+            if have == want and state in (None, STATE_DONE):
+                if state != STATE_DONE and state is not None:
+                    self._set_node_state(node, STATE_DONE)
+                node_states[node_name] = STATE_DONE
+                continue
+
+            # FSM advance (multiple safe steps per pass)
+            if state in (None, STATE_DONE) and have != want:
+                state = STATE_UPGRADE_REQUIRED
+                self._set_node_state(node, state)
+            if state == STATE_UPGRADE_REQUIRED:
+                if in_progress >= budget:
+                    node_states[node_name] = state
+                    continue
+                in_progress += 1
+                state = STATE_CORDON
+                self._set_node_state(node, state)
+            if state == STATE_CORDON:
+                self._cordon(node, True)
+                state = STATE_DRAIN
+                self._set_node_state(node, state)
+            if state == STATE_DRAIN:
+                victims = (self._tpu_workload_pods_on(node_name)
+                           if policy.drain_enable in (None, True) else [])
+                for v in victims:
+                    try:
+                        self.client.delete("v1", "Pod", name_of(v),
+                                           namespace_of(v) or None)
+                        log.info("drained pod %s/%s from %s",
+                                 namespace_of(v), name_of(v), node_name)
+                    except NotFoundError:
+                        pass
+                state = STATE_POD_RESTART
+                self._set_node_state(node, state)
+            if state == STATE_POD_RESTART:
+                try:
+                    self.client.delete("v1", "Pod", name_of(pod),
+                                       namespace_of(pod) or None)
+                    log.info("restarting driver pod on %s", node_name)
+                except NotFoundError:
+                    pass
+                state = STATE_VALIDATION
+                self._set_node_state(node, state)
+                node_states[node_name] = state
+                continue  # must wait for kubelet to recreate
+            if state == STATE_VALIDATION:
+                if have == want and pod_ready:
+                    state = STATE_UNCORDON
+                    self._set_node_state(node, state)
+                else:
+                    node_states[node_name] = state
+                    continue
+            if state == STATE_UNCORDON:
+                self._cordon(node, False)
+                self._set_node_state(node, STATE_DONE)
+                OPERATOR_METRICS.driver_upgrades_done.inc()
+                log.info("node %s upgrade complete", node_name)
+                node_states[node_name] = STATE_DONE
+                continue
+            node_states[node_name] = state or STATE_DONE
+
+        pending = [n for n, s in node_states.items() if s != STATE_DONE]
+        OPERATOR_METRICS.driver_upgrades_in_progress.set(
+            sum(1 for s in node_states.values() if s in IN_PROGRESS_STATES))
+        OPERATOR_METRICS.driver_upgrades_pending.set(
+            sum(1 for s in node_states.values()
+                if s == STATE_UPGRADE_REQUIRED))
+        if pending:
+            return Result(requeue_after=REQUEUE_ACTIVE_S)
+        return Result(requeue_after=REQUEUE_PERIODIC_S)

@@ -1,0 +1,48 @@
+"""Operator-level Prometheus metrics.
+
+The reference exposes 17 series (controllers/operator_metrics.go:29-201);
+this is the TPU rename of the set that applies (driver-toolkit/OpenShift
+series have no analog and are dropped per SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge
+
+from .registry import REGISTRY
+
+
+class OperatorMetrics:
+    def __init__(self, registry=REGISTRY):
+        g = lambda name, doc, **kw: Gauge(name, doc, registry=registry, **kw)
+        c = lambda name, doc, **kw: Counter(name, doc, registry=registry, **kw)
+        self.reconcile_total = c(
+            "tpu_operator_reconciliation_total",
+            "Total TPUClusterPolicy reconciliations")
+        self.reconcile_failures = c(
+            "tpu_operator_reconciliation_failed_total",
+            "Reconciliations that ended in error")
+        self.reconcile_status = g(
+            "tpu_operator_reconciliation_status",
+            "1 when the last reconciliation reached all-ready")
+        self.tpu_nodes = g(
+            "tpu_operator_tpu_nodes_total",
+            "Nodes detected as TPU nodes")
+        self.operand_ready = g(
+            "tpu_operator_operand_ready",
+            "Per-state readiness (1 ready / 0 not)", labelnames=("state",))
+        self.driver_upgrades_in_progress = g(
+            "tpu_operator_driver_upgrades_in_progress",
+            "Nodes currently upgrading libtpu")
+        self.driver_upgrades_done = c(
+            "tpu_operator_driver_upgrades_done_total",
+            "Completed per-node libtpu upgrades")
+        self.driver_upgrades_failed = c(
+            "tpu_operator_driver_upgrades_failed_total",
+            "Failed per-node libtpu upgrades")
+        self.driver_upgrades_pending = g(
+            "tpu_operator_driver_upgrades_pending",
+            "Nodes waiting for libtpu upgrade")
+
+
+OPERATOR_METRICS = OperatorMetrics()

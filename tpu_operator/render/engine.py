@@ -1,0 +1,404 @@
+"""Minimal go-template-compatible rendering engine.
+
+The reference renders operand manifests with text/template + sprig
+(internal/render/render.go:64-151, option missingkey=error, custom funcs
+``yaml`` and ``deref``). This engine implements the subset those manifests
+actually use, with the same strictness: referencing a missing key is an
+error, not an empty string — template bugs must fail loudly at render
+time, not produce subtly-wrong YAML.
+
+Supported syntax:
+
+- ``{{ .Path.To.Field }}`` — dot navigation on the render data
+- ``{{ if EXPR }} … {{ else if EXPR }} … {{ else }} … {{ end }}``
+- ``{{ range .List }} … {{ end }}`` — ``.`` rebinds to the element,
+  ``$`` always refers to the root data
+- pipelines: ``{{ .X | quote | indent 4 }}``
+- function call form: ``{{ default "v" .X }}``, ``{{ eq .A "b" }}``
+- functions: quote, squote, upper, lower, title, trim, join, split,
+  default, indent, nindent, toYaml, fromYaml, deref, eq, ne, lt, gt,
+  and, or, not, len, contains, hasPrefix, hasSuffix, replace, int, toString
+- comments ``{{/* … */}}`` and whitespace trimming ``{{-`` / ``-}}``
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional
+
+import yaml
+
+
+class TemplateError(Exception):
+    pass
+
+
+class MissingKeyError(TemplateError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer: split into text and {{ action }} chunks, honoring {{- and -}}
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-)?\s*(.*?)\s*(-)?\}\}", re.DOTALL)
+
+
+def _lex(source: str) -> List[tuple]:
+    """Yields ("text", str) and ("action", str) chunks."""
+    chunks: List[tuple] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(source):
+        text = source[pos:m.start()]
+        if m.group(1):  # {{- trims preceding whitespace
+            text = text.rstrip(" \t\n\r")
+        if text:
+            chunks.append(("text", text))
+        body = m.group(2)
+        if not body.startswith("/*"):
+            chunks.append(("action", body))
+        pos = m.end()
+        if m.group(3):  # -}} trims following whitespace
+            rest = source[pos:]
+            trimmed = rest.lstrip(" \t\n\r")
+            pos += len(rest) - len(trimmed)
+    tail = source[pos:]
+    if tail:
+        chunks.append(("text", tail))
+    return chunks
+
+
+# ---------------------------------------------------------------------------
+# Parser: nest if/range blocks
+# ---------------------------------------------------------------------------
+
+
+class _Node:
+    pass
+
+
+class _Text(_Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class _Expr(_Node):
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class _If(_Node):
+    def __init__(self):
+        # list of (condition_expr | None for else, body nodes)
+        self.branches: List[tuple] = []
+
+
+class _Range(_Node):
+    def __init__(self, expr):
+        self.expr = expr
+        self.body: List[_Node] = []
+
+
+def _parse(chunks: List[tuple]) -> List[_Node]:
+    root: List[_Node] = []
+    # stack of (container_list, open_node)
+    stack: List[tuple] = [(root, None)]
+
+    def top() -> List[_Node]:
+        node = stack[-1][1]
+        if isinstance(node, _If):
+            return node.branches[-1][1]
+        if isinstance(node, _Range):
+            return node.body
+        return stack[-1][0]
+
+    for kind, val in chunks:
+        if kind == "text":
+            top().append(_Text(val))
+            continue
+        stripped = val.strip()
+        if stripped.startswith("if "):
+            node = _If()
+            node.branches.append((stripped[3:].strip(), []))
+            top().append(node)
+            stack.append(([], node))
+        elif stripped.startswith("range "):
+            node = _Range(stripped[6:].strip())
+            top().append(node)
+            stack.append(([], node))
+        elif stripped == "else" or stripped.startswith("else if "):
+            node = stack[-1][1]
+            if not isinstance(node, _If):
+                raise TemplateError("'else' outside of if block")
+            cond = stripped[8:].strip() if stripped.startswith("else if ") else None
+            node.branches.append((cond, []))
+        elif stripped == "end":
+            if len(stack) == 1:
+                raise TemplateError("unbalanced 'end'")
+            stack.pop()
+        else:
+            top().append(_Expr(stripped))
+    if len(stack) != 1:
+        raise TemplateError("unclosed if/range block")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    "(?:[^"\\]|\\.)*"        # double-quoted string
+  | '(?:[^'\\]|\\.)*'        # single-quoted string
+  | -?\d+\.\d+               # float
+  | -?\d+                    # int
+  | \$\.?[A-Za-z0-9_.]*      # $ root ref
+  | \.[A-Za-z0-9_.]*         # dot path
+  | [A-Za-z_][A-Za-z0-9_]*   # identifier
+  | \(|\)|\|
+""", re.VERBOSE)
+
+
+def _tokenize_expr(expr: str) -> List[str]:
+    tokens = []
+    pos = 0
+    while pos < len(expr):
+        if expr[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(expr, pos)
+        if not m:
+            raise TemplateError(f"bad token at {expr[pos:]!r}")
+        tokens.append(m.group(0))
+        pos = m.end()
+    return tokens
+
+
+def _truthy(v: Any) -> bool:
+    """Go template truthiness: nil, zero, empty string/list/map are false."""
+    if v is None:
+        return False
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v != 0
+    return True
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _indent(n: Any, s: Any) -> str:
+    pad = " " * int(n)
+    return "\n".join(pad + line for line in str(s).split("\n"))
+
+
+BUILTINS: dict[str, Callable] = {
+    "quote": lambda v: '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"',
+    "squote": lambda v: "'" + str(v).replace("'", "''") + "'",
+    "upper": lambda v: str(v).upper(),
+    "lower": lambda v: str(v).lower(),
+    "title": lambda v: str(v).title(),
+    "trim": lambda v: str(v).strip(),
+    "join": lambda sep, lst: str(sep).join(str(x) for x in lst),
+    "split": lambda sep, v: str(v).split(str(sep)),
+    "default": lambda dflt, v=None: v if _truthy(v) else dflt,
+    "indent": _indent,
+    "nindent": lambda n, s: "\n" + _indent(n, s),
+    "toYaml": _to_yaml,
+    "yaml": _to_yaml,  # reference's custom func name (render.go)
+    "fromYaml": lambda s: yaml.safe_load(s),
+    "deref": lambda v: v,  # pointers don't exist here; identity for parity
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "and": lambda *vs: vs[-1] if all(_truthy(v) for v in vs) else next(
+        (v for v in vs if not _truthy(v)), False),
+    "or": lambda *vs: next((v for v in vs if _truthy(v)), vs[-1] if vs else None),
+    "not": lambda v: not _truthy(v),
+    "len": lambda v: len(v),
+    "contains": lambda needle, hay: str(needle) in str(hay),
+    "hasPrefix": lambda p, s: str(s).startswith(str(p)),
+    "hasSuffix": lambda p, s: str(s).endswith(str(p)),
+    "replace": lambda old, new, s: str(s).replace(str(old), str(new)),
+    "int": lambda v: int(v),
+    "toString": lambda v: str(v),
+    "printf": lambda fmt, *a: str(fmt) % tuple(a),
+    "ternary": lambda t, f, c: t if _truthy(c) else f,
+}
+
+
+class _Scope:
+    def __init__(self, root: Any, dot: Any):
+        self.root = root
+        self.dot = dot
+
+    def resolve_path(self, token: str) -> Any:
+        if token.startswith("$"):
+            base = self.root
+            path = token[1:].lstrip(".")
+        else:
+            base = self.dot
+            path = token[1:]  # strip leading '.'
+        if not path:
+            return base
+        cur = base
+        for part in path.split("."):
+            if isinstance(cur, dict):
+                if part not in cur:
+                    raise MissingKeyError(
+                        f"map has no entry for key {part!r} (in {token})")
+                cur = cur[part]
+            elif hasattr(cur, part):
+                cur = getattr(cur, part)
+            else:
+                raise MissingKeyError(f"cannot access {part!r} (in {token})")
+        return cur
+
+
+def _eval_expr(expr: str, scope: _Scope) -> Any:
+    tokens = _tokenize_expr(expr)
+    # split on top-level pipes
+    stages: List[List[str]] = [[]]
+    depth = 0
+    for t in tokens:
+        if t == "(":
+            depth += 1
+        elif t == ")":
+            depth -= 1
+        if t == "|" and depth == 0:
+            stages.append([])
+        else:
+            stages[-1].append(t)
+    value: Any = None
+    have_value = False
+    for stage in stages:
+        if not stage:
+            raise TemplateError(f"empty pipeline stage in {expr!r}")
+        args = stage + ([] if not have_value else [])
+        result, _ = _eval_call(args, 0, scope,
+                               piped=value if have_value else _NO_PIPE)
+        value = result
+        have_value = True
+    return value
+
+
+_NO_PIPE = object()
+
+
+def _eval_atom(tokens: List[str], i: int, scope: _Scope):
+    t = tokens[i]
+    if t == "(":
+        result, j = _eval_call(tokens, i + 1, scope, piped=_NO_PIPE,
+                               until_paren=True)
+        return result, j
+    if t.startswith('"') or t.startswith("'"):
+        body = t[1:-1]
+        return body.encode().decode("unicode_escape"), i + 1
+    if re.fullmatch(r"-?\d+", t):
+        return int(t), i + 1
+    if re.fullmatch(r"-?\d+\.\d+", t):
+        return float(t), i + 1
+    if t.startswith(".") or t.startswith("$"):
+        return scope.resolve_path(t), i + 1
+    if t == "true":
+        return True, i + 1
+    if t == "false":
+        return False, i + 1
+    if t in ("nil", "null"):
+        return None, i + 1
+    if t in BUILTINS:
+        # zero-arg function used as a value — evaluate greedily below
+        raise TemplateError(f"function {t!r} needs call context")
+    raise TemplateError(f"unknown token {t!r}")
+
+
+def _eval_call(tokens: List[str], i: int, scope: _Scope, piped: Any,
+               until_paren: bool = False):
+    """Evaluate ``fn arg arg …`` or a single atom, with optional piped arg
+    appended (go pipeline semantics)."""
+    if i >= len(tokens):
+        raise TemplateError("empty expression")
+    t = tokens[i]
+    if t in BUILTINS:
+        fn = BUILTINS[t]
+        args = []
+        j = i + 1
+        while j < len(tokens) and tokens[j] != "|":
+            if tokens[j] == ")":
+                if until_paren:
+                    j += 1
+                break
+            val, j = _eval_atom(tokens, j, scope)
+            args.append(val)
+        if piped is not _NO_PIPE:
+            args.append(piped)
+        return fn(*args), j
+    # plain atom (possibly with piped value -> error unless it's a call)
+    val, j = _eval_atom(tokens, i, scope)
+    if until_paren:
+        if j < len(tokens) and tokens[j] == ")":
+            j += 1
+    if piped is not _NO_PIPE:
+        raise TemplateError(
+            f"cannot pipe into non-function {t!r}")
+    if j < len(tokens) and not until_paren and tokens[j] != "|":
+        raise TemplateError(f"unexpected token {tokens[j]!r}")
+    return val, j
+
+
+# ---------------------------------------------------------------------------
+# Renderer
+# ---------------------------------------------------------------------------
+
+
+def _render_nodes(nodes: List[_Node], scope: _Scope, out: List[str]) -> None:
+    for node in nodes:
+        if isinstance(node, _Text):
+            out.append(node.s)
+        elif isinstance(node, _Expr):
+            val = _eval_expr(node.expr, scope)
+            if val is None:
+                val = ""
+            elif isinstance(val, bool):
+                val = "true" if val else "false"
+            out.append(str(val))
+        elif isinstance(node, _If):
+            for cond, body in node.branches:
+                if cond is None or _truthy(_eval_expr(cond, scope)):
+                    _render_nodes(body, scope, out)
+                    break
+        elif isinstance(node, _Range):
+            coll = _eval_expr(node.expr, scope)
+            if coll is None:
+                continue
+            items = coll.items() if isinstance(coll, dict) else coll
+            for item in items:
+                _render_nodes(node.body, _Scope(scope.root, item), out)
+
+
+class Template:
+    def __init__(self, source: str, name: str = "<template>"):
+        self.name = name
+        try:
+            self.nodes = _parse(_lex(source))
+        except TemplateError as e:
+            raise TemplateError(f"{name}: {e}") from e
+
+    def render(self, data: Any) -> str:
+        out: List[str] = []
+        try:
+            _render_nodes(self.nodes, _Scope(data, data), out)
+        except TemplateError as e:
+            raise type(e)(f"{self.name}: {e}") from e
+        return "".join(out)
+
+
+def render_string(source: str, data: Any, name: str = "<template>") -> str:
+    return Template(source, name).render(data)

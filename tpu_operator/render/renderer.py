@@ -1,0 +1,52 @@
+"""Manifest directory renderer (internal/render/render.go:64-151 analog).
+
+Renders every ``*.yaml`` template under a state's manifest directory with a
+render-data mapping, then parses the output into unstructured objects.
+Files are rendered in lexical order — manifests are numbered
+(0100_service_account.yaml … 0500_daemonset.yaml) so ordering is the
+deployment order, exactly like the reference's asset layout.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, List
+
+import yaml
+
+from .engine import Template, TemplateError
+
+
+class Renderer:
+    def __init__(self, manifests_dir: str | pathlib.Path):
+        self.dir = pathlib.Path(manifests_dir)
+        if not self.dir.is_dir():
+            raise FileNotFoundError(f"manifest dir {self.dir} does not exist")
+        self._templates = [
+            (p.name, Template(p.read_text(), name=str(p)))
+            for p in sorted(self.dir.glob("*.yaml"))
+        ]
+        if not self._templates:
+            raise FileNotFoundError(f"no *.yaml templates under {self.dir}")
+
+    def render_objects(self, data: Any) -> List[dict]:
+        """Render all templates -> list of parsed objects (empty docs are
+        dropped, multi-doc files are split)."""
+        objects: List[dict] = []
+        for name, tmpl in self._templates:
+            text = tmpl.render(data)
+            try:
+                docs = list(yaml.safe_load_all(text))
+            except yaml.YAMLError as e:
+                raise TemplateError(
+                    f"{self.dir / name}: rendered invalid YAML: {e}\n"
+                    f"--- rendered ---\n{text}") from e
+            for doc in docs:
+                if not doc:
+                    continue
+                if "kind" not in doc or "apiVersion" not in doc:
+                    raise TemplateError(
+                        f"{self.dir / name}: rendered object missing "
+                        f"kind/apiVersion")
+                objects.append(doc)
+        return objects

@@ -127,11 +127,16 @@ class FakeClient(Client):
             meta = obj.setdefault("metadata", {})
             meta["uid"] = get_nested(cur, "metadata", "uid")
             meta["creationTimestamp"] = get_nested(cur, "metadata", "creationTimestamp")
+            cur_gen = get_nested(cur, "metadata", "generation", default=1) or 1
+            meta["resourceVersion"] = actual
+            meta["generation"] = cur_gen
+            # no-op writes don't bump the RV or emit events (real apiserver
+            # semantics; prevents self-sustaining reconcile storms)
+            if obj == cur:
+                return deepcopy_obj(cur)
             meta["resourceVersion"] = self._next_rv()
             if obj.get("spec") != cur.get("spec"):
-                meta["generation"] = (get_nested(cur, "metadata", "generation", default=1) or 1) + 1
-            else:
-                meta["generation"] = get_nested(cur, "metadata", "generation", default=1)
+                meta["generation"] = cur_gen + 1
             self._store[key] = obj
         self._publish("MODIFIED", obj)
         return deepcopy_obj(obj)
@@ -143,8 +148,11 @@ class FakeClient(Client):
             cur = self._store.get(key)
             if cur is None:
                 raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            new_status = deepcopy_obj(obj.get("status") or {})
+            if (cur.get("status") or {}) == new_status:
+                return deepcopy_obj(cur)  # no-op: no RV bump, no event
             cur = deepcopy_obj(cur)
-            cur["status"] = deepcopy_obj(obj.get("status") or {})
+            cur["status"] = new_status
             cur["metadata"]["resourceVersion"] = self._next_rv()
             self._store[key] = cur
         self._publish("MODIFIED", cur)
@@ -157,6 +165,8 @@ class FakeClient(Client):
             if cur is None:
                 raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
             merged = merge_patch(deepcopy_obj(cur), patch)
+            if merged == cur:
+                return deepcopy_obj(cur)  # no-op patch
             merged["metadata"]["resourceVersion"] = self._next_rv()
             if merged.get("spec") != cur.get("spec"):
                 merged["metadata"]["generation"] = (
@@ -241,55 +251,81 @@ class FakeClient(Client):
     def simulate_kubelet(self, ready: bool = True, stale_hash: bool = False) -> None:
         """Advance every DaemonSet's status as a scheduler+kubelet would.
 
-        ``ready=True`` marks all scheduled pods available; ``stale_hash=True``
-        leaves pods labeled with an outdated controller-revision-hash, which
-        the OnDelete readiness check must treat as not-ready (mirrors
-        object_controls.go:3526-3602 semantics).
+        Update-strategy-faithful: under ``OnDelete`` an existing pod keeps
+        its controller-revision-hash label until something deletes it (only
+        then does the recreated pod pick up the current template revision);
+        under ``RollingUpdate`` pods move to the current revision on the
+        next tick. ``updatedNumberScheduled`` is computed from actual pod
+        hashes — this is what the OnDelete readiness check and the upgrade
+        controller's per-node FSM key off (object_controls.go:3526-3602
+        semantics).
+
+        ``ready=True`` marks scheduled pods available; ``stale_hash=True``
+        forces pods onto a fake outdated revision.
         """
         for ds in self.list("apps/v1", "DaemonSet"):
+            # NB: DaemonSet pods tolerate the unschedulable taint, so cordoned
+            # nodes still receive daemon pods — required for driver-pod
+            # restarts during cordon+drain upgrades.
             nodes = self._ds_scheduled_nodes(ds)
             desired = len(nodes)
             revision = object_hash(get_nested(ds, "spec", "template", default={}))
-            pod_hash = "stale" if stale_hash else revision
+            on_delete = get_nested(ds, "spec", "updateStrategy", "type",
+                                   default="RollingUpdate") == "OnDelete"
             ns = namespace_of(ds) or "default"
             tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
                                      default={}) or {}
+            updated = 0
+            n_ready = 0
+            base_hash = "stale" if stale_hash else revision
+            phase = "Running" if ready else "Pending"
+            ready_conds = [{"type": "Ready",
+                            "status": "True" if ready else "False"}]
             for node in nodes:
                 pod_name = f"{name_of(ds)}-{name_of(node)}"
-                pod = {
-                    "apiVersion": "v1",
-                    "kind": "Pod",
-                    "metadata": {
-                        "name": pod_name,
-                        "namespace": ns,
-                        "labels": {**tmpl_labels,
-                                   "controller-revision-hash": pod_hash},
-                        "ownerReferences": [{
-                            "apiVersion": "apps/v1", "kind": "DaemonSet",
-                            "name": name_of(ds),
-                            "uid": get_nested(ds, "metadata", "uid"),
-                            "controller": True,
-                        }],
-                    },
-                    "spec": {"nodeName": name_of(node)},
-                    "status": {"phase": "Running" if ready else "Pending",
-                               "conditions": [{"type": "Ready",
-                                               "status": "True" if ready else "False"}]},
-                }
                 existing = self.get_or_none("v1", "Pod", pod_name, ns)
-                if existing is None:
-                    self.create(pod)
-                else:
-                    existing.update({k: pod[k] for k in ("spec", "status")})
-                    set_nested(existing, pod["metadata"]["labels"], "metadata", "labels")
+                if existing is not None:
+                    # OnDelete: the pod keeps its revision until deleted
+                    pod_hash = (get_nested(existing, "metadata", "labels",
+                                           "controller-revision-hash")
+                                if on_delete and not stale_hash else base_hash)
+                    existing["metadata"]["labels"] = {
+                        **tmpl_labels, "controller-revision-hash": pod_hash}
+                    set_nested(existing, phase, "status", "phase")
+                    set_nested(existing, ready_conds, "status", "conditions")
                     self.update(existing)
+                else:
+                    pod_hash = base_hash
+                    self.create({
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {
+                            "name": pod_name,
+                            "namespace": ns,
+                            "labels": {**tmpl_labels,
+                                       "controller-revision-hash": pod_hash},
+                            "ownerReferences": [{
+                                "apiVersion": "apps/v1", "kind": "DaemonSet",
+                                "name": name_of(ds),
+                                "uid": get_nested(ds, "metadata", "uid"),
+                                "controller": True,
+                            }],
+                        },
+                        "spec": {"nodeName": name_of(node)},
+                        "status": {"phase": phase,
+                                   "conditions": list(ready_conds)},
+                    })
+                if pod_hash == revision:
+                    updated += 1
+                if ready:
+                    n_ready += 1
             status = {
                 "desiredNumberScheduled": desired,
                 "currentNumberScheduled": desired,
                 "numberMisscheduled": 0,
-                "numberReady": desired if ready else 0,
-                "numberAvailable": desired if ready else 0,
-                "updatedNumberScheduled": desired if not stale_hash else 0,
+                "numberReady": n_ready,
+                "numberAvailable": n_ready,
+                "updatedNumberScheduled": updated,
                 "observedGeneration": get_nested(ds, "metadata", "generation", default=1),
             }
             ds["status"] = status

@@ -1,0 +1,9 @@
+from .operands import OperandState, build_states  # noqa: F401
+from .skel import (  # noqa: F401
+    apply_objects,
+    daemonset_ready,
+    delete_state_objects,
+    deployment_ready,
+    objects_ready,
+)
+from .state import State, SyncContext, SyncResult, SyncStatus  # noqa: F401

@@ -1,0 +1,87 @@
+"""TPU node-pool partitioning.
+
+The reference partitions driver rollout by OS/kernel/rhcos
+(internal/state/nodepool.go:55-132) because kernel modules are
+kernel-specific. The TPU partition key is different — SURVEY.md section 7
+flags this as genuinely new design: libtpu builds are keyed by **TPU
+generation x topology**, and multi-host slices additionally need *grouped*
+treatment (all hosts of one slice run the same libtpu and upgrade
+together).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import labels as L
+from ..runtime.objects import labels_of, match_labels, name_of
+
+_SAFE = re.compile(r"[^a-z0-9-]+")
+
+
+def sanitize(s: str) -> str:
+    return _SAFE.sub("-", s.lower()).strip("-")
+
+
+@dataclass
+class NodePool:
+    """One (accelerator, topology) group of TPU nodes."""
+
+    accelerator: str          # e.g. tpu-v5p-slice
+    topology: str             # e.g. 2x2x1
+    nodes: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        gen = L.accelerator_generation(self.accelerator) or "tpu"
+        topo = sanitize(self.topology) or "any"
+        return f"{gen}-{topo}"
+
+    @property
+    def selector(self) -> Dict[str, str]:
+        sel = {}
+        if self.accelerator:
+            sel[L.GKE_TPU_ACCELERATOR] = self.accelerator
+        if self.topology:
+            sel[L.GKE_TPU_TOPOLOGY] = self.topology
+        return sel
+
+    @property
+    def multi_host(self) -> bool:
+        """True when the slice topology spans more than one host. A single
+        v4/v5p host carries at most 4 chips (8 cores), so any topology with
+        more than 4 chips is multi-host; v5e/v6e hosts carry up to 8."""
+        dims = [int(d) for d in re.findall(r"\d+", self.topology or "")]
+        if not dims:
+            return False
+        chips = 1
+        for d in dims:
+            chips *= d
+        per_host = 8 if L.accelerator_generation(self.accelerator) in (
+            "v5e", "v6e") else 4
+        return chips > per_host
+
+
+def get_node_pools(nodes: List[dict],
+                   restrict: Optional[Dict[str, str]] = None) -> List[NodePool]:
+    """Partition TPU nodes into pools (getNodePools analog). ``restrict``
+    is a CR-level nodeSelector limiting which nodes participate."""
+    pools: Dict[tuple, NodePool] = {}
+    for node in nodes:
+        nl = labels_of(node)
+        if L.GKE_TPU_ACCELERATOR not in nl:
+            continue
+        if restrict and not match_labels(nl, restrict):
+            continue
+        key = (nl.get(L.GKE_TPU_ACCELERATOR, ""),
+               nl.get(L.GKE_TPU_TOPOLOGY, ""))
+        pool = pools.setdefault(key, NodePool(accelerator=key[0],
+                                              topology=key[1]))
+        pool.nodes.append(name_of(node))
+    out = list(pools.values())
+    out.sort(key=lambda p: p.name)
+    for p in out:
+        p.nodes.sort()
+    return out

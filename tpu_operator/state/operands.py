@@ -1,0 +1,237 @@
+"""The TPU operand states and their render data.
+
+Maps the reference's operand set (controllers/state_manager.go:791-810
+registration order, SURVEY.md section 2.2) onto the TPU stack:
+
+| order | state                  | reference slot                    |
+|-------|------------------------|-----------------------------------|
+| 1     | pre-requisites         | pre-requisites (RuntimeClasses)   |
+| 2     | operator-metrics       | state-operator-metrics            |
+| 3     | libtpu-driver          | state-driver (kernel driver)      |
+| 4     | tpu-runtime            | state-container-toolkit           |
+| 5     | operator-validation    | state-operator-validation         |
+| 6     | tpu-device-plugin      | state-device-plugin               |
+| 7     | metrics-exporter       | state-dcgm + state-dcgm-exporter  |
+| 8     | node-status-exporter   | state-node-status-exporter        |
+| 9     | topology-manager       | state-mig-manager                 |
+
+Sandbox/vGPU/kata/CC states have no TPU analog (SURVEY.md section 7:
+documented out of scope).
+
+Each state renders ``manifests/state-<name>/*.yaml`` with data built here,
+applies via the skel, and reports readiness. Per-node deploy labels
+(tpu.graft.dev/deploy.<state>) select which nodes run which operand — the
+node-labelling side lives in controllers/state_manager.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Callable, List, Optional
+
+from .. import __version__
+from ..api.clusterpolicy import ComponentSpec
+from ..api.image import image_path
+from ..api.labels import deploy_label
+from ..render import Renderer
+from .skel import apply_objects, delete_state_objects, objects_ready
+from .state import State, SyncContext, SyncResult, SyncStatus
+
+MANIFESTS_ROOT = pathlib.Path(__file__).resolve().parents[2] / "manifests"
+
+DEFAULT_REPOSITORY = "ghcr.io/tpu-operator"
+DEFAULT_VERSION = f"v{__version__}"
+
+# GKE TPU nodes carry this taint; every operand must tolerate it.
+DEFAULT_TOLERATIONS = [
+    {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"},
+    {"key": "node-role.kubernetes.io/master", "operator": "Exists",
+     "effect": "NoSchedule"},
+]
+
+
+def resolve_image(component: str, comp: Optional[ComponentSpec],
+                  default_image: str) -> str:
+    """spec fields -> $<COMPONENT>_IMAGE env -> built-in default."""
+    try:
+        return image_path(component,
+                          comp.repository if comp else None,
+                          comp.image if comp else None,
+                          comp.version if comp else None)
+    except ValueError:
+        return f"{DEFAULT_REPOSITORY}/{default_image}:{DEFAULT_VERSION}"
+
+
+def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
+                state: str, default_image: str) -> dict:
+    ds = ctx.spec.daemonsets
+    hp = ctx.spec.host_paths
+    validator = ctx.spec.validator
+    return {
+        "Namespace": ctx.namespace,
+        "StateName": state,
+        "DeployLabel": deploy_label(state),
+        "Image": resolve_image(state, comp, default_image),
+        "ImagePullPolicy": (comp.image_pull_policy if comp else None)
+        or "IfNotPresent",
+        "ImagePullSecrets": (comp.image_pull_secrets if comp else None) or [],
+        "PriorityClassName": ds.priority_class_name or "system-node-critical",
+        "Tolerations": (ds.tolerations or []) + DEFAULT_TOLERATIONS,
+        "UpdateStrategy": ds.update_strategy or "RollingUpdate",
+        "MaxUnavailable": ds.rolling_update_max_unavailable or "1",
+        "CommonLabels": ds.labels or {},
+        "Env": (comp.env if comp else None) or [],
+        "Args": (comp.args if comp else None) or [],
+        "Resources": comp.resources if comp else None,
+        "RuntimeClass": ctx.spec.operator.runtime_class or "tpu",
+        "ValidatorImage": resolve_image("operator-validation",
+                                        validator, "tpu-validator"),
+        "HostPaths": {
+            "RootFS": hp.root_fs or "/",
+            "ValidationDir": hp.validation_dir or "/run/tpu/validations",
+            "DevDir": hp.dev_dir or "/dev",
+        },
+    }
+
+
+class OperandState(State):
+    """A state fully described by (manifest dir, data builder, enable flag)."""
+
+    def __init__(self, name: str, description: str,
+                 data_fn: Callable[[SyncContext], dict],
+                 enabled_fn: Optional[Callable[[SyncContext], bool]] = None,
+                 manifests_root: Optional[pathlib.Path] = None):
+        self.name = name
+        self.description = description
+        self._data_fn = data_fn
+        self._enabled_fn = enabled_fn
+        self._root = manifests_root or MANIFESTS_ROOT
+
+    def enabled(self, ctx: SyncContext) -> bool:
+        return self._enabled_fn(ctx) if self._enabled_fn else True
+
+    def renderer(self) -> Renderer:
+        return Renderer(self._root / f"state-{self.name}")
+
+    def sync(self, ctx: SyncContext) -> SyncResult:
+        if not self.enabled(ctx):
+            delete_state_objects(ctx.client, self.name)
+            return SyncResult(SyncStatus.DISABLED, "disabled by spec")
+        objects = self.renderer().render_objects(self._data_fn(ctx))
+        applied = apply_objects(ctx.client, ctx.policy, self.name, objects,
+                                ctx.namespace)
+        ok, msg = objects_ready(ctx.client, applied)
+        return SyncResult(SyncStatus.READY if ok else SyncStatus.NOT_READY, msg)
+
+
+# ---------------------------------------------------------------------------
+# Per-state render data
+# ---------------------------------------------------------------------------
+
+
+def _prerequisites_data(ctx: SyncContext) -> dict:
+    return common_data(ctx, None, "pre-requisites", "tpu-operator")
+
+
+def _operator_metrics_data(ctx: SyncContext) -> dict:
+    data = common_data(ctx, None, "operator-metrics", "tpu-operator")
+    data["MetricsPort"] = 8080
+    return data
+
+
+def _libtpu_driver_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.libtpu
+    data = common_data(ctx, spec, "libtpu-driver", "libtpu-installer")
+    # driver replacement must never roll automatically across all nodes:
+    # OnDelete + the upgrade controller owns the rollout
+    # (SURVEY.md section 7 hard parts; object_controls.go:3545 analog)
+    data["UpdateStrategy"] = "OnDelete"
+    data["InstallDir"] = spec.install_dir or "/home/kubernetes/bin"
+    data["Channel"] = spec.channel or "stable"
+    # the TPUDriver controller re-renders this template per node pool with
+    # its own Name/NodeSelector (internal/state/driver.go:211 analog)
+    data["Name"] = "tpu-libtpu-driver-daemonset"
+    data["NodeSelector"] = {data["DeployLabel"]: "true"}
+    return data
+
+
+def _tpu_runtime_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.tpu_runtime
+    data = common_data(ctx, spec, "tpu-runtime", "tpu-runtime")
+    data["DevicePathGlob"] = spec.device_path_glob or "/dev/accel*"
+    return data
+
+
+def _validation_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.validator
+    data = common_data(ctx, spec, "operator-validation", "tpu-validator")
+    data["MatmulSize"] = spec.matmul_size or 4096
+    data["IciThreshold"] = spec.ici_bandwidth_threshold or 0.8
+    data["RuntimeEnabled"] = ctx.spec.tpu_runtime.is_enabled()
+    data["PluginEnabled"] = ctx.spec.device_plugin.is_enabled()
+    return data
+
+
+def _device_plugin_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.device_plugin
+    data = common_data(ctx, spec, "tpu-device-plugin", "tpu-device-plugin")
+    data["ResourceName"] = spec.resource_name or "google.com/tpu"
+    data["SharingPolicy"] = spec.sharing_policy or "exclusive"
+    return data
+
+
+def _metrics_exporter_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.metrics_exporter
+    data = common_data(ctx, spec, "metrics-exporter", "libtpu-metrics-exporter")
+    data["Port"] = spec.port or 9400
+    data["Interval"] = spec.collection_interval_seconds or 15
+    data["ServiceMonitor"] = bool(spec.service_monitor)
+    return data
+
+
+def _node_status_exporter_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.node_status_exporter
+    data = common_data(ctx, spec, "node-status-exporter", "tpu-validator")
+    data["Port"] = spec.port or 9401
+    return data
+
+
+def _topology_manager_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.topology_manager
+    data = common_data(ctx, spec, "topology-manager", "tpu-topology-manager")
+    data["ConfigMapName"] = spec.config_map or "default-slice-config"
+    data["DefaultProfile"] = spec.default_profile or "full"
+    return data
+
+
+def build_states(manifests_root: Optional[pathlib.Path] = None) -> List[State]:
+    """Ordered state list (addState x9; state_manager.go:791-810 analog)."""
+    mk = lambda *a, **kw: OperandState(*a, manifests_root=manifests_root, **kw)
+    return [
+        mk("pre-requisites", "RuntimeClass registration",
+           _prerequisites_data),
+        mk("operator-metrics", "operator metrics Service",
+           _operator_metrics_data),
+        mk("libtpu-driver", "libtpu install on TPU nodes",
+           _libtpu_driver_data,
+           enabled_fn=lambda ctx: ctx.spec.libtpu.is_enabled()
+           and not ctx.extra.get("tpudriver_crd_mode", False)),
+        mk("tpu-runtime", "TPU device/runtime hookup",
+           _tpu_runtime_data,
+           enabled_fn=lambda ctx: ctx.spec.tpu_runtime.is_enabled()),
+        mk("operator-validation", "per-node validation gate",
+           _validation_data,
+           enabled_fn=lambda ctx: ctx.spec.validator.is_enabled()),
+        mk("tpu-device-plugin", "google.com/tpu device plugin",
+           _device_plugin_data,
+           enabled_fn=lambda ctx: ctx.spec.device_plugin.is_enabled()),
+        mk("metrics-exporter", "libtpu metrics exporter",
+           _metrics_exporter_data,
+           enabled_fn=lambda ctx: ctx.spec.metrics_exporter.is_enabled()),
+        mk("node-status-exporter", "validation status metrics",
+           _node_status_exporter_data,
+           enabled_fn=lambda ctx: ctx.spec.node_status_exporter.is_enabled()),
+        mk("topology-manager", "TPU slice shaping",
+           _topology_manager_data,
+           enabled_fn=lambda ctx: ctx.spec.topology_manager.is_enabled()),
+    ]

@@ -1,0 +1,169 @@
+"""Apply/readiness skeleton shared by all states.
+
+The stateSkel core of the reference's engine B
+(internal/state/state_skel.go:223-285 createOrUpdateObjs,
+:313-342 label-based stale deletion, :383-444 readiness), keeping the two
+hard-won behaviors SURVEY.md section 7 calls out:
+
+- **hash-skip updates**: every applied object carries an annotation with a
+  hash of its desired spec; an unchanged hash skips the update entirely
+  (object_controls.go:4303-4346 analog). Without this, every reconcile
+  rewrites every DaemonSet and churns pods.
+- **update-strategy-aware readiness**: a DaemonSet is ready only when the
+  apiserver has observed its latest generation and all scheduled pods are
+  both available and on the current revision (updatedNumberScheduled);
+  this is what makes OnDelete driver-style operands safe
+  (object_controls.go:3526-3602 analog).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List, Optional, Tuple
+
+from ..api.labels import LAST_APPLIED_HASH, STATE_LABEL
+from ..runtime.client import Client, ListOptions, NotFoundError
+from ..runtime.objects import (
+    annotations_of,
+    get_nested,
+    name_of,
+    namespace_of,
+    set_annotation,
+    set_label,
+    set_owner_reference,
+)
+from ..utils.hash import object_hash
+
+log = logging.getLogger("tpu_operator.state")
+
+
+def apply_objects(client: Client, owner: Optional[dict], state_name: str,
+                  objects: Iterable[dict], namespace: str) -> List[dict]:
+    """Create-or-update the desired objects for a state; returns the live
+    objects. Also deletes stale objects still labeled for this state but no
+    longer desired (cleanupStale analog)."""
+    applied: List[dict] = []
+    desired_keys = set()
+    for obj in objects:
+        from ..runtime.objects import is_namespaced
+        if is_namespaced(obj.get("kind", "")):
+            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+        set_label(obj, STATE_LABEL, state_name)
+        if owner is not None:
+            set_owner_reference(obj, owner)
+        desired_hash = object_hash(
+            {k: v for k, v in obj.items() if k != "status"})
+        set_annotation(obj, LAST_APPLIED_HASH, desired_hash)
+        desired_keys.add((obj.get("apiVersion", ""), obj.get("kind", ""),
+                          namespace_of(obj), name_of(obj)))
+        existing = client.get_or_none(obj.get("apiVersion", ""),
+                                      obj.get("kind", ""), name_of(obj),
+                                      namespace_of(obj) or None)
+        if existing is None:
+            applied.append(client.create(obj))
+            log.info("[%s] created %s/%s", state_name, obj["kind"], name_of(obj))
+            continue
+        if annotations_of(existing).get(LAST_APPLIED_HASH) == desired_hash:
+            applied.append(existing)  # hash-skip
+            continue
+        merged = dict(obj)
+        merged.setdefault("metadata", {})
+        merged["metadata"]["resourceVersion"] = get_nested(
+            existing, "metadata", "resourceVersion")
+        if "status" in existing:
+            merged["status"] = existing["status"]
+        applied.append(client.update(merged))
+        log.info("[%s] updated %s/%s", state_name, obj["kind"], name_of(obj))
+    _delete_stale(client, state_name, desired_keys, namespace)
+    return applied
+
+
+def _delete_stale(client: Client, state_name: str, desired_keys: set,
+                  namespace: str) -> None:
+    """Delete objects labeled for this state that are no longer rendered
+    (state_skel.go:313-342 handleStateObjectsDeletion analog)."""
+    for api_version, kind in (("apps/v1", "DaemonSet"),
+                              ("v1", "Service"),
+                              ("v1", "ConfigMap"),
+                              ("node.k8s.io/v1", "RuntimeClass")):
+        try:
+            stale = client.list(api_version, kind, ListOptions(
+                label_selector={STATE_LABEL: state_name}))
+        except NotFoundError:
+            continue
+        for obj in stale:
+            key = (api_version, kind, namespace_of(obj), name_of(obj))
+            if key in desired_keys:
+                continue
+            try:
+                client.delete(api_version, kind, name_of(obj),
+                              namespace_of(obj) or None)
+                log.info("[%s] deleted stale %s/%s", state_name, kind,
+                         name_of(obj))
+            except NotFoundError:
+                pass
+
+
+def delete_state_objects(client: Client, state_name: str) -> None:
+    """Remove everything a state ever applied (used when a state flips to
+    disabled — the reference deletes on disable too,
+    object_controls.go:4167-4174)."""
+    _delete_stale(client, state_name, set(), "")
+
+
+def daemonset_ready(ds: dict) -> Tuple[bool, str]:
+    """Update-strategy-aware DaemonSet readiness.
+
+    desired==0 counts as ready: no matching nodes means nothing to prove
+    (matches isDaemonSetReady's treatment; stale-DS cleanup is a separate
+    concern handled by node pools)."""
+    status = ds.get("status") or {}
+    gen = get_nested(ds, "metadata", "generation", default=1)
+    if status.get("observedGeneration", 0) < gen:
+        return False, "generation not observed"
+    desired = status.get("desiredNumberScheduled", 0)
+    if desired == 0:
+        return True, "no nodes scheduled"
+    if status.get("numberAvailable", 0) != desired:
+        return False, (f"{status.get('numberAvailable', 0)}/{desired} "
+                       f"pods available")
+    if status.get("updatedNumberScheduled", 0) != desired:
+        # pods still on an old revision — critical for OnDelete operands
+        return False, (f"{status.get('updatedNumberScheduled', 0)}/{desired} "
+                       f"pods on current revision")
+    return True, "ready"
+
+
+def deployment_ready(dep: dict) -> Tuple[bool, str]:
+    status = dep.get("status") or {}
+    gen = get_nested(dep, "metadata", "generation", default=1)
+    if status.get("observedGeneration", 0) < gen:
+        return False, "generation not observed"
+    want = get_nested(dep, "spec", "replicas", default=1)
+    if status.get("availableReplicas", 0) != want:
+        return False, f"{status.get('availableReplicas', 0)}/{want} replicas"
+    return True, "ready"
+
+
+def objects_ready(client: Client, objects: Iterable[dict]) -> Tuple[bool, str]:
+    """Aggregate readiness over applied objects (getSyncState analog,
+    state_skel.go:383-444): workload kinds gate, config kinds are ready on
+    existence."""
+    for obj in objects:
+        kind = obj.get("kind", "")
+        live = client.get_or_none(obj.get("apiVersion", ""), kind,
+                                  name_of(obj), namespace_of(obj) or None)
+        if live is None:
+            return False, f"{kind}/{name_of(obj)} missing"
+        if kind == "DaemonSet":
+            ok, msg = daemonset_ready(live)
+        elif kind == "Deployment":
+            ok, msg = deployment_ready(live)
+        elif kind == "Pod":
+            ok = get_nested(live, "status", "phase") in ("Running", "Succeeded")
+            msg = get_nested(live, "status", "phase", default="Unknown")
+        else:
+            continue
+        if not ok:
+            return False, f"{kind}/{name_of(obj)}: {msg}"
+    return True, "all objects ready"
