@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Headline benchmark for the TPU-native operator framework.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What is measured (BASELINE.md targets):
+
+- multi-chip hosts: the validator's ICI psum allreduce, reported as the
+  fraction of the chip's published aggregate ICI bandwidth actually
+  achieved. Baseline bar: 0.80 (">=80% of ICI link bandwidth").
+- single-chip hosts (this harness: one tunneled chip): the validator's
+  bf16 matmul proof, reported as the fraction of the chip's published
+  peak bf16 TFLOP/s sustained on the MXU. The same 0.80 bar is applied.
+
+vs_baseline = value / 0.80, so >1.0 beats the target.
+
+The reference itself publishes no numbers (SURVEY.md section 6) — its
+workload proof (CUDA vectorAdd) measures nothing; this framework's proof
+doubles as a roofline benchmark.
+
+Details (device kind, absolute TFLOP/s / GB/s, timings) go to stderr.
+"""
+
+import json
+import sys
+
+BASELINE_FRACTION = 0.80
+
+
+def main() -> int:
+    import jax
+
+    from tpu_operator.workloads import collectives, hardware, matmul
+
+    platform, n_devices, kind, spec = hardware.detect()
+    print(f"# platform={platform} devices={n_devices} kind={kind!r} "
+          f"spec={spec}", file=sys.stderr)
+
+    if n_devices > 1:
+        res = collectives.run(size_mb=256.0, iters=10, repeats=3)
+        print(f"# allreduce: {res}", file=sys.stderr)
+        value = res.fraction_of_peak
+        if value is None:  # unknown chip: report absolute bus bandwidth
+            print(json.dumps({
+                "metric": "validator_ici_allreduce_bus_bandwidth",
+                "value": round(res.bus_bw_gbps, 2), "unit": "GB/s",
+                "vs_baseline": 0.0}))
+            return 0
+        print(json.dumps({
+            "metric": "validator_ici_allreduce_fraction_of_peak",
+            "value": round(value, 4), "unit": "fraction_of_ici_peak",
+            "vs_baseline": round(value / BASELINE_FRACTION, 4)}))
+        return 0
+
+    # single chip: MXU utilization headline
+    size = 8192 if (spec is None or spec.hbm_gb >= 8) else 4096
+    res = matmul.run(size=size, iters=32, calls=8, repeats=3)
+    print(f"# matmul: {res}", file=sys.stderr)
+    if res.utilization is not None:
+        print(json.dumps({
+            "metric": "validator_matmul_mxu_utilization",
+            "value": round(res.utilization, 4),
+            "unit": "fraction_of_peak_bf16",
+            "vs_baseline": round(res.utilization / BASELINE_FRACTION, 4)}))
+    else:
+        print(json.dumps({
+            "metric": "validator_matmul_throughput",
+            "value": round(res.tflops, 2), "unit": "TFLOP/s",
+            "vs_baseline": 0.0}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

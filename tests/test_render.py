@@ -69,6 +69,12 @@ class TestEngine:
         assert render_string("{{ if not .A }}y{{ else }}n{{ end }}",
                              {"A": ""}) == "y"
 
+    def test_pipe_inside_parens(self):
+        # regression: pipes nested in parens must apply, not silently drop
+        assert render_string('{{ (.X | quote) }}', {"X": "a: b"}) == '"a: b"'
+        assert render_string('{{ default (.X | upper) .Y }}',
+                             {"X": "fb", "Y": None}) == "FB"
+
     def test_parens(self):
         t = '{{ if and (eq .A 1) (not .B) }}y{{ else }}n{{ end }}'
         assert render_string(t, {"A": 1, "B": False}) == "y"

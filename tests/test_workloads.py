@@ -1,0 +1,102 @@
+"""JAX workloads on the virtual 8-device CPU mesh: mesh shaping, matmul,
+allreduce, sharded burn-in training step."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_operator.parallel.mesh import (
+    build_mesh,
+    factor_axes,
+    parse_topology,
+    ring_mesh,
+)
+from tpu_operator.workloads import collectives, matmul
+from tpu_operator.workloads.burnin import (
+    BurninConfig,
+    forward,
+    init_params,
+    make_batch,
+    make_train_step,
+    run as burnin_run,
+)
+from tpu_operator.workloads.hardware import chip_spec_for
+
+
+class TestMesh:
+    def test_parse_topology(self):
+        assert parse_topology("2x2x1") == (2, 2, 1)
+        assert parse_topology("16x16") == (16, 16)
+        assert parse_topology("") == (1,)
+
+    def test_factor_axes(self):
+        assert factor_axes(8) == (4, 2)
+        assert factor_axes(8, model_parallel=4) == (2, 4)
+        assert factor_axes(1) == (1, 1)
+        with pytest.raises(ValueError):
+            factor_axes(8, model_parallel=3)
+
+    def test_build_mesh_axes(self):
+        mesh = build_mesh()
+        assert mesh.axis_names == ("data", "model")
+        assert mesh.devices.size == 8
+
+    def test_ring_mesh(self):
+        assert ring_mesh().devices.shape == (8,)
+
+
+class TestHardware:
+    def test_chip_spec_mapping(self):
+        assert chip_spec_for("TPU v5 lite").generation == "v5e"
+        assert chip_spec_for("TPU v5p chip").generation == "v5p"
+        assert chip_spec_for("TPU v4").generation == "v4"
+        assert chip_spec_for("cpu") is None
+
+
+class TestMatmul:
+    def test_small_matmul_runs(self):
+        res = matmul.run(size=64, iters=4, calls=2, repeats=1)
+        assert res.checksum_ok
+        assert res.tflops > 0
+        assert res.utilization is None  # cpu has no ChipSpec
+
+
+class TestCollectives:
+    def test_allreduce_correct_on_mesh(self):
+        res = collectives.run(size_mb=1.0, iters=2, repeats=1)
+        assert res.devices == 8
+        assert res.correct
+        assert res.bus_bw_gbps > 0
+
+
+class TestBurnin:
+    CFG = BurninConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, seq_len=16, batch=8)
+
+    def test_forward_shape(self):
+        params = init_params(self.CFG, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, self.CFG.seq_len), dtype=jnp.int32)
+        logits = forward(params, tokens, self.CFG)
+        assert logits.shape == (2, self.CFG.seq_len, self.CFG.vocab)
+
+    def test_loss_falls_on_sharded_mesh(self):
+        first, last = burnin_run(self.CFG, steps=8)
+        assert last < first
+
+    def test_gradients_flow_through_all_shards(self):
+        mesh = build_mesh()  # 4x2
+        step, init_state, _ = make_train_step(mesh, self.CFG)
+        state = init_state(jax.random.PRNGKey(0))
+        batch = make_batch(self.CFG, mesh, jax.random.PRNGKey(1))
+        new_state, loss = step(state, batch)
+        assert bool(jnp.isfinite(loss))
+        # every parameter moved (grads were nonzero through tp shards)
+        before = init_state(jax.random.PRNGKey(0))["params"]
+        moved = jax.tree.map(
+            lambda a, b: bool(jnp.any(a != b)), before,
+            new_state["params"])
+        assert all(jax.tree.leaves(moved))
+
+    def test_explicit_model_parallel_dim(self):
+        first, last = burnin_run(self.CFG, steps=3, model_parallel=4)
+        assert last < first

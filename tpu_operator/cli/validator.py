@@ -1,0 +1,120 @@
+"""tpu-validator entrypoint (validator/main.go:226-596 analog).
+
+Usage:
+    tpu-validator -c driver|runtime|jax|ici|plugin|metrics|sleep
+    tpu-validator wait <status-file>     # initContainer gate primitive
+    tpu-validator cleanup                # preStop barrier teardown
+
+Flags mirror to env vars the way the reference's urfave/cli flags do
+(WITH_WAIT, NODE_NAME, OPERATOR_NAMESPACE, MATMUL_SIZE, ICI_THRESHOLD,
+TPU_VALIDATION_DIR, METRICS_PORT).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-validator",
+                                description="per-node TPU stack validator")
+    sub = p.add_subparsers(dest="cmd")
+    p.add_argument("-c", "--component", default=None,
+                   choices=["driver", "runtime", "jax", "ici", "plugin",
+                            "metrics", "sleep"])
+    p.add_argument("--pod-mode", action="store_true",
+                   help="jax/plugin: spawn a workload pod via the apiserver "
+                        "instead of running in-process")
+    p.add_argument("--with-wait", action="store_true",
+                   default=os.environ.get("WITH_WAIT", "").lower() == "true",
+                   help="block until prerequisite gates pass instead of "
+                        "failing")
+    wait = sub.add_parser("wait", help="block until a status file exists")
+    wait.add_argument("status_file")
+    wait.add_argument("--timeout", type=float, default=300.0)
+    sub.add_parser("cleanup", help="remove all validation status files")
+    return p
+
+
+def _client_and_identity():
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    node = os.environ.get("NODE_NAME", "")
+    ns = os.environ.get("OPERATOR_NAMESPACE", "tpu-operator")
+    image = os.environ.get("VALIDATOR_IMAGE",
+                           "ghcr.io/tpu-operator/tpu-validator:latest")
+    return HTTPClient(KubeConfig.load()), node, ns, image
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+    log = logging.getLogger("tpu_validator")
+
+    from ..validator import barrier, components
+
+    if args.cmd == "wait":
+        ok = barrier.wait_for(args.status_file, timeout=args.timeout)
+        if not ok:
+            log.error("timed out waiting for %s", args.status_file)
+            return 1
+        return 0
+    if args.cmd == "cleanup":
+        components.component_cleanup()
+        return 0
+
+    comp = args.component
+    if not comp:
+        build_parser().print_help()
+        return 2
+
+    retry = barrier.RETRY_INTERVAL_S
+    while True:
+        try:
+            if comp == "driver":
+                info = components.validate_driver()
+            elif comp == "runtime":
+                info = components.validate_runtime()
+            elif comp == "jax":
+                if args.pod_mode:
+                    from ..validator.workload import validate_jax_pod
+
+                    client, node, ns, image = _client_and_identity()
+                    info = validate_jax_pod(client, node, ns, image)
+                else:
+                    info = components.validate_jax()
+            elif comp == "ici":
+                info = components.validate_ici()
+            elif comp == "plugin":
+                from ..validator.workload import validate_plugin
+
+                client, node, ns, image = _client_and_identity()
+                info = validate_plugin(client, node, ns, image)
+            elif comp == "metrics":
+                from ..validator.metrics import serve
+
+                port = int(os.environ.get("METRICS_PORT", "9401"))
+                serve(port, node_name=os.environ.get("NODE_NAME", ""))
+                log.info("node metrics exporter on :%d", port)
+                while True:
+                    time.sleep(3600)
+            elif comp == "sleep":
+                components.component_sleep()
+            log.info("%s validation OK: %s", comp, info)
+            return 0
+        except components.ValidationFailed as e:
+            log.error("%s validation failed: %s", comp, e)
+            if not args.with_wait:
+                return 1
+            time.sleep(retry)
+        except KeyboardInterrupt:
+            return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Device-mesh construction + sharding helpers.
+
+The framework's collective workloads (ICI validator, burn-in step) are
+written SPMD-first: pick a Mesh, annotate shardings, let XLA insert the
+collectives over ICI (the scaling-book recipe). This module owns mesh
+shaping: factoring a device count into (data, model) axes and honoring the
+physical topology label (cloud.google.com/gke-tpu-topology) when present.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """'2x2x1' -> (2, 2, 1)."""
+    dims = tuple(int(d) for d in re.findall(r"\d+", topology or ""))
+    return dims or (1,)
+
+
+def factor_axes(n: int, model_parallel: Optional[int] = None) -> Tuple[int, int]:
+    """Split n devices into (data, model). When unspecified, model gets the
+    largest power-of-two factor <= sqrt(n) so both axes stay useful."""
+    if model_parallel:
+        if n % model_parallel:
+            raise ValueError(f"{n} devices not divisible by "
+                             f"model_parallel={model_parallel}")
+        return n // model_parallel, model_parallel
+    model = 1
+    while model * 2 <= int(math.isqrt(n)) and n % (model * 2) == 0:
+        model *= 2
+    return n // model, model
+
+
+def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               model_parallel: Optional[int] = None,
+               axis_names: Tuple[str, str] = ("data", "model")) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, mp = factor_axes(len(devices), model_parallel)
+    arr = np.array(devices).reshape(dp, mp)
+    return Mesh(arr, axis_names)
+
+
+def ring_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              axis_name: str = "ring") -> Mesh:
+    """1D mesh over all devices — the allreduce-bandwidth shape."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

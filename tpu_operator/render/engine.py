@@ -262,9 +262,18 @@ class _Scope:
         return cur
 
 
+_NO_PIPE = object()
+
+
 def _eval_expr(expr: str, scope: _Scope) -> Any:
-    tokens = _tokenize_expr(expr)
-    # split on top-level pipes
+    return _eval_tokens(_tokenize_expr(expr), scope)
+
+
+def _eval_tokens(tokens: List[str], scope: _Scope) -> Any:
+    """Full pipeline evaluation of a token list: split on top-level pipes,
+    evaluate each stage as ``fn arg arg…`` with the previous stage's value
+    appended (go pipeline semantics). Used both for whole {{ actions }} and
+    for parenthesized groups, so pipes nest correctly inside parens."""
     stages: List[List[str]] = [[]]
     depth = 0
     for t in tokens:
@@ -280,24 +289,31 @@ def _eval_expr(expr: str, scope: _Scope) -> Any:
     have_value = False
     for stage in stages:
         if not stage:
-            raise TemplateError(f"empty pipeline stage in {expr!r}")
-        args = stage + ([] if not have_value else [])
-        result, _ = _eval_call(args, 0, scope,
-                               piped=value if have_value else _NO_PIPE)
-        value = result
+            raise TemplateError(f"empty pipeline stage in {tokens!r}")
+        value = _eval_stage(stage, scope,
+                            piped=value if have_value else _NO_PIPE)
         have_value = True
     return value
 
 
-_NO_PIPE = object()
+def _matching_paren(tokens: List[str], i: int) -> int:
+    """Index of the ')' matching the '(' at ``i``."""
+    depth = 0
+    for j in range(i, len(tokens)):
+        if tokens[j] == "(":
+            depth += 1
+        elif tokens[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return j
+    raise TemplateError("unbalanced parenthesis")
 
 
 def _eval_atom(tokens: List[str], i: int, scope: _Scope):
     t = tokens[i]
     if t == "(":
-        result, j = _eval_call(tokens, i + 1, scope, piped=_NO_PIPE,
-                               until_paren=True)
-        return result, j
+        close = _matching_paren(tokens, i)
+        return _eval_tokens(tokens[i + 1:close], scope), close + 1
     if t.startswith('"') or t.startswith("'"):
         body = t[1:-1]
         return body.encode().decode("unicode_escape"), i + 1
@@ -314,43 +330,30 @@ def _eval_atom(tokens: List[str], i: int, scope: _Scope):
     if t in ("nil", "null"):
         return None, i + 1
     if t in BUILTINS:
-        # zero-arg function used as a value — evaluate greedily below
         raise TemplateError(f"function {t!r} needs call context")
     raise TemplateError(f"unknown token {t!r}")
 
 
-def _eval_call(tokens: List[str], i: int, scope: _Scope, piped: Any,
-               until_paren: bool = False):
-    """Evaluate ``fn arg arg …`` or a single atom, with optional piped arg
-    appended (go pipeline semantics)."""
-    if i >= len(tokens):
-        raise TemplateError("empty expression")
-    t = tokens[i]
+def _eval_stage(tokens: List[str], scope: _Scope, piped: Any) -> Any:
+    """One pipeline stage: ``fn arg arg…`` or a single atom. ``tokens``
+    contains no top-level pipes by construction."""
+    t = tokens[0]
     if t in BUILTINS:
         fn = BUILTINS[t]
         args = []
-        j = i + 1
-        while j < len(tokens) and tokens[j] != "|":
-            if tokens[j] == ")":
-                if until_paren:
-                    j += 1
-                break
+        j = 1
+        while j < len(tokens):
             val, j = _eval_atom(tokens, j, scope)
             args.append(val)
         if piped is not _NO_PIPE:
             args.append(piped)
-        return fn(*args), j
-    # plain atom (possibly with piped value -> error unless it's a call)
-    val, j = _eval_atom(tokens, i, scope)
-    if until_paren:
-        if j < len(tokens) and tokens[j] == ")":
-            j += 1
+        return fn(*args)
+    val, j = _eval_atom(tokens, 0, scope)
     if piped is not _NO_PIPE:
-        raise TemplateError(
-            f"cannot pipe into non-function {t!r}")
-    if j < len(tokens) and not until_paren and tokens[j] != "|":
+        raise TemplateError(f"cannot pipe into non-function {t!r}")
+    if j < len(tokens):
         raise TemplateError(f"unexpected token {tokens[j]!r}")
-    return val, j
+    return val
 
 
 # ---------------------------------------------------------------------------

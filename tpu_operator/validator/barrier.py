@@ -1,0 +1,99 @@
+"""Status-file barrier protocol.
+
+The cross-pod synchronization mechanism of the whole system (SURVEY.md
+section 2.3): each validation component writes
+``<validation-dir>/<component>-ready`` on success; every downstream
+operand's initContainer blocks on the file it needs. The directory is a
+hostPath (default /run/tpu/validations) so the barrier spans pods on the
+same node. Mirrors the reference's status-file handling
+(validator/main.go:139-180 retry cadence, :801-812 driver-ready payload,
+preStop cleanup in assets/state-operator-validation/0500_daemonset.yaml:
+155-157).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import time
+from typing import Dict, Optional
+
+DEFAULT_DIR = "/run/tpu/validations"
+RETRY_INTERVAL_S = 5.0      # validator/main.go:139 analog
+DEFAULT_TIMEOUT_S = 300.0   # 60 x 5s pod-wait analog
+
+KNOWN_STATUS_FILES = (
+    "driver-ready",
+    "runtime-ready",
+    "jax-ready",
+    "plugin-ready",
+    "ici-ready",
+    "topology-ready",
+    ".driver-ctr-ready",
+)
+
+
+def validation_dir() -> pathlib.Path:
+    return pathlib.Path(os.environ.get("TPU_VALIDATION_DIR", DEFAULT_DIR))
+
+
+def status_path(name: str) -> pathlib.Path:
+    return validation_dir() / name
+
+
+def write_status(name: str, info: Optional[Dict[str, str]] = None) -> pathlib.Path:
+    """Write a status file atomically (tmp+rename) with KEY=VALUE payload
+    lines, like the reference's driverInfo env-style lines
+    (validator/driver.go:32-39)."""
+    path = status_path(name)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    lines = [f"{k}={v}" for k, v in (info or {}).items()]
+    tmp.write_text("\n".join(lines) + ("\n" if lines else ""))
+    tmp.rename(path)
+    return path
+
+
+def read_status(name: str) -> Optional[Dict[str, str]]:
+    path = status_path(name)
+    if not path.exists():
+        return None
+    out: Dict[str, str] = {}
+    for line in path.read_text().splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            out[k] = v
+    return out
+
+
+def is_ready(name: str) -> bool:
+    return status_path(name).exists()
+
+
+def clear_status(name: str) -> None:
+    try:
+        status_path(name).unlink()
+    except FileNotFoundError:
+        pass
+
+
+def cleanup_all() -> None:
+    """preStop: drop every status file so a departing validator re-gates
+    the node."""
+    d = validation_dir()
+    if not d.is_dir():
+        return
+    for name in KNOWN_STATUS_FILES:
+        clear_status(name)
+
+
+def wait_for(name: str, timeout: float = DEFAULT_TIMEOUT_S,
+             interval: float = RETRY_INTERVAL_S) -> bool:
+    """Block until a status file exists (the wait initContainer primitive)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        if is_ready(name):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(min(interval, max(0.01, deadline - time.monotonic())))

@@ -1,0 +1,220 @@
+"""Validation components (validator/main.go:479-596 dispatch analog).
+
+Each component proves one layer of the TPU stack and writes its barrier
+status file. Component -> proof:
+
+- ``driver``   TPU chips visible: /dev/accel* (TPU VM) or /dev/vfio
+               device nodes, the native libtpu probe when present, or a
+               JAX enumeration; writes chip inventory into driver-ready
+               (validateHostDriver/validateDriverContainer analog,
+               main.go:694-750)
+- ``runtime``  device nodes accessible + env contract -> runtime-ready
+- ``jax``      REAL compute proof: bf16 matmul on a chip, in-process or
+               as a spawned workload pod (cuda component analog,
+               main.go:1350-1425)
+- ``ici``      psum allreduce across all local chips; asserts achieved
+               fraction of ICI peak >= threshold (the BASELINE.md north
+               star; nothing like it exists for NCCL in the reference,
+               where fabric checks are presence-only)
+- ``plugin``   google.com/tpu extended resource allocatable on this node,
+               then a pod *requesting* one TPU schedules and runs
+               (main.go:1086-1253 analog)
+- ``metrics``  node-status exporter loop (validator/metrics.go analog)
+- ``sleep``    main-container park; ``cleanup`` preStop barrier teardown
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import barrier
+
+log = logging.getLogger("tpu_validator")
+
+
+class ValidationFailed(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# chip discovery
+# ---------------------------------------------------------------------------
+
+
+def discover_chips() -> Dict:
+    """Enumerate TPU chips on this host, best source first:
+
+    1. TPU_FAKE_CHIPS env (tests / fake clusters)
+    2. the native libtpu probe binary (native/libtpu_probe)
+    3. /dev/accel* + /dev/vfio/* device nodes
+    4. JAX device enumeration (requires exclusive libtpu access, so only
+       used when TPU_VALIDATOR_USE_JAX=true)
+    """
+    fake = os.environ.get("TPU_FAKE_CHIPS")
+    if fake:
+        n = int(fake)
+        return {"count": n, "source": "fake",
+                "devices": [f"/dev/accel{i}" for i in range(n)]}
+
+    probe = os.environ.get("LIBTPU_PROBE_BIN", "libtpu-probe")
+    try:
+        out = subprocess.run([probe, "--json"], capture_output=True,
+                             timeout=30, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            data = json.loads(out.stdout)
+            data.setdefault("source", "libtpu-probe")
+            return data
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError):
+        pass
+
+    devices = sorted(glob.glob("/dev/accel*"))
+    vfio = sorted(p for p in glob.glob("/dev/vfio/*")
+                  if os.path.basename(p) != "vfio")
+    if devices or vfio:
+        return {"count": len(devices) or len(vfio),
+                "source": "devfs", "devices": devices or vfio}
+
+    if os.environ.get("TPU_VALIDATOR_USE_JAX", "").lower() == "true":
+        import jax
+
+        tpus = [d for d in jax.devices() if d.platform != "cpu"]
+        return {"count": len(tpus), "source": "jax",
+                "devices": [str(d) for d in tpus],
+                "kind": tpus[0].device_kind if tpus else ""}
+
+    return {"count": 0, "source": "none", "devices": []}
+
+
+# ---------------------------------------------------------------------------
+# components
+# ---------------------------------------------------------------------------
+
+
+def validate_driver() -> Dict[str, str]:
+    chips = discover_chips()
+    if chips["count"] == 0:
+        raise ValidationFailed(
+            "no TPU chips visible (no /dev/accel*, no vfio devices, "
+            "libtpu probe found nothing)")
+    info = {
+        "CHIP_COUNT": str(chips["count"]),
+        "SOURCE": chips["source"],
+        "DEVICES": ",".join(chips.get("devices", [])),
+    }
+    if chips.get("kind"):
+        info["DEVICE_KIND"] = chips["kind"]
+    if chips.get("libtpu_version"):
+        info["LIBTPU_VERSION"] = chips["libtpu_version"]
+    barrier.write_status("driver-ready", info)
+    return info
+
+
+def validate_runtime() -> Dict[str, str]:
+    if not barrier.is_ready("driver-ready"):
+        if os.environ.get("WITH_WAIT", "").lower() == "true":
+            if not barrier.wait_for("driver-ready"):
+                raise ValidationFailed("timed out waiting for driver-ready")
+        else:
+            raise ValidationFailed("driver-ready gate not passed")
+    chips = discover_chips()
+    inaccessible = [d for d in chips.get("devices", [])
+                    if d.startswith("/dev/") and not os.access(d, os.R_OK)]
+    if chips["count"] and inaccessible and chips["source"] != "fake":
+        raise ValidationFailed(
+            f"device nodes not accessible: {inaccessible}")
+    info = {"DEVICE_COUNT": str(chips["count"])}
+    barrier.write_status("runtime-ready", info)
+    return info
+
+
+def validate_jax(matmul_size: Optional[int] = None,
+                 allow_cpu: Optional[bool] = None) -> Dict[str, str]:
+    """In-process single-chip matmul proof. (The pod-spawning variant lives
+    in workload.py and is used when a kube client is available.)
+
+    The proof must run on an actual TPU: JAX silently falls back to the CPU
+    backend when libtpu can't initialize, and certifying a node off a CPU
+    matmul would defeat the whole gate. CPU is allowed only via explicit
+    opt-in (tests, fake clusters)."""
+    size = matmul_size or int(os.environ.get("MATMUL_SIZE", "4096"))
+    if allow_cpu is None:
+        allow_cpu = os.environ.get("TPU_VALIDATOR_ALLOW_CPU",
+                                   "").lower() == "true"
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not allow_cpu:
+        raise ValidationFailed(
+            "JAX initialized on the CPU backend — libtpu is not usable "
+            "from this container (set TPU_VALIDATOR_ALLOW_CPU=true only "
+            "for fake/test clusters)")
+    from ..workloads import matmul
+
+    res = matmul.run(size=size, iters=8, calls=2, repeats=1)
+    if not res.checksum_ok:
+        raise ValidationFailed("matmul produced non-finite values")
+    info = {
+        "MATMUL_SIZE": str(size),
+        "TFLOPS": f"{res.tflops:.2f}",
+        "DEVICE_KIND": res.device_kind,
+    }
+    if res.utilization is not None:
+        info["MXU_UTILIZATION"] = f"{res.utilization:.3f}"
+    barrier.write_status("jax-ready", info)
+    return info
+
+
+def validate_ici(threshold: Optional[float] = None,
+                 allow_cpu: Optional[bool] = None) -> Dict[str, str]:
+    import jax
+
+    if allow_cpu is None:
+        allow_cpu = os.environ.get("TPU_VALIDATOR_ALLOW_CPU",
+                                   "").lower() == "true"
+    if jax.devices()[0].platform == "cpu" and not allow_cpu:
+        raise ValidationFailed(
+            "JAX initialized on the CPU backend — cannot measure ICI "
+            "(set TPU_VALIDATOR_ALLOW_CPU=true only for fake/test clusters)")
+    thr = threshold if threshold is not None else float(
+        os.environ.get("ICI_THRESHOLD", "0.8"))
+    n = jax.device_count()
+    if n < 2:
+        info = {"SKIPPED": "single-chip host, no ICI to validate",
+                "DEVICES": str(n)}
+        barrier.write_status("ici-ready", info)
+        return info
+    from ..workloads import collectives
+
+    res = collectives.run(size_mb=float(os.environ.get("ICI_SIZE_MB", "256")))
+    if not res.correct:
+        raise ValidationFailed("allreduce produced wrong values")
+    info = {
+        "DEVICES": str(res.devices),
+        "BUS_BW_GBPS": f"{res.bus_bw_gbps:.2f}",
+        "DEVICE_KIND": res.device_kind,
+    }
+    if res.fraction_of_peak is not None:
+        info["FRACTION_OF_PEAK"] = f"{res.fraction_of_peak:.3f}"
+        if res.fraction_of_peak < thr:
+            raise ValidationFailed(
+                f"ICI allreduce reached {res.fraction_of_peak:.1%} of peak, "
+                f"below the {thr:.0%} threshold")
+    barrier.write_status("ici-ready", info)
+    return info
+
+
+def component_sleep() -> None:  # pragma: no cover - blocks forever
+    log.info("node validated; sleeping (DaemonSet main container)")
+    while True:
+        time.sleep(3600)
+
+
+def component_cleanup() -> None:
+    barrier.cleanup_all()
+    log.info("validation status files removed")

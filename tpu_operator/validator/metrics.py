@@ -1,0 +1,125 @@
+"""Node validation-status metrics exporter (validator/metrics.go:39-320
+analog): polls the barrier status files, periodically re-proves the driver
+layer, and serves tpu_operator_node_* gauges for the node-status-exporter
+DaemonSet."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from . import barrier, components
+
+log = logging.getLogger("tpu_validator.metrics")
+
+POLL_INTERVAL_S = 30.0        # status-file poll (metrics.go:39-46 analog)
+REVALIDATE_INTERVAL_S = 60.0  # driver re-proof cadence
+
+COMPONENT_FILES = {
+    "driver": "driver-ready",
+    "runtime": "runtime-ready",
+    "jax": "jax-ready",
+    "plugin": "plugin-ready",
+    "ici": "ici-ready",
+}
+
+
+class NodeMetrics:
+    def __init__(self, node_name: str = ""):
+        self.registry = CollectorRegistry()
+        self.node_name = node_name
+        self.ready = Gauge("tpu_operator_node_component_ready",
+                           "1 when the component's validation is current",
+                           labelnames=("component", "node"),
+                           registry=self.registry)
+        self.chips = Gauge("tpu_operator_node_tpu_chips",
+                           "TPU chips discovered on this node",
+                           labelnames=("node",), registry=self.registry)
+        self.revalidations = Gauge("tpu_operator_node_revalidations_total",
+                                   "Driver re-validation attempts",
+                                   labelnames=("node",),
+                                   registry=self.registry)
+        self.revalidation_ok = Gauge(
+            "tpu_operator_node_driver_revalidation_ok",
+            "1 when the last periodic driver re-proof succeeded",
+            labelnames=("node",), registry=self.registry)
+        self._reval_count = 0
+
+    def collect_once(self, revalidate: bool = False) -> None:
+        if revalidate:
+            self._reval_count += 1
+            self.revalidations.labels(node=self.node_name).set(
+                self._reval_count)
+            try:
+                components.validate_driver()
+                self.revalidation_ok.labels(node=self.node_name).set(1)
+            except components.ValidationFailed as e:
+                # Report the failure via the gauge only. The barrier file is
+                # OWNED by the validator DaemonSet — clearing it from here
+                # would wedge every operand on the node whenever this
+                # exporter pod merely lacks device visibility.
+                log.warning("driver re-validation failed: %s", e)
+                self.revalidation_ok.labels(node=self.node_name).set(0)
+        for comp, fname in COMPONENT_FILES.items():
+            self.ready.labels(component=comp, node=self.node_name).set(
+                1 if barrier.is_ready(fname) else 0)
+        info = barrier.read_status("driver-ready") or {}
+        self.chips.labels(node=self.node_name).set(
+            int(info.get("CHIP_COUNT", "0") or 0))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def serve(port: int, node_name: str = "",
+          poll_interval: float = POLL_INTERVAL_S,
+          revalidate_interval: float = REVALIDATE_INTERVAL_S,
+          stop_event: threading.Event = None) -> ThreadingHTTPServer:
+    """Start the exporter (returns the server; caller joins/stops)."""
+    metrics = NodeMetrics(node_name)
+    metrics.collect_once(revalidate=False)
+    stop = stop_event or threading.Event()
+
+    def poll_loop():
+        last_reval = time.monotonic()
+        while not stop.is_set():
+            revalidate = time.monotonic() - last_reval >= revalidate_interval
+            if revalidate:
+                last_reval = time.monotonic()
+            try:
+                metrics.collect_once(revalidate=revalidate)
+            except Exception:
+                log.exception("metrics collection failed")
+            stop.wait(poll_interval)
+
+    threading.Thread(target=poll_loop, daemon=True).start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = metrics.render()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+            elif self.path == "/healthz":
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+            else:
+                body = b"not found"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    server._stop_event = stop  # type: ignore[attr-defined]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,124 @@
+"""Workload-pod spawning proofs.
+
+The plugin/jax validations that go through the scheduler: create a real
+pod (optionally requesting google.com/tpu) and wait for it to succeed —
+proving admission, scheduling, device allocation, and the runtime end to
+end (validator/main.go:1086-1170 plugin pod, :1350-1425 cuda pod analog).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from ..api import labels as L
+from ..runtime.client import Client, NotFoundError
+from ..runtime.objects import get_nested
+from . import barrier
+from .components import ValidationFailed
+
+log = logging.getLogger("tpu_validator")
+
+POD_WAIT_ATTEMPTS = 60     # validator/main.go pod-wait 60x5s
+POD_WAIT_INTERVAL_S = 5.0
+RESOURCE_WAIT_ATTEMPTS = 30  # TPU-discovery 30x5s analog
+
+
+def jax_workload_pod(namespace: str, node_name: str, image: str,
+                     matmul_size: int = 4096,
+                     request_tpu: bool = True) -> dict:
+    """The JAX matmul proof pod (cuda-workload-validation.yaml analog)."""
+    resources = ({"limits": {L.TPU_RESOURCE: "1"}} if request_tpu else {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "tpu-jax-validator" + ("" if request_tpu else "-nores"),
+            "namespace": namespace,
+            "labels": {"app": "tpu-jax-validator"},
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeName": node_name,
+            "tolerations": [{"key": L.TPU_RESOURCE, "operator": "Exists",
+                             "effect": "NoSchedule"}],
+            "containers": [{
+                "name": "jax-matmul",
+                "image": image,
+                "command": ["python", "-m", "tpu_operator.workloads.matmul"],
+                "env": [{"name": "MATMUL_SIZE", "value": str(matmul_size)}],
+                "resources": resources,
+            }],
+        },
+    }
+
+
+def wait_for_pod_phase(client: Client, name: str, namespace: str,
+                       want=("Succeeded",),
+                       attempts: int = POD_WAIT_ATTEMPTS,
+                       interval: float = POD_WAIT_INTERVAL_S) -> str:
+    for _ in range(attempts):
+        pod = client.get_or_none("v1", "Pod", name, namespace)
+        phase = get_nested(pod or {}, "status", "phase", default="")
+        if phase in want:
+            return phase
+        if phase == "Failed" and "Failed" not in want:
+            raise ValidationFailed(f"workload pod {name} failed")
+        time.sleep(interval)
+    raise ValidationFailed(
+        f"workload pod {name} did not reach {want} in "
+        f"{attempts * interval:.0f}s")
+
+
+def spawn_and_wait(client: Client, pod: dict) -> str:
+    name = pod["metadata"]["name"]
+    ns = pod["metadata"]["namespace"]
+    try:
+        client.delete("v1", "Pod", name, ns)  # clear previous attempt
+    except NotFoundError:
+        pass
+    client.create(pod)
+    try:
+        return wait_for_pod_phase(client, name, ns)
+    finally:
+        try:
+            client.delete("v1", "Pod", name, ns)
+        except NotFoundError:
+            pass
+
+
+def validate_plugin(client: Client, node_name: str, namespace: str,
+                    image: str,
+                    attempts: int = RESOURCE_WAIT_ATTEMPTS,
+                    interval: float = POD_WAIT_INTERVAL_S) -> Dict[str, str]:
+    """google.com/tpu allocatable on the node, then a pod requesting one
+    TPU runs to completion."""
+    allocatable = "0"
+    for _ in range(attempts):
+        node = client.get_or_none("v1", "Node", node_name)
+        allocatable = str(get_nested(node or {}, "status", "allocatable",
+                                     L.TPU_RESOURCE, default="0"))
+        if allocatable not in ("", "0"):
+            break
+        time.sleep(interval)
+    else:
+        raise ValidationFailed(
+            f"node {node_name} never advertised {L.TPU_RESOURCE}")
+
+    pod = jax_workload_pod(namespace, node_name, image, request_tpu=True)
+    pod["metadata"]["name"] = "tpu-plugin-validator"
+    phase = spawn_and_wait(client, pod)
+    info = {"ALLOCATABLE": allocatable, "WORKLOAD_PHASE": phase}
+    barrier.write_status("plugin-ready", info)
+    return info
+
+
+def validate_jax_pod(client: Client, node_name: str, namespace: str,
+                     image: str, matmul_size: int = 4096) -> Dict[str, str]:
+    pod = jax_workload_pod(namespace, node_name, image,
+                           matmul_size=matmul_size, request_tpu=False)
+    phase = spawn_and_wait(client, pod)
+    info = {"WORKLOAD_PHASE": phase, "MATMUL_SIZE": str(matmul_size)}
+    barrier.write_status("jax-ready", info)
+    return info

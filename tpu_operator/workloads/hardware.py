@@ -1,0 +1,61 @@
+"""TPU hardware identification + public peak numbers.
+
+Peaks are the published per-chip figures (cloud.google.com/tpu/docs system
+architecture pages); they anchor the validator's utilization fractions and
+the ICI-bandwidth threshold from BASELINE.md (>=80% of link bandwidth).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    generation: str
+    peak_bf16_tflops: float    # per chip
+    hbm_gb: float
+    hbm_bw_gbps: float         # GB/s per chip
+    ici_bw_gbps: float         # GB/s per chip, aggregate across links
+
+
+# public per-chip numbers (TFLOP/s bf16, HBM GB, HBM GB/s, ICI GB/s)
+CHIPS = {
+    "v2": ChipSpec("v2", 45.0, 16, 600, 62.5),
+    "v3": ChipSpec("v3", 123.0, 32, 900, 87.5),
+    "v4": ChipSpec("v4", 275.0, 32, 1228, 300.0),
+    "v5e": ChipSpec("v5e", 197.0, 16, 819, 200.0),
+    "v5p": ChipSpec("v5p", 459.0, 95, 2765, 600.0),
+    "v6e": ChipSpec("v6e", 918.0, 32, 1640, 448.0),
+}
+
+_KIND_HINTS = (
+    ("v6e", "v6e"), ("v6 lite", "v6e"),
+    ("v5p", "v5p"),
+    ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
+    ("v5", "v5p"),
+    ("v4", "v4"),
+    ("v3", "v3"),
+    ("v2", "v2"),
+)
+
+
+def chip_spec_for(device_kind: str) -> Optional[ChipSpec]:
+    """Map jax.Device.device_kind (e.g. 'TPU v5p chip') to a ChipSpec."""
+    kind = (device_kind or "").lower()
+    for hint, gen in _KIND_HINTS:
+        if hint in kind:
+            return CHIPS[gen]
+    return None
+
+
+def detect() -> tuple:
+    """(platform, device_count, device_kind, ChipSpec|None) for the default
+    JAX backend."""
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    kind = getattr(devices[0], "device_kind", "")
+    return platform, len(devices), kind, chip_spec_for(kind)
