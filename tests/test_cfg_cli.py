@@ -1,0 +1,89 @@
+"""tpuop-cfg CLI: offline validation + manifest generation
+(cmd/gpuop-cfg tier)."""
+
+import yaml
+
+from tpu_operator.cli.tpuop_cfg import main, validate_cr
+from tpu_operator.deploy.packaging import generate
+
+
+def write_policy(tmp_path, spec, name="p", kind="TPUClusterPolicy",
+                 api_version="tpu.graft.dev/v1"):
+    p = tmp_path / "cr.yaml"
+    p.write_text(yaml.safe_dump({
+        "apiVersion": api_version, "kind": kind,
+        "metadata": {"name": name}, "spec": spec}))
+    return str(p)
+
+
+class TestValidate:
+    def test_valid_policy(self, tmp_path, capsys):
+        f = write_policy(tmp_path, {"libtpu": {"channel": "nightly"},
+                                    "validator": {"matmulSize": 2048}})
+        assert main(["validate", "clusterpolicy", "-f", f]) == 0
+        assert "is valid" in capsys.readouterr().out
+
+    def test_unknown_field_rejected(self, tmp_path, capsys):
+        f = write_policy(tmp_path, {"libtpu": {"chanel": "stable"}})
+        assert main(["validate", "clusterpolicy", "-f", f]) == 1
+        assert "unknown field" in capsys.readouterr().err
+
+    def test_wrong_type_rejected(self, tmp_path, capsys):
+        f = write_policy(tmp_path, {"validator": {"matmulSize": "big"}})
+        assert main(["validate", "clusterpolicy", "-f", f]) == 1
+        assert "expected integer" in capsys.readouterr().err
+
+    def test_wrong_api_version(self, tmp_path):
+        f = write_policy(tmp_path, {}, api_version="tpu.graft.dev/v2")
+        assert main(["validate", "clusterpolicy", "-f", f]) == 1
+
+    def test_incomplete_image_rejected(self, tmp_path, capsys):
+        f = write_policy(tmp_path,
+                         {"libtpu": {"repository": "gcr.io/x"}})  # no image/version
+        assert main(["validate", "clusterpolicy", "-f", f]) == 1
+        assert "cannot resolve image" in capsys.readouterr().err
+
+    def test_kind_must_match_subcommand(self, tmp_path, capsys):
+        # a CI gate validating a TPUDriver must not pass on a ClusterPolicy
+        f = write_policy(tmp_path, {})
+        assert main(["validate", "tpudriver", "-f", f]) == 1
+        assert "requires kind TPUDriver" in capsys.readouterr().err
+
+    def test_tpudriver_validates(self, tmp_path):
+        f = write_policy(tmp_path, {"channel": "stable"},
+                         kind="TPUDriver",
+                         api_version="tpu.graft.dev/v1alpha1")
+        assert main(["validate", "tpudriver", "-f", f]) == 0
+
+    def test_status_state_rejected_outside_enum(self):
+        errs, _ = validate_cr({
+            "apiVersion": "tpu.graft.dev/v1", "kind": "TPUClusterPolicy",
+            "metadata": {"name": "x"},
+            "spec": {"daemonsets": {"updateStrategy": 7}}})
+        assert any("expected string" in e for e in errs)
+
+
+class TestGenerate:
+    def test_crds(self):
+        docs = generate("crds")
+        assert [d["kind"] for d in docs] == ["CustomResourceDefinition"] * 2
+
+    def test_operator_bundle_complete(self):
+        docs = generate("operator")
+        kinds = [d["kind"] for d in docs]
+        for want in ("Namespace", "ServiceAccount", "ClusterRole",
+                     "ClusterRoleBinding", "Deployment", "TPUClusterPolicy"):
+            assert want in kinds, want
+
+    def test_cli_emits_parseable_yaml(self, capsys):
+        assert main(["generate", "all", "-n", "custom-ns"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert len(docs) == 8
+        ns = [d for d in docs if d["kind"] == "Namespace"][0]
+        assert ns["metadata"]["name"] == "custom-ns"
+
+    def test_generated_sample_policy_is_valid(self):
+        from tpu_operator.deploy.packaging import sample_cluster_policy
+
+        errs, _ = validate_cr(sample_cluster_policy())
+        assert errs == []

@@ -1,0 +1,141 @@
+"""Device plugin: real gRPC round trips over unix sockets with a fake
+kubelet (the kubelet side of the v1beta1 contract)."""
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_operator.deviceplugin import api_pb2 as pb
+from tpu_operator.deviceplugin.plugin import (
+    API_VERSION,
+    TPUDevicePlugin,
+    device_host_path,
+    discover_devices,
+)
+
+
+class FakeKubelet:
+    """Serves v1beta1.Registration on kubelet.sock like the real kubelet."""
+
+    def __init__(self, socket_dir):
+        self.socket_dir = socket_dir
+        self.registrations = []
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+
+        def register(request, context):
+            self.registrations.append(request)
+            return pb.Empty()
+
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration", {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    register,
+                    request_deserializer=pb.RegisterRequest.FromString,
+                    response_serializer=pb.Empty.SerializeToString),
+            })
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(
+            f"unix://{os.path.join(socket_dir, 'kubelet.sock')}")
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(grace=0.2)
+
+
+@pytest.fixture
+def plugin(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+    p = TPUDevicePlugin(socket_dir=str(tmp_path), health_interval_s=0.1)
+    p.start()
+    yield p
+    p.stop()
+
+
+def plugin_channel(plugin):
+    return grpc.insecure_channel(f"unix://{plugin.socket_path}")
+
+
+def call(channel, method, req, req_cls, resp_cls):
+    rpc = channel.unary_unary(
+        f"/v1beta1.DevicePlugin/{method}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString)
+    return rpc(req, timeout=5)
+
+
+class TestDiscovery:
+    def test_fake_chips(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        devices = discover_devices()
+        assert [d.ID for d in devices] == ["accel0", "accel1", "accel2",
+                                          "accel3"]
+        assert all(d.health == "Healthy" for d in devices)
+
+    def test_device_host_path(self):
+        assert device_host_path("accel2") == "/dev/accel2"
+        assert device_host_path("17") == "/dev/vfio/17"
+
+
+class TestDevicePluginRPC:
+    def test_options(self, plugin):
+        with plugin_channel(plugin) as ch:
+            opts = call(ch, "GetDevicePluginOptions", pb.Empty(), pb.Empty,
+                        pb.DevicePluginOptions)
+        assert opts.get_preferred_allocation_available
+
+    def test_list_and_watch_streams_inventory(self, plugin):
+        with plugin_channel(plugin) as ch:
+            rpc = ch.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=pb.Empty.SerializeToString,
+                response_deserializer=pb.ListAndWatchResponse.FromString)
+            stream = rpc(pb.Empty(), timeout=5)
+            first = next(stream)
+            assert len(first.devices) == 4
+            # inventory change pushes an update
+            os.environ["TPU_FAKE_CHIPS"] = "2"
+            try:
+                second = next(stream)
+                assert len(second.devices) == 2
+            finally:
+                os.environ["TPU_FAKE_CHIPS"] = "4"
+            stream.cancel()
+
+    def test_allocate_returns_devices_and_env(self, plugin):
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["accel0", "accel1"])
+        with plugin_channel(plugin) as ch:
+            resp = call(ch, "Allocate", req, pb.AllocateRequest,
+                        pb.AllocateResponse)
+        [cresp] = resp.container_responses
+        assert [d.host_path for d in cresp.devices] == ["/dev/accel0",
+                                                        "/dev/accel1"]
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+
+    def test_preferred_allocation_contiguous(self, plugin):
+        req = pb.PreferredAllocationRequest()
+        req.container_requests.add(
+            available_deviceIDs=["accel3", "accel1", "accel0", "accel2"],
+            allocation_size=2)
+        with plugin_channel(plugin) as ch:
+            resp = call(ch, "GetPreferredAllocation", req,
+                        pb.PreferredAllocationRequest,
+                        pb.PreferredAllocationResponse)
+        assert list(resp.container_responses[0].deviceIDs) == ["accel0",
+                                                               "accel1"]
+
+
+class TestKubeletRegistration:
+    def test_register_round_trip(self, tmp_path, plugin):
+        kubelet = FakeKubelet(str(plugin.socket_dir))
+        try:
+            plugin.register_with_kubelet()
+            [reg] = kubelet.registrations
+            assert reg.version == API_VERSION
+            assert reg.resource_name == "google.com/tpu"
+            assert reg.endpoint == "tpu-device-plugin.sock"
+        finally:
+            kubelet.stop()

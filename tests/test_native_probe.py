@@ -1,0 +1,61 @@
+"""Native libtpu-probe: build + JSON contract + dlopen verification."""
+
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+NATIVE_DIR = pathlib.Path(__file__).resolve().parents[1] / "native"
+PROBE = NATIVE_DIR / "libtpu-probe"
+
+
+@pytest.fixture(scope="module")
+def probe_bin():
+    subprocess.run(["make", "-C", str(NATIVE_DIR)], check=True,
+                   capture_output=True)
+    return str(PROBE)
+
+
+def run_probe(probe_bin, env=None):
+    import os
+
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    proc = subprocess.run([probe_bin, "--json"], capture_output=True,
+                          text=True, env=full_env)
+    return proc.returncode, json.loads(proc.stdout)
+
+
+class TestProbe:
+    def test_json_contract(self, probe_bin):
+        code, data = run_probe(probe_bin)
+        assert set(data) == {"count", "devices", "source", "libtpu"}
+        assert set(data["libtpu"]) == {"found", "path", "dlopen_ok",
+                                       "version_symbol"}
+        assert isinstance(data["count"], int)
+
+    def test_no_devices_exits_nonzero(self, probe_bin):
+        # this host has no /dev/accel* (TPU is tunneled)
+        code, data = run_probe(probe_bin)
+        if data["count"] == 0:
+            assert code == 1
+
+    def test_dlopen_real_shared_object(self, probe_bin, tmp_path):
+        src = tmp_path / "fake.c"
+        so = tmp_path / "libtpu.so"
+        src.write_text("int GetPjrtApi(void){return 0;}\n")
+        subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(so), str(src)],
+                       check=True)
+        code, data = run_probe(probe_bin, env={"LIBTPU_PATH": str(so)})
+        assert data["libtpu"]["found"]
+        assert data["libtpu"]["dlopen_ok"]
+        assert data["libtpu"]["version_symbol"]
+
+    def test_corrupt_libtpu_detected(self, probe_bin, tmp_path):
+        so = tmp_path / "libtpu.so"
+        so.write_text("garbage")
+        code, data = run_probe(probe_bin, env={"LIBTPU_PATH": str(so)})
+        assert data["libtpu"]["found"]
+        assert not data["libtpu"]["dlopen_ok"]
+        assert code == 1  # broken libtpu => driver layer broken

@@ -1,0 +1,86 @@
+"""Node-agent entrypoints: libtpu install flow, preflight gate closing,
+runtime contract."""
+
+import ctypes.util
+import os
+import subprocess
+
+import pytest
+
+from tpu_operator.cli.node_agents import (
+    driver_manager_main,
+    install_libtpu,
+    libtpu_install_main,
+    runtime_setup_main,
+)
+from tpu_operator.validator import barrier
+
+
+@pytest.fixture
+def valdir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_VALIDATION_DIR", str(tmp_path / "validations"))
+    return tmp_path
+
+
+def make_fake_so(path):
+    """Build a real tiny shared object so dlopen verification is honest."""
+    src = path.with_suffix(".c")
+    src.write_text("int libtpu_fake_symbol(void){return 42;}\n")
+    subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(path), str(src)],
+                   check=True)
+
+
+class TestLibtpuInstall:
+    def test_installs_bundled_and_verifies_dlopen(self, tmp_path, valdir,
+                                                  monkeypatch):
+        src_dir = tmp_path / "bundle" / "stable"
+        src_dir.mkdir(parents=True)
+        make_fake_so(src_dir / "libtpu.so")
+        install_dir = tmp_path / "host-bin"
+        monkeypatch.setenv("INSTALL_DIR", str(install_dir))
+        monkeypatch.setenv("LIBTPU_SRC", str(tmp_path / "bundle"))
+        monkeypatch.setenv("LIBTPU_CHANNEL", "stable")
+        assert libtpu_install_main(["run", "--no-park"]) == 0
+        assert (install_dir / "libtpu.so").exists()
+        info = barrier.read_status(".driver-ctr-ready")
+        assert info["CHANNEL"] == "stable"
+
+    def test_fails_without_any_libtpu(self, tmp_path, valdir, monkeypatch):
+        monkeypatch.setenv("INSTALL_DIR", str(tmp_path / "empty"))
+        monkeypatch.setenv("LIBTPU_SRC", str(tmp_path / "nothing"))
+        assert libtpu_install_main(["run", "--no-park"]) == 1
+        assert not barrier.is_ready(".driver-ctr-ready")
+
+    def test_corrupt_so_fails_dlopen_verification(self, tmp_path, valdir,
+                                                  monkeypatch):
+        install_dir = tmp_path / "host-bin"
+        install_dir.mkdir()
+        (install_dir / "libtpu.so").write_text("not an ELF")
+        with pytest.raises(OSError):
+            install_libtpu(str(install_dir), "stable", "/nonexistent")
+
+
+class TestDriverManager:
+    def test_preflight_closes_gates(self, valdir):
+        barrier.write_status("driver-ready")
+        barrier.write_status("jax-ready")
+        assert driver_manager_main(["preflight"]) == 0
+        assert not barrier.is_ready("driver-ready")
+        assert not barrier.is_ready("jax-ready")
+
+
+class TestRuntimeSetup:
+    def test_writes_env_contract(self, valdir, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x1")
+        assert runtime_setup_main(["run", "--no-park"]) == 0
+        env_file = barrier.validation_dir().parent / "tpu-env"
+        content = env_file.read_text()
+        assert "TPU_DEVICES=/dev/accel0,/dev/accel1,/dev/accel2,/dev/accel3" \
+            in content
+        assert "TPU_TOPOLOGY=2x2x1" in content
+
+    def test_fails_without_devices(self, valdir, monkeypatch):
+        monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
+        monkeypatch.setenv("DEVICE_PATH_GLOB", "/dev/definitely-not-a-tpu*")
+        assert runtime_setup_main(["run", "--no-park"]) == 1

@@ -1,0 +1,170 @@
+"""Topology/slice manager + libtpu exporter + slice-aware device plugin."""
+
+import json
+
+import pytest
+import requests
+
+from tpu_operator.api import labels as L
+from tpu_operator.metrics.libtpu_exporter import LibtpuExporter
+from tpu_operator.runtime import FakeClient
+from tpu_operator.topology.manager import (
+    STATE_FAILED,
+    STATE_PENDING,
+    STATE_SUCCESS,
+    TopologyManager,
+    chip_groups,
+    load_profiles,
+    read_slice_file,
+)
+
+PROFILES_YAML = """
+version: v1
+profiles:
+  full:
+    subslices: 1
+  split-2:
+    subslices: 2
+  split-4:
+    subslices: 4
+"""
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(PROFILES_YAML)
+    return str(p)
+
+
+def tpu_node(c, name, topology="2x2x1", slice_config=None, chips="4"):
+    labels = {
+        L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+        L.GKE_TPU_TOPOLOGY: topology,
+        L.TPU_CHIP_COUNT: chips,
+    }
+    if slice_config:
+        labels[L.SLICE_CONFIG] = slice_config
+    return c.add_node(name, labels=labels,
+                      allocatable={"google.com/tpu": chips})
+
+
+class TestProfiles:
+    def test_load(self, config_file):
+        profiles = load_profiles(config_file)
+        assert profiles["split-2"].subslices == 2
+
+    def test_chip_groups_contiguous(self):
+        assert chip_groups(["a", "b", "c", "d"], 2) == [["a", "b"],
+                                                        ["c", "d"]]
+        with pytest.raises(ValueError):
+            chip_groups(["a", "b", "c"], 2)
+
+
+class TestTopologyManager:
+    def test_apply_profile_writes_file_and_label(self, config_file, tmp_path):
+        c = FakeClient()
+        tpu_node(c, "tpu-0", slice_config="split-2")
+        slice_file = str(tmp_path / "slice.json")
+        mgr = TopologyManager(c, "tpu-0", config_file,
+                              slice_file=slice_file)
+        assert mgr.apply_once() == STATE_SUCCESS
+        cfg = read_slice_file(slice_file)
+        assert cfg["subslices"] == 2
+        assert cfg["groups"] == [["accel0", "accel1"], ["accel2", "accel3"]]
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][L.SLICE_CONFIG_STATE] == "success"
+
+    def test_default_profile_when_unlabeled(self, config_file, tmp_path):
+        c = FakeClient()
+        tpu_node(c, "tpu-0")
+        mgr = TopologyManager(c, "tpu-0", config_file,
+                              slice_file=str(tmp_path / "s.json"))
+        assert mgr.apply_once() == STATE_SUCCESS
+        assert read_slice_file(str(tmp_path / "s.json"))["subslices"] == 1
+
+    def test_unknown_profile_fails(self, config_file, tmp_path):
+        c = FakeClient()
+        tpu_node(c, "tpu-0", slice_config="nope")
+        mgr = TopologyManager(c, "tpu-0", config_file,
+                              slice_file=str(tmp_path / "s.json"))
+        assert mgr.apply_once() == STATE_FAILED
+
+    def test_indivisible_profile_fails(self, config_file, tmp_path):
+        c = FakeClient()
+        tpu_node(c, "tpu-0", slice_config="split-4", chips="2")
+        mgr = TopologyManager(c, "tpu-0", config_file,
+                              slice_file=str(tmp_path / "s.json"))
+        assert mgr.apply_once() == STATE_FAILED
+
+    def test_multi_host_waits_for_pool_agreement(self, config_file, tmp_path):
+        """Grouped semantics: a 4x4x4 (multi-host) pool only applies once
+        every host requests the same profile."""
+        c = FakeClient()
+        tpu_node(c, "host-0", topology="4x4x4", slice_config="split-2")
+        tpu_node(c, "host-1", topology="4x4x4", slice_config="full")
+        mgr = TopologyManager(c, "host-0", config_file,
+                              slice_file=str(tmp_path / "s.json"))
+        assert mgr.apply_once() == STATE_PENDING
+        # peer converges -> success
+        c.patch("v1", "Node", "host-1",
+                {"metadata": {"labels": {L.SLICE_CONFIG: "split-2"}}})
+        assert mgr.apply_once() == STATE_SUCCESS
+
+
+class TestSliceAwareDevicePlugin:
+    def test_slices_advertised_and_expanded(self, tmp_path, monkeypatch):
+        from tpu_operator.deviceplugin.plugin import (
+            discover_devices,
+            expand_to_chips,
+        )
+
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        slice_file = tmp_path / "slice.json"
+        slice_file.write_text(json.dumps({
+            "profile": "split-2", "subslices": 2,
+            "groups": [["accel0", "accel1"], ["accel2", "accel3"]]}))
+        monkeypatch.setenv("TPU_SLICE_FILE", str(slice_file))
+        devices = discover_devices()
+        assert [d.ID for d in devices] == ["slice0", "slice1"]
+        assert expand_to_chips(["slice1"]) == ["accel2", "accel3"]
+
+    def test_full_profile_advertises_chips(self, tmp_path, monkeypatch):
+        from tpu_operator.deviceplugin.plugin import discover_devices
+
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        slice_file = tmp_path / "slice.json"
+        slice_file.write_text(json.dumps({
+            "profile": "full", "subslices": 1,
+            "groups": [["accel0", "accel1"]]}))
+        monkeypatch.setenv("TPU_SLICE_FILE", str(slice_file))
+        assert [d.ID for d in discover_devices()] == ["accel0", "accel1"]
+
+
+class TestLibtpuExporter:
+    def test_fake_collection_and_render(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        exp = LibtpuExporter(node_name="tpu-0")
+        assert exp.collect_once() == 2
+        body = exp.render().decode()
+        assert 'tpu_duty_cycle_percent{chip="accel0",node="tpu-0"} 50.0' in body
+        assert 'tpu_hbm_total_bytes{chip="accel1",node="tpu-0"}' in body
+        assert 'tpu_chips_total{node="tpu-0"} 2.0' in body
+
+    def test_http_serving(self, monkeypatch):
+        import threading
+
+        from tpu_operator.metrics.libtpu_exporter import serve
+
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "1")
+        stop = threading.Event()
+        server = serve(0, node_name="n0", interval=0.05, stop_event=stop)
+        port = server.server_address[1]
+        try:
+            body = requests.get(f"http://127.0.0.1:{port}/metrics",
+                                timeout=2).text
+            assert "tpu_duty_cycle_percent" in body
+        finally:
+            stop.set()
+            server.shutdown()
+            server.server_close()

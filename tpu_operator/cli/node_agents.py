@@ -1,0 +1,197 @@
+"""Node-level operand agents — the container entrypoints of the operand
+DaemonSets (the role the driver-container / k8s-driver-manager / toolkit
+images play for the reference; SURVEY.md section 2.4 rows 1-2).
+
+- ``tpu-driver-manager preflight``: safe-replacement preflight for the
+  libtpu installer (k8s-driver-manager initContainer analog,
+  assets/state-driver/0500_daemonset.yaml:47-78): drop this node's
+  validation gates so downstream operands re-prove against the NEW
+  libtpu, never the old one.
+- ``libtpu-install run``: install/verify libtpu.so into the host dir and
+  park (nvidia-driver init-container analog). On GKE/TPU-VM images libtpu
+  ships with the node, so "install" is verify-or-copy: a bundled build
+  (LIBTPU_SRC) is copied in when the host lacks one or the channel pins a
+  different build; the result is dlopen-verified, then
+  ``.driver-ctr-ready`` opens the gate the validator's driver component
+  polls (main.go:649-658 analog).
+- ``tpu-runtime-setup run``: device-node exposure + TPU env contract
+  (container-toolkit slot): verify DEVICE_PATH_GLOB matches, fix
+  permissions, drop /run/tpu/tpu-env for workloads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import glob
+import logging
+import os
+import shutil
+import sys
+import time
+
+from ..validator import barrier
+
+log = logging.getLogger("tpu_node_agent")
+
+
+def _park() -> None:  # pragma: no cover - container main loop
+    while True:
+        time.sleep(3600)
+
+
+# ---------------------------------------------------------------------------
+# tpu-driver-manager
+# ---------------------------------------------------------------------------
+
+
+def driver_manager_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-driver-manager")
+    p.add_argument("action", choices=["preflight"])
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.action == "preflight":
+        # close ALL the gates: every operand must re-validate against the
+        # libtpu this pod is about to (re)install (single source of truth
+        # for the gate list lives in barrier.KNOWN_STATUS_FILES)
+        barrier.cleanup_all()
+        log.info("preflight: validation gates closed for reinstall")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# libtpu-install
+# ---------------------------------------------------------------------------
+
+
+def _dlopen_ok(path: str) -> bool:
+    try:
+        ctypes.CDLL(path)
+        return True
+    except OSError:
+        return False
+
+
+def _sha256(path: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def install_libtpu(install_dir: str, channel: str, src: str,
+                   verify_dlopen: bool = True) -> str:
+    """Ensure a working libtpu.so under install_dir; returns its path."""
+    os.makedirs(install_dir, exist_ok=True)
+    dst = os.path.join(install_dir, "libtpu.so")
+    candidates = [
+        os.path.join(src, channel, "libtpu.so"),
+        os.path.join(src, "libtpu.so"),
+        src if src.endswith(".so") else "",
+    ]
+    bundled = next((c for c in candidates if c and os.path.exists(c)), None)
+    if bundled:
+        # content hash, not size: same-size patch builds must still install
+        if not os.path.exists(dst) or _sha256(dst) != _sha256(bundled):
+            shutil.copy2(bundled, dst)
+            log.info("installed bundled libtpu (%s channel) -> %s",
+                     channel, dst)
+    if not os.path.exists(dst):
+        raise FileNotFoundError(
+            f"no libtpu.so on host ({dst}) and no bundled build under "
+            f"{src!r}")
+    if verify_dlopen and not _dlopen_ok(dst):
+        raise OSError(f"{dst} exists but dlopen fails")
+    return dst
+
+
+def libtpu_install_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="libtpu-install")
+    p.add_argument("action", choices=["run", "verify"])
+    p.add_argument("--no-park", action="store_true",
+                   help="exit after install instead of sleeping (tests)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    install_dir = os.environ.get("INSTALL_DIR", "/home/kubernetes/bin")
+    channel = os.environ.get("LIBTPU_CHANNEL", "stable")
+    src = os.environ.get("LIBTPU_SRC", "/opt/libtpu")
+    verify = os.environ.get("LIBTPU_SKIP_DLOPEN", "").lower() != "true"
+    try:
+        path = install_libtpu(install_dir, channel, src, verify_dlopen=verify)
+    except (OSError, FileNotFoundError) as e:
+        log.error("libtpu install failed: %s", e)
+        return 1
+    barrier.write_status(".driver-ctr-ready", {
+        "LIBTPU_PATH": path,
+        "CHANNEL": channel,
+    })
+    log.info("libtpu ready at %s; gate .driver-ctr-ready open", path)
+    if args.action == "run" and not args.no_park:
+        _park()  # pragma: no cover
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tpu-runtime-setup
+# ---------------------------------------------------------------------------
+
+
+def runtime_setup_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-runtime-setup")
+    p.add_argument("action", choices=["run", "verify"])
+    p.add_argument("--no-park", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    pattern = os.environ.get("DEVICE_PATH_GLOB", "/dev/accel*")
+    devices = sorted(glob.glob(pattern))
+    if not devices and os.environ.get("TPU_FAKE_CHIPS"):
+        devices = [f"/dev/accel{i}"
+                   for i in range(int(os.environ["TPU_FAKE_CHIPS"]))]
+    if not devices:
+        log.error("no TPU device nodes match %s", pattern)
+        return 1
+    env_file = os.path.join(str(barrier.validation_dir()), "..", "tpu-env")
+    env_file = os.path.normpath(env_file)
+    os.makedirs(os.path.dirname(env_file), exist_ok=True)
+    with open(env_file, "w") as f:
+        f.write(f"TPU_DEVICES={','.join(devices)}\n")
+        for key in ("TPU_TOPOLOGY", "TPU_WORKER_ID", "TPU_ACCELERATOR_TYPE"):
+            if os.environ.get(key):
+                f.write(f"{key}={os.environ[key]}\n")
+    log.info("runtime contract written to %s (%d devices)", env_file,
+             len(devices))
+    if args.action == "run" and not args.no_park:
+        _park()  # pragma: no cover
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tpu-device-plugin
+# ---------------------------------------------------------------------------
+
+
+def device_plugin_main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    from ..deviceplugin.plugin import TPUDevicePlugin
+
+    plugin = TPUDevicePlugin(
+        resource_name=os.environ.get("RESOURCE_NAME", "google.com/tpu"))
+    try:
+        plugin.serve_forever(register=True)
+    except KeyboardInterrupt:
+        plugin.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    prog = os.path.basename(sys.argv[0])
+    mains = {
+        "tpu-driver-manager": driver_manager_main,
+        "libtpu-install": libtpu_install_main,
+        "tpu-runtime-setup": runtime_setup_main,
+        "tpu-device-plugin": device_plugin_main,
+    }
+    sys.exit(mains.get(prog, libtpu_install_main)())

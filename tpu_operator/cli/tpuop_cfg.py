@@ -1,0 +1,171 @@
+"""tpuop-cfg: offline configuration tooling (cmd/gpuop-cfg analog).
+
+    tpuop-cfg validate clusterpolicy -f policy.yaml
+    tpuop-cfg validate tpudriver -f driver.yaml
+    tpuop-cfg generate crds|operator|all [-n NAMESPACE] [--image IMG]
+
+``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
+schema conformance against the generated CRD (unknown fields, wrong
+types, enum violations), and that every operand image reference is
+resolvable to a concrete path (cmd/gpuop-cfg/validate/clusterpolicy/
+images.go analog — without the registry round-trip, which needs network).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, List, Tuple
+
+import yaml
+
+from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
+from ..api.crd import cluster_policy_crd, tpu_driver_crd
+
+
+def _schema_errors(obj: Any, schema: dict, path: str = "") -> List[str]:
+    """Minimal openAPIV3Schema checker: types, enums, unknown properties."""
+    errs: List[str] = []
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return errs
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(obj, dict):
+            return [f"{path or '.'}: expected object, got {type(obj).__name__}"]
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        for k, v in obj.items():
+            if v is None:
+                continue
+            sub = None
+            if props and k in props:
+                sub = props[k]
+            elif addl:
+                sub = addl
+            elif props is not None:
+                errs.append(f"{path}/{k}: unknown field")
+                continue
+            if sub:
+                errs.extend(_schema_errors(v, sub, f"{path}/{k}"))
+    elif t == "array":
+        if not isinstance(obj, list):
+            return [f"{path}: expected array, got {type(obj).__name__}"]
+        for i, v in enumerate(obj):
+            errs.extend(_schema_errors(v, schema.get("items", {}),
+                                       f"{path}[{i}]"))
+    elif t == "string":
+        if not isinstance(obj, str):
+            errs.append(f"{path}: expected string, got {type(obj).__name__}")
+        elif "enum" in schema and obj not in schema["enum"]:
+            errs.append(f"{path}: {obj!r} not in {schema['enum']}")
+    elif t == "integer":
+        if not isinstance(obj, int) or isinstance(obj, bool):
+            errs.append(f"{path}: expected integer, got {type(obj).__name__}")
+    elif t == "number":
+        if not isinstance(obj, (int, float)) or isinstance(obj, bool):
+            errs.append(f"{path}: expected number, got {type(obj).__name__}")
+    elif t == "boolean":
+        if not isinstance(obj, bool):
+            errs.append(f"{path}: expected boolean, got {type(obj).__name__}")
+    return errs
+
+
+def _image_errors(cr: dict) -> List[str]:
+    """Every operand with explicit image fields must resolve."""
+    from ..api.image import image_path
+
+    errs = []
+    spec = cr.get("spec") or {}
+    for component, body in spec.items():
+        if not isinstance(body, dict):
+            continue
+        fields = {k: body.get(k) for k in ("repository", "image", "version")}
+        if not any(fields.values()):
+            continue  # built-in defaults apply
+        try:
+            image_path(component, fields["repository"], fields["image"],
+                       fields["version"])
+        except ValueError as e:
+            errs.append(f"/spec/{component}: {e}")
+    return errs
+
+
+def validate_cr(cr: dict) -> Tuple[List[str], str]:
+    kind = cr.get("kind", "")
+    if kind == KIND_CLUSTER_POLICY:
+        crd, want_av = cluster_policy_crd(), V1
+    elif kind == KIND_TPU_DRIVER:
+        crd, want_av = tpu_driver_crd(), V1ALPHA1
+    else:
+        return ([f"unsupported kind {kind!r}"], kind)
+    errs = []
+    if cr.get("apiVersion") != want_av:
+        errs.append(f"apiVersion: want {want_av}, got {cr.get('apiVersion')}")
+    if not (cr.get("metadata") or {}).get("name"):
+        errs.append("metadata.name: required")
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    errs.extend(_schema_errors(cr.get("spec") or {},
+                               schema["properties"]["spec"], "/spec"))
+    errs.extend(_image_errors(cr))
+    return errs, kind
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpuop-cfg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="offline CR validation")
+    v.add_argument("what", choices=["clusterpolicy", "tpudriver"])
+    v.add_argument("-f", "--file", required=True)
+
+    g = sub.add_parser("generate", help="emit deployment manifests")
+    g.add_argument("what", choices=["crds", "operator", "all"])
+    g.add_argument("-n", "--namespace", default="tpu-operator")
+    g.add_argument("--image", default="")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "generate":
+        from ..deploy.packaging import generate
+
+        docs = generate(args.what, namespace=args.namespace, image=args.image)
+        try:
+            print(yaml.safe_dump_all(docs, sort_keys=False), end="")
+            sys.stdout.flush()
+        except BrokenPipeError:
+            # consumer (e.g. `| head`) closed the pipe — not an error
+            import os
+
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+            return 141 - 128  # conventional SIGPIPE-style exit, quiet
+        return 0
+
+    try:
+        with open(args.file) as f:
+            cr = yaml.safe_load(f)
+    except OSError as e:
+        print(f"cannot read {args.file}: {e.strerror}", file=sys.stderr)
+        return 1
+    except yaml.YAMLError as e:
+        print(f"invalid YAML: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(cr, dict):
+        print("file does not contain a mapping", file=sys.stderr)
+        return 1
+    want_kind = {"clusterpolicy": KIND_CLUSTER_POLICY,
+                 "tpudriver": KIND_TPU_DRIVER}[args.what]
+    if cr.get("kind") != want_kind:
+        print(f"INVALID kind: validating a {args.what} requires kind "
+              f"{want_kind}, file has {cr.get('kind')!r}", file=sys.stderr)
+        return 1
+    errs, kind = validate_cr(cr)
+    if errs:
+        for e in errs:
+            print(f"INVALID {e}", file=sys.stderr)
+        return 1
+    print(f"{kind} {(cr.get('metadata') or {}).get('name')!r} is valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

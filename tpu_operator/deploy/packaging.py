@@ -1,0 +1,154 @@
+"""Deployment manifest generation — the Helm-chart slot.
+
+The reference packages via a 546-line values.yaml Helm chart rendering the
+ClusterPolicy CR plus operator Deployment/RBAC
+(deployments/gpu-operator/). Here the same artifacts are generated from
+code, so they cannot drift from the API types:
+
+    tpuop-cfg generate crds     # both CRDs (from the dataclass schemas)
+    tpuop-cfg generate operator # namespace + RBAC + Deployment + sample CR
+    tpuop-cfg generate all
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import __version__
+from ..api.crd import all_crds
+
+
+def namespace_manifest(namespace: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": namespace}}
+
+
+def service_account(namespace: str) -> dict:
+    return {"apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": "tpu-operator", "namespace": namespace}}
+
+
+def cluster_role() -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "tpu-operator"},
+        "rules": [
+            {"apiGroups": ["tpu.graft.dev"],
+             "resources": ["tpuclusterpolicies", "tpudrivers",
+                           "tpuclusterpolicies/status", "tpudrivers/status"],
+             "verbs": ["get", "list", "watch", "update", "patch"]},
+            {"apiGroups": [""],
+             "resources": ["nodes"],
+             "verbs": ["get", "list", "watch", "patch"]},
+            {"apiGroups": [""],
+             "resources": ["pods", "pods/eviction", "services",
+                           "serviceaccounts", "configmaps", "namespaces",
+                           "endpoints"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch",
+                       "delete"]},
+            {"apiGroups": ["apps"],
+             "resources": ["daemonsets", "deployments", "controllerrevisions"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch",
+                       "delete"]},
+            {"apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["roles", "rolebindings", "clusterroles",
+                           "clusterrolebindings"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch",
+                       "delete"]},
+            {"apiGroups": ["node.k8s.io"],
+             "resources": ["runtimeclasses"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch",
+                       "delete"]},
+            {"apiGroups": ["coordination.k8s.io"],
+             "resources": ["leases"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch"]},
+            {"apiGroups": ["monitoring.coreos.com"],
+             "resources": ["servicemonitors"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch",
+                       "delete"]},
+        ],
+    }
+
+
+def cluster_role_binding(namespace: str) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "tpu-operator"},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "tpu-operator"},
+        "subjects": [{"kind": "ServiceAccount", "name": "tpu-operator",
+                      "namespace": namespace}],
+    }
+
+
+def operator_deployment(namespace: str, image: str) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "tpu-operator", "namespace": namespace,
+                     "labels": {"app": "tpu-operator"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "tpu-operator"}},
+            "template": {
+                "metadata": {"labels": {"app": "tpu-operator"}},
+                "spec": {
+                    "serviceAccountName": "tpu-operator",
+                    "priorityClassName": "system-cluster-critical",
+                    "containers": [{
+                        "name": "tpu-operator",
+                        "image": image,
+                        "command": ["tpu-operator", "--health-port", "8080"],
+                        "env": [{"name": "OPERATOR_NAMESPACE",
+                                 "valueFrom": {"fieldRef": {
+                                     "fieldPath": "metadata.namespace"}}}],
+                        "ports": [{"name": "metrics", "containerPort": 8080}],
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8080},
+                            "initialDelaySeconds": 10,
+                            "periodSeconds": 20},
+                        "readinessProbe": {
+                            "httpGet": {"path": "/readyz", "port": 8080},
+                            "initialDelaySeconds": 5,
+                            "periodSeconds": 10},
+                    }],
+                },
+            },
+        },
+    }
+
+
+def sample_cluster_policy() -> dict:
+    from ..api import new_cluster_policy
+
+    cr = new_cluster_policy()
+    cr["spec"] = {
+        "libtpu": {"channel": "stable"},
+        "metricsExporter": {"serviceMonitor": False},
+        "validator": {"matmulSize": 4096, "iciBandwidthThreshold": 0.8},
+        "upgradePolicy": {"autoUpgrade": False, "maxParallelUpgrades": 1},
+    }
+    return cr
+
+
+def generate(what: str, namespace: str = "tpu-operator",
+             image: str = "") -> List[dict]:
+    image = image or f"ghcr.io/tpu-operator/tpu-operator:v{__version__}"
+    crds = all_crds()
+    operator = [
+        namespace_manifest(namespace),
+        service_account(namespace),
+        cluster_role(),
+        cluster_role_binding(namespace),
+        operator_deployment(namespace, image),
+        sample_cluster_policy(),
+    ]
+    if what == "crds":
+        return crds
+    if what == "operator":
+        return operator
+    if what == "all":
+        return crds + operator
+    raise ValueError(f"unknown target {what!r} (crds|operator|all)")

@@ -1,0 +1,293 @@
+"""TPU kubelet device plugin: advertises google.com/tpu.
+
+The k8s-device-plugin slot (SURVEY.md section 2.4 row 3): a gRPC server on
+a unix socket under /var/lib/kubelet/device-plugins/ that registers with
+kubelet and serves the v1beta1 DevicePlugin API. One google.com/tpu is
+advertised per discovered chip; Allocate hands containers their
+/dev/accel* device nodes plus the TPU env contract.
+
+The gRPC services are wired with generic handlers over the
+protoc-generated message classes (api_pb2) — no grpc codegen plugin is
+required at build time.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from . import api_pb2 as pb
+
+log = logging.getLogger("tpu_device_plugin")
+
+KUBELET_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
+KUBELET_SOCKET = "kubelet.sock"
+PLUGIN_SOCKET = "tpu-device-plugin.sock"
+API_VERSION = "v1beta1"
+DEFAULT_RESOURCE = "google.com/tpu"
+
+_SVC_PLUGIN = "v1beta1.DevicePlugin"
+_SVC_REGISTRATION = "v1beta1.Registration"
+
+
+# ---------------------------------------------------------------------------
+# device discovery
+# ---------------------------------------------------------------------------
+
+
+def discover_chips() -> List[str]:
+    """Chip IDs on this host. Sources: TPU_FAKE_CHIPS (tests), then
+    /dev/accel* (TPU VMs), then /dev/vfio (passthrough)."""
+    fake = os.environ.get("TPU_FAKE_CHIPS")
+    if fake:
+        return [f"accel{i}" for i in range(int(fake))]
+    paths = sorted(glob.glob("/dev/accel*"))
+    if not paths:
+        paths = sorted(p for p in glob.glob("/dev/vfio/*")
+                       if os.path.basename(p) != "vfio")
+    return [os.path.basename(p) for p in paths]
+
+
+def discover_devices() -> List[pb.Device]:
+    """Advertised allocation units. Without a slice config each chip is one
+    device; with one (written by the topology manager,
+    topology/manager.py), each sub-slice group is one device — allocating
+    a unit grants all its chips, preserving ICI locality."""
+    groups = slice_groups()
+    if groups:
+        return [pb.Device(ID=f"slice{i}", health="Healthy")
+                for i in range(len(groups))]
+    return [pb.Device(ID=c, health="Healthy") for c in discover_chips()]
+
+
+def slice_groups() -> Optional[Dict[str, List[str]]]:
+    """slice-unit ID -> member chip IDs, from the topology manager's file."""
+    from ..topology.manager import DEFAULT_SLICE_FILE, read_slice_file
+
+    cfg = read_slice_file(os.environ.get("TPU_SLICE_FILE",
+                                         DEFAULT_SLICE_FILE))
+    if not cfg or not cfg.get("groups"):
+        return None
+    if int(cfg.get("subslices", 1)) <= 1:
+        return None  # full profile: advertise per chip
+    return {f"slice{i}": g for i, g in enumerate(cfg["groups"])}
+
+
+def expand_to_chips(device_ids: List[str]) -> List[str]:
+    groups = slice_groups() or {}
+    chips: List[str] = []
+    for device_id in device_ids:
+        chips.extend(groups.get(device_id, [device_id]))
+    return chips
+
+
+def device_host_path(device_id: str) -> str:
+    if device_id.startswith("accel"):
+        return f"/dev/{device_id}"
+    return f"/dev/vfio/{device_id}"
+
+
+# ---------------------------------------------------------------------------
+# gRPC service wiring (generic handlers over api_pb2 messages)
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString)
+
+
+def _stream(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
+    return grpc.unary_stream_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString)
+
+
+class TPUDevicePlugin:
+    """The DevicePlugin service + kubelet registration client."""
+
+    def __init__(self, resource_name: str = DEFAULT_RESOURCE,
+                 socket_dir: str = KUBELET_SOCKET_DIR,
+                 plugin_socket: str = PLUGIN_SOCKET,
+                 discover: Callable[[], List[pb.Device]] = discover_devices,
+                 health_interval_s: float = 30.0):
+        self.resource_name = resource_name
+        self.socket_dir = socket_dir
+        self.plugin_socket = plugin_socket
+        self.discover = discover
+        self.health_interval_s = health_interval_s
+        self._devices: List[pb.Device] = []
+        self._cond = threading.Condition()
+        self._stopped = threading.Event()
+        self._server: Optional[grpc.Server] = None
+        self.allocations: List[Dict] = []  # audit trail of Allocate calls
+
+    # -- DevicePlugin RPCs -------------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        """Initial device list, then an update whenever discovery changes
+        (kubelet keeps this stream open for the plugin's lifetime). The
+        yield happens OUTSIDE the condition lock: gRPC may park the
+        generator mid-send on a stalled peer, and holding the lock there
+        would deadlock refresh_devices()/stop()."""
+        last: Optional[List[tuple]] = None
+        while not self._stopped.is_set():
+            response = None
+            with self._cond:
+                snapshot = [(d.ID, d.health) for d in self._devices]
+                if snapshot != last:
+                    last = snapshot
+                    response = pb.ListAndWatchResponse(
+                        devices=list(self._devices))
+                else:
+                    self._cond.wait(timeout=1.0)
+            if response is not None:
+                yield response
+
+    def GetPreferredAllocation(self, request, context):
+        """Prefer low-numbered contiguous chips — neighboring chips share
+        ICI links, so contiguous allocation preserves torus locality."""
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            ids = sorted(creq.available_deviceIDs)
+            must = list(creq.must_include_deviceIDs)
+            picked = must + [i for i in ids if i not in must]
+            resp.container_responses.add(
+                deviceIDs=picked[:creq.allocation_size])
+        return resp
+
+    def Allocate(self, request, context):
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
+            chips = expand_to_chips(ids)  # slice units -> member chips
+            cresp = resp.container_responses.add()
+            for chip in chips:
+                host = device_host_path(chip)
+                cresp.devices.add(container_path=host, host_path=host,
+                                  permissions="rw")
+            # the TPU env contract workloads expect
+            cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(
+                c.removeprefix("accel") for c in chips)
+            cresp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(chips)}"
+            cresp.envs["TPU_RUNTIME_METRICS_PORTS"] = ""
+            self.allocations.append({"devices": ids, "chips": chips})
+            log.info("allocated %s -> chips %s", ids, chips)
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(_SVC_PLUGIN, {
+            "GetDevicePluginOptions": _unary(self.GetDevicePluginOptions,
+                                             pb.Empty,
+                                             pb.DevicePluginOptions),
+            "ListAndWatch": _stream(self.ListAndWatch, pb.Empty,
+                                    pb.ListAndWatchResponse),
+            "GetPreferredAllocation": _unary(self.GetPreferredAllocation,
+                                             pb.PreferredAllocationRequest,
+                                             pb.PreferredAllocationResponse),
+            "Allocate": _unary(self.Allocate, pb.AllocateRequest,
+                               pb.AllocateResponse),
+            "PreStartContainer": _unary(self.PreStartContainer,
+                                        pb.PreStartContainerRequest,
+                                        pb.PreStartContainerResponse),
+        })
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.socket_dir, self.plugin_socket)
+
+    def refresh_devices(self) -> None:
+        devices = self.discover()
+        with self._cond:
+            if [(d.ID, d.health) for d in devices] != \
+                    [(d.ID, d.health) for d in self._devices]:
+                self._devices = devices
+                log.info("device inventory: %s",
+                         [(d.ID, d.health) for d in devices])
+            self._cond.notify_all()
+
+    def _health_loop(self):
+        while not self._stopped.wait(self.health_interval_s):
+            try:
+                self.refresh_devices()
+            except Exception:
+                log.exception("device re-discovery failed")
+
+    def start(self) -> None:
+        os.makedirs(self.socket_dir, exist_ok=True)
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self.refresh_devices()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        threading.Thread(target=self._health_loop, daemon=True).start()
+        log.info("device plugin serving on %s (%d devices)",
+                 self.socket_path, len(self._devices))
+
+    def register_with_kubelet(self, kubelet_socket: Optional[str] = None,
+                              timeout: float = 10.0) -> None:
+        """Dial kubelet's registration socket and announce ourselves."""
+        target = f"unix://{kubelet_socket or os.path.join(self.socket_dir, KUBELET_SOCKET)}"
+        with grpc.insecure_channel(target) as channel:
+            grpc.channel_ready_future(channel).result(timeout=timeout)
+            register = channel.unary_unary(
+                f"/{_SVC_REGISTRATION}/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString)
+            register(pb.RegisterRequest(
+                version=API_VERSION,
+                endpoint=self.plugin_socket,
+                resource_name=self.resource_name,
+                options=pb.DevicePluginOptions(
+                    get_preferred_allocation_available=True)),
+                timeout=timeout)
+        log.info("registered %s with kubelet", self.resource_name)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._server:
+            self._server.stop(grace=1.0)
+
+    def serve_forever(self, register: bool = True) -> None:
+        """Entrypoint for the DaemonSet container: serve, register, and
+        re-register if kubelet restarts (its socket gets recreated)."""
+        self.start()
+        kubelet_sock = os.path.join(self.socket_dir, KUBELET_SOCKET)
+        registered_ino = None
+        while not self._stopped.is_set():
+            if register and os.path.exists(kubelet_sock):
+                try:
+                    ino = os.stat(kubelet_sock).st_ino
+                except OSError:
+                    ino = None
+                if ino is not None and ino != registered_ino:
+                    try:
+                        self.register_with_kubelet()
+                        registered_ino = ino
+                    except Exception as e:
+                        log.warning("kubelet registration failed: %s", e)
+            self._stopped.wait(5.0)

@@ -1,0 +1,194 @@
+"""libtpu metrics exporter — the DCGM + dcgm-exporter slot.
+
+Per-chip telemetry as Prometheus gauges (duty cycle, HBM usage, tensorcore
+utilization, temperature), collected through pluggable backends:
+
+- ``fake``:  deterministic values for tests/fake clusters (TPU_FAKE_CHIPS)
+- ``sysfs``: /sys/class/accel* counters where the TPU VM kernel exposes
+             them
+- ``jax``:   live chip introspection via the JAX backend's memory stats
+             (requires exclusive libtpu access, so only for dedicated
+             monitoring deployments: LIBTPU_EXPORTER_USE_JAX=true)
+
+The exporter deliberately holds no libtpu handle by default: on TPU VMs
+libtpu is single-client, and stealing it from the workload would be the
+monitoring system breaking the thing it monitors (the reason DCGM runs a
+separate host engine in the reference, assets/state-dcgm).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+log = logging.getLogger("libtpu_exporter")
+
+
+class ChipSample:
+    def __init__(self, chip_id: str, duty_cycle_pct: float = 0.0,
+                 hbm_used: int = 0, hbm_total: int = 0,
+                 tensorcore_util_pct: float = 0.0,
+                 temperature_c: Optional[float] = None):
+        self.chip_id = chip_id
+        self.duty_cycle_pct = duty_cycle_pct
+        self.hbm_used = hbm_used
+        self.hbm_total = hbm_total
+        self.tensorcore_util_pct = tensorcore_util_pct
+        self.temperature_c = temperature_c
+
+
+def collect_fake() -> List[ChipSample]:
+    n = int(os.environ.get("TPU_FAKE_CHIPS", "0") or 0)
+    return [ChipSample(f"accel{i}", duty_cycle_pct=50.0 + i,
+                       hbm_used=(i + 1) * (1 << 30), hbm_total=16 << 30,
+                       tensorcore_util_pct=40.0 + i, temperature_c=45.0 + i)
+            for i in range(n)]
+
+
+def collect_sysfs() -> List[ChipSample]:
+    out = []
+    for path in sorted(glob.glob("/sys/class/accel/accel*")):
+        chip_id = os.path.basename(path)
+
+        def read_int(name, default=0):
+            try:
+                with open(os.path.join(path, name)) as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                return default
+
+        out.append(ChipSample(
+            chip_id,
+            duty_cycle_pct=read_int("duty_cycle_pct"),
+            hbm_used=read_int("hbm_used_bytes"),
+            hbm_total=read_int("hbm_total_bytes"),
+            temperature_c=read_int("temp_millic", 0) / 1000.0 or None))
+    return out
+
+
+def collect_jax() -> List[ChipSample]:
+    import jax
+
+    out = []
+    for d in jax.devices():
+        if d.platform == "cpu":
+            continue
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append(ChipSample(
+            f"chip{d.id}",
+            hbm_used=stats.get("bytes_in_use", 0),
+            hbm_total=stats.get("bytes_limit", 0)))
+    return out
+
+
+def collect() -> List[ChipSample]:
+    if os.environ.get("TPU_FAKE_CHIPS"):
+        return collect_fake()
+    samples = collect_sysfs()
+    if samples:
+        return samples
+    if os.environ.get("LIBTPU_EXPORTER_USE_JAX", "").lower() == "true":
+        return collect_jax()
+    return []
+
+
+class LibtpuExporter:
+    def __init__(self, node_name: str = ""):
+        self.node_name = node_name
+        self.registry = CollectorRegistry()
+        labels = ("chip", "node")
+        g = lambda name, doc: Gauge(name, doc, labelnames=labels,
+                                    registry=self.registry)
+        self.duty_cycle = g("tpu_duty_cycle_percent",
+                            "TensorCore duty cycle (%)")
+        self.hbm_used = g("tpu_hbm_used_bytes", "HBM bytes in use")
+        self.hbm_total = g("tpu_hbm_total_bytes", "HBM capacity bytes")
+        self.tc_util = g("tpu_tensorcore_utilization_percent",
+                         "TensorCore utilization (%)")
+        self.temperature = g("tpu_temperature_celsius", "Chip temperature")
+        self.chips = Gauge("tpu_chips_total", "Chips visible to the exporter",
+                           labelnames=("node",), registry=self.registry)
+
+    def collect_once(self) -> int:
+        samples = collect()
+        # drop series for chips that disappeared — serving a vanished
+        # chip's last values forever would hide the failure from alerts
+        for gauge in (self.duty_cycle, self.hbm_used, self.hbm_total,
+                      self.tc_util, self.temperature):
+            gauge.clear()
+        self.chips.labels(node=self.node_name).set(len(samples))
+        for s in samples:
+            lab = dict(chip=s.chip_id, node=self.node_name)
+            self.duty_cycle.labels(**lab).set(s.duty_cycle_pct)
+            self.hbm_used.labels(**lab).set(s.hbm_used)
+            self.hbm_total.labels(**lab).set(s.hbm_total)
+            self.tc_util.labels(**lab).set(s.tensorcore_util_pct)
+            if s.temperature_c is not None:
+                self.temperature.labels(**lab).set(s.temperature_c)
+        return len(samples)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def serve(port: int, node_name: str = "", interval: float = 15.0,
+          stop_event: Optional[threading.Event] = None) -> ThreadingHTTPServer:
+    exporter = LibtpuExporter(node_name)
+    exporter.collect_once()
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            try:
+                exporter.collect_once()
+            except Exception:
+                log.exception("collection failed")
+
+    threading.Thread(target=loop, daemon=True).start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body, code, ctype = exporter.render(), 200, \
+                    "text/plain; version=0.0.4"
+            elif self.path == "/healthz":
+                body, code, ctype = b"ok", 200, "text/plain"
+            else:
+                body, code, ctype = b"not found", 404, "text/plain"
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    log.info("libtpu metrics exporter on :%d", server.server_address[1])
+    return server
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    port = int(os.environ.get("METRICS_PORT", "9400"))
+    interval = float(os.environ.get("COLLECTION_INTERVAL", "15"))
+    serve(port, node_name=os.environ.get("NODE_NAME", ""), interval=interval)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

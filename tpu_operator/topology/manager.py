@@ -1,0 +1,196 @@
+"""TPU topology/slice manager — the mig-manager slot.
+
+The reference's MIG manager watches ``nvidia.com/mig.config`` on its node
+and re-partitions GPUs to the named profile (object_controls.go:1688,
+state_manager.go:50). The TPU analog shapes *slices*: the node label
+``tpu.graft.dev/slice.config`` names a profile from the profiles
+ConfigMap; the manager resolves it into chip groups, publishes the
+grouping to the device plugin through a shared hostPath file
+(/run/tpu/slice-config.json), and reports via
+``tpu.graft.dev/slice.config.state`` (pending|success|failed).
+
+**Multi-host slices are grouped** (SURVEY.md section 7 "genuinely new
+design"): when the node's topology spans hosts, all nodes of the pool
+must request the same profile before any of them flips to success —
+a half-reconfigured multi-host slice is not a usable TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import yaml
+
+from ..api import labels as L
+from ..runtime.client import Client
+from ..runtime.objects import get_nested, labels_of, name_of
+from ..state.nodepool import NodePool
+
+log = logging.getLogger("tpu_topology_manager")
+
+DEFAULT_SLICE_FILE = "/run/tpu/slice-config.json"
+
+STATE_PENDING = "pending"
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+
+@dataclass
+class Profile:
+    name: str
+    subslices: int
+    description: str = ""
+
+
+def load_profiles(config_file: str) -> Dict[str, Profile]:
+    with open(config_file) as f:
+        raw = yaml.safe_load(f) or {}
+    out = {}
+    for name, body in (raw.get("profiles") or {}).items():
+        out[name] = Profile(name=name,
+                            subslices=int(body.get("subslices", 1)),
+                            description=body.get("description", ""))
+    if not out:
+        raise ValueError(f"no profiles in {config_file}")
+    return out
+
+
+def chip_groups(chip_ids: List[str], subslices: int) -> List[List[str]]:
+    """Partition chips into contiguous groups — contiguous chips share ICI
+    links, so each sub-slice keeps torus locality."""
+    if subslices < 1 or len(chip_ids) % subslices:
+        raise ValueError(
+            f"cannot split {len(chip_ids)} chips into {subslices} sub-slices")
+    per = len(chip_ids) // subslices
+    return [chip_ids[i * per:(i + 1) * per] for i in range(subslices)]
+
+
+def write_slice_file(path: str, profile: Profile,
+                     groups: List[List[str]]) -> None:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(json.dumps({
+        "profile": profile.name,
+        "subslices": profile.subslices,
+        "groups": groups,
+    }, indent=2))
+    tmp.rename(p)
+
+
+def read_slice_file(path: str = DEFAULT_SLICE_FILE) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class TopologyManager:
+    def __init__(self, client: Client, node_name: str, config_file: str,
+                 default_profile: str = "full",
+                 slice_file: str = DEFAULT_SLICE_FILE):
+        self.client = client
+        self.node_name = node_name
+        self.profiles = load_profiles(config_file)
+        self.default_profile = default_profile
+        self.slice_file = slice_file
+
+    def _set_state(self, state: str) -> None:
+        self.client.patch("v1", "Node", self.node_name,
+                          {"metadata": {"labels":
+                                        {L.SLICE_CONFIG_STATE: state}}})
+
+    def _pool_peers(self, node: dict) -> List[dict]:
+        """Nodes in the same (accelerator, topology) pool as this node."""
+        nl = labels_of(node)
+        accel = nl.get(L.GKE_TPU_ACCELERATOR, "")
+        topo = nl.get(L.GKE_TPU_TOPOLOGY, "")
+        return [n for n in self.client.list("v1", "Node")
+                if labels_of(n).get(L.GKE_TPU_ACCELERATOR) == accel
+                and labels_of(n).get(L.GKE_TPU_TOPOLOGY) == topo]
+
+    def apply_once(self) -> str:
+        """One reconcile pass; returns the state written to the node."""
+        node = self.client.get("v1", "Node", self.node_name)
+        nl = labels_of(node)
+        wanted = nl.get(L.SLICE_CONFIG, self.default_profile)
+        profile = self.profiles.get(wanted)
+        if profile is None:
+            log.error("unknown slice profile %r (have %s)", wanted,
+                      sorted(self.profiles))
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+
+        pool = NodePool(
+            accelerator=nl.get(L.GKE_TPU_ACCELERATOR, ""),
+            topology=nl.get(L.GKE_TPU_TOPOLOGY, ""))
+        if pool.multi_host:
+            # grouped semantics: every host of the slice must agree first
+            peers = self._pool_peers(node)
+            disagreeing = [
+                name_of(p) for p in peers
+                if labels_of(p).get(L.SLICE_CONFIG,
+                                    self.default_profile) != wanted]
+            if disagreeing:
+                log.info("multi-host pool not converged on %r yet "
+                         "(disagreeing: %s)", wanted, disagreeing)
+                self._set_state(STATE_PENDING)
+                return STATE_PENDING
+
+        chips = int(nl.get(L.TPU_CHIP_COUNT) or
+                    get_nested(node, "status", "allocatable", L.TPU_RESOURCE,
+                               default="0") or 0)
+        if chips == 0:
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+        # use the real device names where discoverable (vfio hosts don't
+        # name chips accelN); synthesize only as a last resort
+        from ..deviceplugin.plugin import discover_chips
+
+        chip_ids = discover_chips() or [f"accel{i}" for i in range(chips)]
+        if len(chip_ids) != chips:
+            log.warning("label says %d chips but %d device nodes found; "
+                        "using device nodes", chips, len(chip_ids))
+        try:
+            groups = chip_groups(chip_ids, profile.subslices)
+        except ValueError as e:
+            log.error("%s", e)
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+        write_slice_file(self.slice_file, profile, groups)
+        self._set_state(STATE_SUCCESS)
+        log.info("applied profile %r: %d sub-slice(s) of %d chip(s)",
+                 profile.name, profile.subslices, chips // profile.subslices)
+        return STATE_SUCCESS
+
+    def run_forever(self, interval: float = 15.0) -> None:  # pragma: no cover
+        while True:
+            try:
+                self.apply_once()
+            except Exception:
+                log.exception("slice reconcile failed")
+            time.sleep(interval)
+
+
+def main() -> int:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    mgr = TopologyManager(
+        client=HTTPClient(KubeConfig.load()),
+        node_name=os.environ["NODE_NAME"],
+        config_file=os.environ.get("CONFIG_FILE", "/config/config.yaml"),
+        default_profile=os.environ.get("DEFAULT_PROFILE", "full"))
+    mgr.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
