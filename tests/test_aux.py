@@ -1,0 +1,139 @@
+"""Auxiliary subsystems: nodeinfo, leader election, must-gather,
+operator metrics rendering."""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.controllers.nodeinfo import (
+    NodeFilter,
+    NodeInfoProvider,
+    attributes_of,
+)
+from tpu_operator.runtime import FakeClient
+from tpu_operator.runtime.leaderelection import LeaderElector
+
+
+def v5p_node(c, name, extra=None, **kw):
+    return c.add_node(name, labels={
+        L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+        L.GKE_TPU_TOPOLOGY: "2x2x1",
+        L.GKE_ACCELERATOR_COUNT: "4", **(extra or {})},
+        allocatable={"google.com/tpu": "4"}, **kw)
+
+
+class TestNodeInfo:
+    def test_attributes_extraction(self):
+        c = FakeClient()
+        v5p_node(c, "tpu-0", extra={L.UPGRADE_STATE: "done"})
+        attrs = attributes_of(c.get("v1", "Node", "tpu-0"))
+        assert attrs.is_tpu
+        assert attrs.generation == "v5p"
+        assert attrs.topology == "2x2x1"
+        assert attrs.chip_count == 4
+        assert attrs.schedulable
+        assert attrs.upgrade_state == "done"
+
+    def test_cpu_node_not_tpu(self):
+        c = FakeClient()
+        c.add_node("cpu-0")
+        assert not attributes_of(c.get("v1", "Node", "cpu-0")).is_tpu
+
+    def test_filters_compose(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        v5p_node(c, "b", extra={"pool": "x"})
+        c.add_node("cpu-0")
+        provider = NodeInfoProvider(c)
+        assert len(provider.tpu_nodes()) == 2
+        got = provider.nodes(NodeFilter().tpu_only().with_label("pool", "x"))
+        assert [n["metadata"]["name"] for n in got] == ["b"]
+        got = provider.nodes(NodeFilter().without_label("pool"))
+        assert len(got) == 2  # a + cpu-0
+
+    def test_schedulable_filter(self):
+        c = FakeClient()
+        v5p_node(c, "a")
+        node = c.get("v1", "Node", "a")
+        node["spec"]["unschedulable"] = True
+        c.update(node)
+        assert NodeInfoProvider(c).nodes(NodeFilter().schedulable()) == []
+
+
+class TestLeaderElection:
+    def test_first_candidate_wins(self):
+        c = FakeClient()
+        e = LeaderElector(c, identity="a")
+        assert e.try_acquire_or_renew()
+        lease = c.get("coordination.k8s.io/v1", "Lease", "tpu-operator",
+                      "tpu-operator")
+        assert lease["spec"]["holderIdentity"] == "a"
+
+    def test_second_candidate_blocked_until_expiry(self):
+        c = FakeClient()
+        a = LeaderElector(c, identity="a", lease_duration_s=1.0)
+        b = LeaderElector(c, identity="b", lease_duration_s=1.0)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        time.sleep(1.1)  # lease expires without renewal
+        assert b.try_acquire_or_renew()
+        lease = c.get("coordination.k8s.io/v1", "Lease", "tpu-operator",
+                      "tpu-operator")
+        assert lease["spec"]["holderIdentity"] == "b"
+
+    def test_holder_renews(self):
+        c = FakeClient()
+        a = LeaderElector(c, identity="a", lease_duration_s=1.0)
+        assert a.try_acquire_or_renew()
+        time.sleep(0.6)
+        assert a.try_acquire_or_renew()  # renewal resets the clock
+        b = LeaderElector(c, identity="b", lease_duration_s=1.0)
+        time.sleep(0.6)  # only 0.6 since renew: not expired
+        assert not b.try_acquire_or_renew()
+
+    def test_callbacks_and_release(self):
+        c = FakeClient()
+        events = []
+        a = LeaderElector(c, identity="a", renew_interval_s=0.05,
+                          on_started_leading=lambda: events.append("up"))
+        a.start()
+        deadline = time.monotonic() + 5
+        while "up" not in events and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert events == ["up"]
+        a.stop(release=True)
+        assert c.get_or_none("coordination.k8s.io/v1", "Lease",
+                             "tpu-operator", "tpu-operator") is None
+
+    def test_manager_gates_controllers_on_leadership(self):
+        from tpu_operator.runtime import Manager
+
+        c = FakeClient()
+        mgr = Manager(c, leader_elect=True)
+        mgr.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not (mgr.elector and mgr.elector.is_leader):
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        finally:
+            mgr.stop()
+
+
+class TestMustGather:
+    def test_fake_demo_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_VALIDATION_DIR", str(tmp_path / "val"))
+        from tpu_operator.cli.must_gather import main
+
+        out = tmp_path / "bundle"
+        assert main(["--fake-demo", "-o", str(out)]) == 0
+        summary = json.loads((out / "summary.json").read_text())
+        assert summary["kinds"]["TPUClusterPolicy"] == 1
+        assert summary["kinds"]["DaemonSet"] >= 7
+        crs = list((out / "crs").glob("*.yaml"))
+        assert any("tpuclusterpolicy" in f.name for f in crs)
+        nodes = list((out / "nodes").glob("*.yaml"))
+        assert len(nodes) == 1

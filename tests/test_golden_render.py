@@ -1,0 +1,93 @@
+"""Golden-file render tests (internal/state/driver_test.go:44,63-670
+pattern): render each operand state for a set of spec permutations and
+diff the full object stream against checked-in goldens.
+
+Regenerate after intentional manifest changes:
+
+    python -m tests.test_golden_render --update
+"""
+
+import pathlib
+import sys
+
+import pytest
+import yaml
+
+from tpu_operator.api.clusterpolicy import TPUClusterPolicySpec, new_cluster_policy
+from tpu_operator.state.operands import build_states
+from tpu_operator.state.state import SyncContext
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "testdata" / "golden"
+
+# (name, policy spec) permutations — the driver_test.go spec matrix analog
+PERMUTATIONS = {
+    "minimal": {},
+    "custom-images": {
+        "libtpu": {"repository": "gcr.io/custom", "image": "my-libtpu",
+                   "version": "9.9.9", "installDir": "/opt/custom-libtpu"},
+        "devicePlugin": {"repository": "gcr.io/custom", "image": "my-dp",
+                         "version": "1.2.3"},
+    },
+    "ondelete-strategy": {
+        "daemonsets": {"updateStrategy": "OnDelete",
+                       "priorityClassName": "high"},
+    },
+    "servicemonitor-on": {
+        "metricsExporter": {"serviceMonitor": True,
+                            "collectionIntervalSeconds": 30, "port": 9999},
+    },
+    "validator-tuned": {
+        "validator": {"matmulSize": 16384, "iciBandwidthThreshold": 0.9},
+        "tpuRuntime": {"enabled": False},
+        "devicePlugin": {"enabled": False},
+    },
+    "custom-hostpaths": {
+        "hostPaths": {"rootFS": "/host", "validationDir": "/var/run/tpu/v",
+                      "devDir": "/hostdev"},
+    },
+}
+
+
+def render_all(spec_dict) -> str:
+    policy = new_cluster_policy(spec=spec_dict)
+    spec = TPUClusterPolicySpec.from_obj(policy)
+    ctx = SyncContext(client=None, policy=policy, spec=spec,
+                      namespace="tpu-operator")
+    docs = []
+    for state in build_states():
+        if not state.enabled(ctx):
+            continue
+        for obj in state.renderer().render_objects(state._data_fn(ctx)):
+            docs.append(obj)
+    return yaml.safe_dump_all(docs, sort_keys=True)
+
+
+def golden_path(name: str) -> pathlib.Path:
+    return GOLDEN_DIR / f"{name}.yaml"
+
+
+@pytest.mark.parametrize("name", sorted(PERMUTATIONS))
+def test_golden(name):
+    rendered = render_all(PERMUTATIONS[name])
+    path = golden_path(name)
+    assert path.exists(), (
+        f"golden file {path} missing — run "
+        f"`python -m tests.test_golden_render --update`")
+    expected = path.read_text()
+    assert rendered == expected, (
+        f"rendered output for {name!r} drifted from golden; if intentional, "
+        f"regenerate with `python -m tests.test_golden_render --update`")
+
+
+def update_goldens():
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    for name, spec in PERMUTATIONS.items():
+        golden_path(name).write_text(render_all(spec))
+        print(f"wrote {golden_path(name)}")
+
+
+if __name__ == "__main__":
+    if "--update" in sys.argv:
+        update_goldens()
+    else:
+        print(__doc__)

@@ -97,6 +97,23 @@ class TestTopologyManager:
                               slice_file=str(tmp_path / "s.json"))
         assert mgr.apply_once() == STATE_FAILED
 
+    def test_independent_pools_of_same_shape_not_conflated(self, config_file,
+                                                           tmp_path):
+        """Two distinct nodepools with identical (accelerator, topology)
+        must form separate agreement groups."""
+        c = FakeClient()
+        tpu_node(c, "a-0", topology="4x4x4", slice_config="split-2",
+                 )
+        c.patch("v1", "Node", "a-0",
+                {"metadata": {"labels": {L.GKE_NODEPOOL: "pool-a"}}})
+        tpu_node(c, "b-0", topology="4x4x4", slice_config="full")
+        c.patch("v1", "Node", "b-0",
+                {"metadata": {"labels": {L.GKE_NODEPOOL: "pool-b"}}})
+        mgr = TopologyManager(c, "a-0", config_file,
+                              slice_file=str(tmp_path / "s.json"))
+        # pool-b's different profile must NOT block pool-a
+        assert mgr.apply_once() == STATE_SUCCESS
+
     def test_multi_host_waits_for_pool_agreement(self, config_file, tmp_path):
         """Grouped semantics: a 4x4x4 (multi-host) pool only applies once
         every host requests the same profile."""

@@ -104,6 +104,14 @@ class TestComponents:
         with pytest.raises(ValidationFailed, match="CPU backend"):
             validate_ici()
 
+    def test_hbm_triad_proof(self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_hbm
+
+        monkeypatch.setenv("HBM_SIZE_MB", "4")
+        info = validate_hbm(allow_cpu=True)
+        assert barrier.is_ready("hbm-ready")
+        assert float(info["BANDWIDTH_GBPS"]) > 0
+
     def test_ici_allreduce_proof(self, valdir, monkeypatch):
         # 8 virtual CPU devices (conftest); no ChipSpec for cpu so no
         # threshold assertion, but correctness is still proven. Keep the

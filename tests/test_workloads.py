@@ -69,6 +69,28 @@ class TestCollectives:
         assert res.bus_bw_gbps > 0
 
 
+class TestPallasProbe:
+    def test_triad_correct_in_interpret_mode(self):
+        from tpu_operator.workloads.pallas_probe import run, triad
+        import jax.numpy as jnp
+
+        out = triad(jnp.ones((128, 256), jnp.float32),
+                    jnp.full((128, 256), 2.0, jnp.float32),
+                    alpha=0.5, interpret=True)
+        assert bool(jnp.allclose(out, 2.0))
+        res = run(size_mb=2.0, iters=3, repeats=1, interpret=True)
+        assert res.correct
+        assert res.bandwidth_gbps > 0
+
+    def test_triad_rejects_misaligned_shapes(self):
+        from tpu_operator.workloads.pallas_probe import triad
+        import jax.numpy as jnp
+
+        with pytest.raises(AssertionError):
+            triad(jnp.ones((128, 100), jnp.float32),
+                  jnp.ones((128, 100), jnp.float32), interpret=True)
+
+
 class TestBurnin:
     CFG = BurninConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
                        d_ff=64, seq_len=16, batch=8)

@@ -9,6 +9,7 @@ labels play in labelGPUNodes (controllers/state_manager.go:479-581).
 GKE_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"  # e.g. tpu-v5p-slice
 GKE_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"        # e.g. 2x2x1
 GKE_ACCELERATOR_COUNT = "cloud.google.com/gke-accelerator-count"
+GKE_NODEPOOL = "cloud.google.com/gke-nodepool"                # pool identity
 
 # --- labels stamped by this operator --------------------------------------
 DOMAIN = "tpu.graft.dev"

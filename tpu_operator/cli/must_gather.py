@@ -1,0 +1,113 @@
+"""Diagnostic bundle collection (hack/must-gather.sh analog).
+
+    tpuop-must-gather [-o DIR] [--kubeconfig PATH | --fake-demo]
+
+Dumps everything a support engineer needs into a directory tree: the CRs
+with status/conditions, operand DaemonSets + pods, TPU node labels and
+upgrade states, operator metrics, and the validator barrier files when run
+on a node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import pathlib
+import sys
+
+import yaml
+
+log = logging.getLogger("tpuop-must-gather")
+
+DUMP_KINDS = [
+    ("tpu.graft.dev/v1", "TPUClusterPolicy", "crs"),
+    ("tpu.graft.dev/v1alpha1", "TPUDriver", "crs"),
+    ("v1", "Node", "nodes"),
+    ("apps/v1", "DaemonSet", "operands"),
+    ("v1", "Pod", "pods"),
+    ("v1", "ConfigMap", "config"),
+    ("v1", "Service", "operands"),
+    ("coordination.k8s.io/v1", "Lease", "leader"),
+]
+
+
+def gather(client, out_dir: pathlib.Path) -> dict:
+    summary = {"kinds": {}, "errors": []}
+    for api_version, kind, subdir in DUMP_KINDS:
+        try:
+            objs = client.list(api_version, kind)
+        except Exception as e:
+            summary["errors"].append(f"list {kind}: {e}")
+            continue
+        d = out_dir / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        for obj in objs:
+            name = obj.get("metadata", {}).get("name", "unnamed")
+            ns = obj.get("metadata", {}).get("namespace", "")
+            fname = f"{kind.lower()}_{ns + '_' if ns else ''}{name}.yaml"
+            (d / fname).write_text(yaml.safe_dump(obj, sort_keys=False))
+        summary["kinds"][kind] = len(objs)
+
+    # node-local barrier state, when run on a TPU node
+    from ..validator import barrier
+
+    vd = barrier.validation_dir()
+    if vd.is_dir():
+        d = out_dir / "node-local"
+        d.mkdir(parents=True, exist_ok=True)
+        for f in sorted(vd.iterdir()):
+            if f.is_file():
+                (d / f.name).write_text(f.read_text())
+        summary["validation_files"] = sorted(
+            f.name for f in vd.iterdir() if f.is_file())
+
+    (out_dir / "summary.json").write_text(json.dumps(summary, indent=2))
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpuop-must-gather")
+    p.add_argument("-o", "--output", default="must-gather")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--fake-demo", action="store_true",
+                   help="gather from an in-memory demo cluster (self-test)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.fake_demo:
+        from ..api import new_cluster_policy
+        from ..api import labels as L
+        from ..controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+        )
+        from ..runtime import FakeClient, Request
+
+        client = FakeClient()
+        client.add_node("tpu-0", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x1"},
+            allocatable={"google.com/tpu": "4"})
+        client.create(new_cluster_policy())
+        ClusterPolicyReconciler(client=client, namespace="tpu-operator"
+                                ).reconcile(Request(name="tpu-cluster-policy"))
+    else:
+        from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+        cfg = (KubeConfig.from_kubeconfig(args.kubeconfig)
+               if args.kubeconfig else KubeConfig.load())
+        client = HTTPClient(cfg)
+
+    out = pathlib.Path(args.output)
+    summary = gather(client, out)
+    log.info("gathered %s into %s",
+             {k: v for k, v in summary["kinds"].items() if v}, out)
+    if summary["errors"]:
+        for e in summary["errors"]:
+            log.warning("%s", e)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,13 +145,20 @@ def runtime_setup_main(argv=None) -> int:
     p.add_argument("--no-park", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    pattern = os.environ.get("DEVICE_PATH_GLOB", "/dev/accel*")
-    devices = sorted(glob.glob(pattern))
-    if not devices and os.environ.get("TPU_FAKE_CHIPS"):
-        devices = [f"/dev/accel{i}"
-                   for i in range(int(os.environ["TPU_FAKE_CHIPS"]))]
+    # same discovery the device plugin uses (fake -> /dev/accel* -> vfio),
+    # so the runtime contract stays consistent across operands
+    from ..deviceplugin.plugin import device_host_path, discover_chips
+
+    devices = [device_host_path(c) for c in discover_chips()]
+    pattern = os.environ.get("DEVICE_PATH_GLOB")
+    if pattern:  # explicit override narrows, never widens
+        import fnmatch
+
+        devices = [d for d in devices if fnmatch.fnmatch(d, pattern)] or \
+            sorted(glob.glob(pattern))
     if not devices:
-        log.error("no TPU device nodes match %s", pattern)
+        log.error("no TPU device nodes found (glob=%s)",
+                  pattern or "/dev/accel*, /dev/vfio/*")
         return 1
     env_file = os.path.join(str(barrier.validation_dir()), "..", "tpu-env")
     env_file = os.path.normpath(env_file)

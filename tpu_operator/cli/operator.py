@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TPU node count for --fake-cluster")
     p.add_argument("--once", action="store_true",
                    help="exit once the policy reaches ready (fake mode)")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="gate controllers behind a coordination.k8s.io "
+                        "Lease (for multi-replica deployments)")
     p.add_argument("--kubeconfig", default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
@@ -87,7 +90,8 @@ def main(argv=None) -> int:
         stop = None
 
     mgr = Manager(client, namespace=args.namespace,
-                  health_port=args.health_port)
+                  health_port=args.health_port,
+                  leader_elect=args.leader_elect)
     mgr.add_reconciler(
         ClusterPolicyReconciler(client=client, namespace=args.namespace))
     mgr.add_reconciler(

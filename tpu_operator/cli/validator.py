@@ -24,8 +24,8 @@ def build_parser() -> argparse.ArgumentParser:
                                 description="per-node TPU stack validator")
     sub = p.add_subparsers(dest="cmd")
     p.add_argument("-c", "--component", default=None,
-                   choices=["driver", "runtime", "jax", "ici", "plugin",
-                            "metrics", "sleep"])
+                   choices=["driver", "runtime", "jax", "ici", "hbm",
+                            "plugin", "metrics", "sleep"])
     p.add_argument("--pod-mode", action="store_true",
                    help="jax/plugin: spawn a workload pod via the apiserver "
                         "instead of running in-process")
@@ -90,6 +90,8 @@ def main(argv=None) -> int:
                     info = components.validate_jax()
             elif comp == "ici":
                 info = components.validate_ici()
+            elif comp == "hbm":
+                info = components.validate_hbm()
             elif comp == "plugin":
                 from ..validator.workload import validate_plugin
 

@@ -88,6 +88,7 @@ class ClusterPolicyReconciler(Reconciler):
             name_of(c)))
         if all_crs and name_of(all_crs[0]) != request.name:
             self._set_state(cr, STATE_IGNORED)
+            OPERATOR_METRICS.policy_state.labels(policy=request.name).set(2)
             conditions.set_error(
                 self.client, cr, "DuplicateResource",
                 f"only one {KIND_CLUSTER_POLICY} is allowed; "
@@ -100,6 +101,8 @@ class ClusterPolicyReconciler(Reconciler):
         OPERATOR_METRICS.tpu_nodes.set(tpu_nodes)
         if tpu_nodes == 0:
             self._set_state(cr, STATE_NOT_READY)
+            OPERATOR_METRICS.reconcile_status.set(0)
+            OPERATOR_METRICS.policy_state.labels(policy=request.name).set(1)
             conditions.set_not_ready(
                 self.client, cr, "NoTPUNodes",
                 "no nodes with cloud.google.com/gke-tpu-accelerator labels "
@@ -118,6 +121,9 @@ class ClusterPolicyReconciler(Reconciler):
                 1 if r.ready else 0)
         OPERATOR_METRICS.reconcile_total.inc()
 
+        if errors or not_ready:
+            OPERATOR_METRICS.reconcile_status.set(0)
+            OPERATOR_METRICS.policy_state.labels(policy=request.name).set(1)
         if errors:
             self._set_state(cr, STATE_NOT_READY)
             conditions.set_error(
@@ -136,6 +142,21 @@ class ClusterPolicyReconciler(Reconciler):
         conditions.set_ready(self.client, cr,
                              f"all {len(results)} states ready "
                              f"on {tpu_nodes} TPU node(s)")
+        import time as _time
+
+        from ..state.nodepool import get_node_pools
+
+        OPERATOR_METRICS.reconcile_status.set(1)
+        OPERATOR_METRICS.reconcile_last_success.set(_time.time())
+        OPERATOR_METRICS.policy_state.labels(policy=request.name).set(0)
+        nodes = self.client.list("v1", "Node")
+        pools = get_node_pools(nodes)
+        OPERATOR_METRICS.node_pools.set(len(pools))
+        from .nodeinfo import attributes_of
+
+        OPERATOR_METRICS.tpu_chips_cluster_total.set(
+            sum(a.chip_count for n in nodes
+                if (a := attributes_of(n)).is_tpu))
         log.info("policy %s ready (%d states, %d TPU nodes)",
                  request.name, len(results), tpu_nodes)
         return Result()

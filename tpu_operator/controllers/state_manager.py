@@ -12,10 +12,12 @@ validation barrier, not by pausing the FSM between states).
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..api import labels as L
+from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..api.clusterpolicy import TPUClusterPolicySpec
 from ..runtime.client import Client
 from ..runtime.objects import get_nested, labels_of, name_of
@@ -115,9 +117,13 @@ class StateManager:
                           extra=extra or {})
         results: Dict[str, SyncResult] = {}
         for state in self.states:
+            start = time.perf_counter()
             try:
                 results[state.name] = state.sync(ctx)
             except Exception as e:  # a broken state must not wedge the rest
                 log.exception("state %s sync failed", state.name)
                 results[state.name] = SyncResult(SyncStatus.ERROR, str(e))
+            finally:
+                OPERATOR_METRICS.operand_sync_duration.labels(
+                    state=state.name).set(time.perf_counter() - start)
         return results

@@ -260,6 +260,11 @@ class UpgradeReconciler(Reconciler):
         OPERATOR_METRICS.driver_upgrades_pending.set(
             sum(1 for s in node_states.values()
                 if s == STATE_UPGRADE_REQUIRED))
+        for fsm_state in (STATE_DONE, STATE_UPGRADE_REQUIRED, STATE_CORDON,
+                          STATE_DRAIN, STATE_POD_RESTART, STATE_VALIDATION,
+                          STATE_UNCORDON, STATE_FAILED):
+            OPERATOR_METRICS.upgrade_state_nodes.labels(state=fsm_state).set(
+                sum(1 for s in node_states.values() if s == fsm_state))
         if pending:
             return Result(requeue_after=REQUEUE_ACTIVE_S)
         return Result(requeue_after=REQUEUE_PERIODIC_S)

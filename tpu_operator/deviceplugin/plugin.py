@@ -274,11 +274,23 @@ class TPUDevicePlugin:
 
     def serve_forever(self, register: bool = True) -> None:
         """Entrypoint for the DaemonSet container: serve, register, and
-        re-register if kubelet restarts (its socket gets recreated)."""
+        recover from kubelet restarts. A restarting kubelet wipes the
+        device-plugins dir (deleting OUR socket) and recreates
+        kubelet.sock — so on either signal the plugin re-binds its socket
+        first, then re-registers; re-registering alone would advertise a
+        dead endpoint."""
         self.start()
         kubelet_sock = os.path.join(self.socket_dir, KUBELET_SOCKET)
         registered_ino = None
         while not self._stopped.is_set():
+            if not os.path.exists(self.socket_path):
+                log.warning("plugin socket vanished (kubelet restart?); "
+                            "re-binding %s", self.socket_path)
+                if self._server:
+                    self._server.stop(grace=1.0)
+                self._stopped.clear()
+                self.start()
+                registered_ino = None  # force re-registration below
             if register and os.path.exists(kubelet_sock):
                 try:
                     ino = os.stat(kubelet_sock).st_ino

@@ -43,6 +43,27 @@ class OperatorMetrics:
         self.driver_upgrades_pending = g(
             "tpu_operator_driver_upgrades_pending",
             "Nodes waiting for libtpu upgrade")
+        # remaining series of the reference's 17-gauge set that carry over
+        # (operator_metrics.go:29-201; the DTK/OpenShift ones are dropped)
+        self.reconcile_last_success = g(
+            "tpu_operator_reconciliation_last_success_timestamp_seconds",
+            "Unix time of the last all-ready reconciliation")
+        self.policy_state = g(
+            "tpu_operator_cluster_policy_state",
+            "Coarse CR state (0 ready / 1 notReady / 2 ignored / 3 disabled)",
+            labelnames=("policy",))
+        self.operand_sync_duration = g(
+            "tpu_operator_operand_sync_duration_seconds",
+            "Wall time of the last sync per state", labelnames=("state",))
+        self.tpu_chips_cluster_total = g(
+            "tpu_operator_tpu_chips_total",
+            "TPU chips across all discovered TPU nodes")
+        self.node_pools = g(
+            "tpu_operator_node_pools_total",
+            "Distinct (generation x topology) TPU node pools")
+        self.upgrade_state_nodes = g(
+            "tpu_operator_upgrade_state_nodes",
+            "Nodes per upgrade FSM state", labelnames=("state",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -233,15 +233,32 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
 
 class Manager:
-    """Holds the client, the controllers, and the serving endpoints."""
+    """Holds the client, the controllers, and the serving endpoints.
+
+    With ``leader_elect=True`` the controllers only start once the Lease is
+    won (cmd/gpu-operator/main.go --leader-elect analog); losing the lease
+    invokes ``on_lost_leadership`` (default: hard process exit so the pod
+    restarts and re-campaigns — the standard operator pattern)."""
 
     def __init__(self, client: Client, namespace: str = "tpu-operator",
-                 health_port: Optional[int] = None):
+                 health_port: Optional[int] = None,
+                 leader_elect: bool = False,
+                 on_lost_leadership: Optional[Callable[[], None]] = None):
         self.client = client
         self.namespace = namespace
         self.controllers: list[Controller] = []
         self.health_port = health_port
         self._http: Optional[ThreadingHTTPServer] = None
+        self.leader_elect = leader_elect
+        self.elector = None
+        self._on_lost = on_lost_leadership or self._default_on_lost
+
+    @staticmethod
+    def _default_on_lost():  # pragma: no cover - process exit
+        import os
+
+        log.error("leadership lost; exiting for clean re-campaign")
+        os._exit(1)
 
     def add_reconciler(self, reconciler: Reconciler,
                        rate_limiter: Optional[RateLimiter] = None) -> Controller:
@@ -255,12 +272,26 @@ class Manager:
             handler = type("H", (_HealthHandler,), {"manager": self})
             self._http = ThreadingHTTPServer(("0.0.0.0", self.health_port), handler)
             threading.Thread(target=self._http.serve_forever, daemon=True).start()
+        if self.leader_elect:
+            from .leaderelection import LeaderElector
+
+            self.elector = LeaderElector(
+                self.client, namespace=self.namespace,
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._on_lost)
+            self.elector.start()
+        else:
+            self._start_controllers()
+
+    def _start_controllers(self):
         for ctrl in self.controllers:
             ctrl.start()
 
     def stop(self):
         for ctrl in self.controllers:
             ctrl.stop()
+        if self.elector:
+            self.elector.stop()
         if self._http:
             self._http.shutdown()
             self._http.server_close()

@@ -108,13 +108,24 @@ class TopologyManager:
                                         {L.SLICE_CONFIG_STATE: state}}})
 
     def _pool_peers(self, node: dict) -> List[dict]:
-        """Nodes in the same (accelerator, topology) pool as this node."""
+        """Hosts of the same slice as this node: same (accelerator,
+        topology) AND same node-pool identity — two independent pools of
+        identical shape must not be conflated into one agreement group."""
         nl = labels_of(node)
         accel = nl.get(L.GKE_TPU_ACCELERATOR, "")
         topo = nl.get(L.GKE_TPU_TOPOLOGY, "")
-        return [n for n in self.client.list("v1", "Node")
-                if labels_of(n).get(L.GKE_TPU_ACCELERATOR) == accel
-                and labels_of(n).get(L.GKE_TPU_TOPOLOGY) == topo]
+        pool = nl.get(L.GKE_NODEPOOL)
+        out = []
+        for n in self.client.list("v1", "Node"):
+            other = labels_of(n)
+            if other.get(L.GKE_TPU_ACCELERATOR) != accel:
+                continue
+            if other.get(L.GKE_TPU_TOPOLOGY) != topo:
+                continue
+            if pool is not None and other.get(L.GKE_NODEPOOL) != pool:
+                continue
+            out.append(n)
+        return out
 
     def apply_once(self) -> str:
         """One reconcile pass; returns the state written to the node."""

@@ -28,6 +28,7 @@ KNOWN_STATUS_FILES = (
     "jax-ready",
     "plugin-ready",
     "ici-ready",
+    "hbm-ready",
     "topology-ready",
     ".driver-ctr-ready",
 )

@@ -209,6 +209,42 @@ def validate_ici(threshold: Optional[float] = None,
     return info
 
 
+def validate_hbm(threshold: Optional[float] = None,
+                 allow_cpu: Optional[bool] = None) -> Dict[str, str]:
+    """HBM bandwidth proof: the Pallas STREAM-triad kernel must sustain a
+    healthy fraction of the chip's published HBM bandwidth (a slow HBM is
+    a failing chip). Default bar is 0.5 — conservative across runtimes;
+    the measured healthy figure on v5e is ~0.8."""
+    import jax
+
+    if allow_cpu is None:
+        allow_cpu = os.environ.get("TPU_VALIDATOR_ALLOW_CPU",
+                                   "").lower() == "true"
+    if jax.devices()[0].platform == "cpu" and not allow_cpu:
+        raise ValidationFailed(
+            "JAX initialized on the CPU backend — cannot measure HBM "
+            "(set TPU_VALIDATOR_ALLOW_CPU=true only for fake/test clusters)")
+    thr = threshold if threshold is not None else float(
+        os.environ.get("HBM_THRESHOLD", "0.5"))
+    from ..workloads import pallas_probe
+
+    res = pallas_probe.run(size_mb=float(os.environ.get("HBM_SIZE_MB", "512")))
+    if not res.correct:
+        raise ValidationFailed("triad kernel produced wrong values")
+    info = {
+        "BANDWIDTH_GBPS": f"{res.bandwidth_gbps:.2f}",
+        "DEVICE_KIND": res.device_kind,
+    }
+    if res.fraction_of_peak is not None:
+        info["FRACTION_OF_PEAK"] = f"{res.fraction_of_peak:.3f}"
+        if res.fraction_of_peak < thr:
+            raise ValidationFailed(
+                f"HBM triad reached {res.fraction_of_peak:.1%} of peak, "
+                f"below the {thr:.0%} threshold")
+    barrier.write_status("hbm-ready", info)
+    return info
+
+
 def component_sleep() -> None:  # pragma: no cover - blocks forever
     log.info("node validated; sleeping (DaemonSet main container)")
     while True:
