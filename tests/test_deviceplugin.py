@@ -128,6 +128,62 @@ class TestDevicePluginRPC:
                                                                "accel1"]
 
 
+class TestSharing:
+    """Time-shared chips (MPS/time-slicing slot): each unit advertised
+    SHARING_REPLICAS times; replicas collapse back to their chip."""
+
+    def test_replicated_inventory(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.setenv("SHARING_REPLICAS", "3")
+        ids = [d.ID for d in discover_devices()]
+        assert len(ids) == 6
+        assert "accel0::r0" in ids and "accel1::r2" in ids
+
+    def test_allocate_replicas_dedup_to_chip(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.setenv("SHARING_REPLICAS", "2")
+        p = TPUDevicePlugin(socket_dir=str(tmp_path), health_interval_s=0.1)
+        p.start()
+        try:
+            req = pb.AllocateRequest()
+            req.container_requests.add(devicesIDs=["accel0::r0", "accel0::r1"])
+            with plugin_channel(p) as ch:
+                resp = call(ch, "Allocate", req, pb.AllocateRequest,
+                            pb.AllocateResponse)
+            [cresp] = resp.container_responses
+            # two replicas of one chip mount the device once
+            assert [d.host_path for d in cresp.devices] == ["/dev/accel0"]
+            assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0"
+        finally:
+            p.stop()
+
+    def test_preferred_allocation_spreads_across_units(self, monkeypatch,
+                                                       tmp_path):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.setenv("SHARING_REPLICAS", "2")
+        p = TPUDevicePlugin(socket_dir=str(tmp_path), health_interval_s=0.1)
+        p.start()
+        try:
+            req = pb.PreferredAllocationRequest()
+            req.container_requests.add(
+                available_deviceIDs=["accel0::r0", "accel0::r1",
+                                     "accel1::r0", "accel1::r1"],
+                allocation_size=2)
+            with plugin_channel(p) as ch:
+                resp = call(ch, "GetPreferredAllocation", req,
+                            pb.PreferredAllocationRequest,
+                            pb.PreferredAllocationResponse)
+            picked = list(resp.container_responses[0].deviceIDs)
+            assert picked == ["accel0::r0", "accel1::r0"]
+        finally:
+            p.stop()
+
+    def test_exclusive_default_unreplicated(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        monkeypatch.delenv("SHARING_REPLICAS", raising=False)
+        assert len(discover_devices()) == 4
+
+
 class TestKubeletRegistration:
     def test_register_round_trip(self, tmp_path, plugin):
         kubelet = FakeKubelet(str(plugin.socket_dir))

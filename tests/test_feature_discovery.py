@@ -1,0 +1,118 @@
+"""Feature discovery (gpu-feature-discovery slot): on-node property labels."""
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.controllers.state_manager import desired_node_labels
+from tpu_operator.featurediscovery import FeatureDiscovery, compute_feature_labels
+from tpu_operator.runtime import FakeClient
+
+
+@pytest.fixture(autouse=True)
+def fake_chips(monkeypatch):
+    monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+    # the axon PJRT plugin exports TPU_TOPOLOGY into the process env
+    monkeypatch.delenv("TPU_TOPOLOGY", raising=False)
+
+
+def gke_labels(accel="tpu-v5-lite-podslice", topo="2x4"):
+    return {L.GKE_TPU_ACCELERATOR: accel, L.GKE_TPU_TOPOLOGY: topo}
+
+
+class TestComputeFeatureLabels:
+    def test_gke_node(self):
+        want = compute_feature_labels(gke_labels(), {"count": 4})
+        assert want[L.TPU_ACCELERATOR] == "tpu-v5-lite-podslice"
+        assert want[L.TPU_TOPOLOGY] == "2x4"
+        assert want[L.TPU_MEMORY_GB] == "16"    # v5e HBM
+        assert want[L.TPU_ICI_GBPS] == "200"
+        assert want[L.TPU_MULTIHOST] == "false"  # 8 chips on one v5e host
+
+    def test_multihost_slice(self):
+        want = compute_feature_labels(
+            gke_labels("tpu-v5p-slice", "4x4x4"), {"count": 4})
+        assert want[L.TPU_MULTIHOST] == "true"
+        assert want[L.TPU_MEMORY_GB] == "95"    # v5p HBM
+
+    def test_libtpu_version_from_probe(self):
+        want = compute_feature_labels(
+            gke_labels(), {"count": 4, "libtpu_version": "2.9.0"})
+        assert want[L.LIBTPU_VERSION] == "2.9.0"
+
+    def test_non_gke_node_falls_back_to_operator_generation(self):
+        # TPU-VM without GKE labels but already stamped by the operator
+        want = compute_feature_labels({L.TPU_GENERATION: "v4"}, {"count": 4})
+        assert want[L.TPU_MEMORY_GB] == "32"
+        assert L.TPU_ACCELERATOR not in want
+
+    def test_stale_labels_removed(self):
+        have = {L.TPU_TOPOLOGY: "2x2", L.LIBTPU_VERSION: "old"}
+        want = compute_feature_labels(have, {"count": 0})
+        assert want[L.TPU_TOPOLOGY] is None
+        assert want[L.LIBTPU_VERSION] is None
+
+
+class TestAgent:
+    def test_apply_once_patches_and_converges(self):
+        c = FakeClient()
+        c.add_node("n1", labels=gke_labels())
+        agent = FeatureDiscovery(client=c, node_name="n1")
+        delta = agent.apply_once()
+        assert delta[L.TPU_TOPOLOGY] == "2x4"
+        node = c.get("v1", "Node", "n1")
+        assert node["metadata"]["labels"][L.TPU_MEMORY_GB] == "16"
+        # second pass: labels converged, no patch
+        assert agent.apply_once() == {}
+
+    def test_label_removal_roundtrip(self):
+        c = FakeClient()
+        c.add_node("n1", labels={**gke_labels(), L.LIBTPU_VERSION: "stale"})
+        FeatureDiscovery(client=c, node_name="n1").apply_once()
+        assert L.LIBTPU_VERSION not in c.get(
+            "v1", "Node", "n1")["metadata"]["labels"]
+
+
+class TestOperandWiring:
+    def test_deploy_label_stamped_on_container_nodes(self):
+        node = {"metadata": {"name": "n1", "labels": gke_labels()},
+                "status": {"allocatable": {L.TPU_RESOURCE: "4"}}}
+        want = desired_node_labels(node)
+        assert want[L.deploy_label("feature-discovery")] == "true"
+
+    def test_state_registered_and_renders(self):
+        from tpu_operator.api.clusterpolicy import (
+            TPUClusterPolicySpec,
+            new_cluster_policy,
+        )
+        from tpu_operator.state.operands import build_states
+        from tpu_operator.state.state import SyncContext
+
+        policy = new_cluster_policy(spec={})
+        ctx = SyncContext(client=None, policy=policy,
+                          spec=TPUClusterPolicySpec.from_obj(policy),
+                          namespace="tpu-operator")
+        state = {s.name: s for s in build_states()}["feature-discovery"]
+        assert state.enabled(ctx)
+        objs = state.renderer().render_objects(state._data_fn(ctx))
+        kinds = sorted(o["kind"] for o in objs)
+        assert kinds == ["ClusterRole", "ClusterRoleBinding", "DaemonSet",
+                        "ServiceAccount"]
+        ds = next(o for o in objs if o["kind"] == "DaemonSet")
+        ctr = ds["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["command"] == ["tpu-feature-discovery"]
+
+    def test_disable_flag(self):
+        from tpu_operator.api.clusterpolicy import (
+            TPUClusterPolicySpec,
+            new_cluster_policy,
+        )
+        from tpu_operator.state.operands import build_states
+        from tpu_operator.state.state import SyncContext
+
+        policy = new_cluster_policy(
+            spec={"featureDiscovery": {"enabled": False}})
+        ctx = SyncContext(client=None, policy=policy,
+                          spec=TPUClusterPolicySpec.from_obj(policy),
+                          namespace="tpu-operator")
+        state = {s.name: s for s in build_states()}["feature-discovery"]
+        assert not state.enabled(ctx)

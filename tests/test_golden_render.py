@@ -45,6 +45,11 @@ PERMUTATIONS = {
         "hostPaths": {"rootFS": "/host", "validationDir": "/var/run/tpu/v",
                       "devDir": "/hostdev"},
     },
+    "health-engine-on": {
+        "tpuHealth": {"enabled": True, "port": 9555},
+        "devicePlugin": {"sharingPolicy": "time-shared",
+                         "sharingReplicas": 4},
+    },
 }
 
 

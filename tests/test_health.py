@@ -1,0 +1,150 @@
+"""Standalone health engine (DCGM host-engine slot) + remote exporter mode."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpu_operator.metrics.health_engine import (
+    FAIL,
+    OK,
+    WARN,
+    HealthEngine,
+    evaluate_chip,
+    serve,
+)
+from tpu_operator.metrics.libtpu_exporter import (
+    ChipSample,
+    LibtpuExporter,
+    collect_remote,
+)
+
+
+class TestRules:
+    def test_healthy_chip(self):
+        v = evaluate_chip(ChipSample("accel0", temperature_c=50.0,
+                                     hbm_used=1 << 30, hbm_total=16 << 30))
+        assert v["status"] == OK and v["reasons"] == []
+
+    def test_overheat_warn_and_fail(self):
+        warm = evaluate_chip(ChipSample("a", temperature_c=80.0))
+        hot = evaluate_chip(ChipSample("a", temperature_c=95.0))
+        assert warm["status"] == WARN
+        assert hot["status"] == FAIL
+        assert "temperature" in hot["reasons"][0]
+
+    def test_hbm_exhaustion_warns(self):
+        v = evaluate_chip(ChipSample("a", hbm_used=97, hbm_total=100))
+        assert v["status"] == WARN
+        assert "HBM" in v["reasons"][0]
+
+
+class TestEngine:
+    def test_ok_with_fake_chips(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        eng = HealthEngine()
+        assert eng.collect_once() == 4
+        health = eng.health()
+        assert health["status"] == OK
+        assert len(health["chips"]) == 4
+
+    def test_chip_loss_is_hard_failure(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+        eng = HealthEngine()
+        eng.collect_once()
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        eng.collect_once()
+        health = eng.health()
+        assert health["status"] == FAIL
+        assert "2 of 4 chips missing" in health["reasons"][0]
+
+
+@pytest.fixture
+def engine_server(monkeypatch):
+    monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+    server = serve(0, interval=3600)
+    yield server
+    server.shutdown()
+
+
+class TestHTTPAndRemoteExporter:
+    def test_endpoints(self, engine_server):
+        port = engine_server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/v1/health") as r:
+            health = json.loads(r.read())
+        assert health["status"] == OK
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/v1/samples") as r:
+            samples = json.loads(r.read())
+        assert [s["chip_id"] for s in samples] == ["accel0", "accel1"]
+
+    def test_collect_remote_round_trip(self, engine_server):
+        port = engine_server.server_address[1]
+        samples = collect_remote(f"localhost:{port}")
+        assert len(samples) == 2
+        assert samples[0].chip_id == "accel0"
+        assert samples[0].hbm_total == 16 << 30
+
+    def test_exporter_presents_engine_samples(self, engine_server,
+                                              monkeypatch):
+        port = engine_server.server_address[1]
+        monkeypatch.setenv("TPU_HEALTH_ENGINE_INFO", f"localhost:{port}")
+        monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
+        exporter = LibtpuExporter(node_name="n1")
+        assert exporter.collect_once() == 2
+        text = exporter.render().decode()
+        assert 'tpu_hbm_total_bytes{chip="accel0",node="n1"}' in text
+
+
+class TestOperandWiring:
+    def mk_ctx(self, spec_dict):
+        from tpu_operator.api.clusterpolicy import (
+            TPUClusterPolicySpec,
+            new_cluster_policy,
+        )
+        from tpu_operator.state.state import SyncContext
+
+        policy = new_cluster_policy(spec=spec_dict)
+        return SyncContext(client=None, policy=policy,
+                           spec=TPUClusterPolicySpec.from_obj(policy),
+                           namespace="tpu-operator")
+
+    def states(self):
+        from tpu_operator.state.operands import build_states
+
+        return {s.name: s for s in build_states()}
+
+    def test_disabled_by_default(self):
+        ctx = self.mk_ctx({})
+        assert not self.states()["tpu-health"].enabled(ctx)
+
+    def test_enabled_renders_hostport_engine(self):
+        ctx = self.mk_ctx({"tpuHealth": {"enabled": True, "port": 9999}})
+        state = self.states()["tpu-health"]
+        assert state.enabled(ctx)
+        objs = state.renderer().render_objects(state._data_fn(ctx))
+        [ds] = [o for o in objs if o["kind"] == "DaemonSet"]
+        ctr = ds["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["command"] == ["tpu-health-engine"]
+        assert ctr["ports"][0]["hostPort"] == 9999
+
+    def test_exporter_gets_remote_engine_env(self):
+        ctx = self.mk_ctx({"tpuHealth": {"enabled": True}})
+        state = self.states()["metrics-exporter"]
+        objs = state.renderer().render_objects(state._data_fn(ctx))
+        [ds] = [o for o in objs if o["kind"] == "DaemonSet"]
+        env = {e["name"]: e for e in
+               ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["TPU_HEALTH_ENGINE_INFO"]["value"] == "$(NODE_IP):9402"
+        assert env["NODE_IP"]["valueFrom"]["fieldRef"][
+            "fieldPath"] == "status.hostIP"
+
+    def test_exporter_local_by_default(self):
+        ctx = self.mk_ctx({})
+        state = self.states()["metrics-exporter"]
+        objs = state.renderer().render_objects(state._data_fn(ctx))
+        [ds] = [o for o in objs if o["kind"] == "DaemonSet"]
+        names = [e["name"] for e in
+                 ds["spec"]["template"]["spec"]["containers"][0]["env"]]
+        assert "TPU_HEALTH_ENGINE_INFO" not in names

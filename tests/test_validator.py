@@ -122,6 +122,56 @@ class TestComponents:
         assert info.get("DEVICES") == "8"
         assert "BUS_BW_GBPS" in info
 
+    def test_dcn_skipped_single_slice(self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_dcn
+
+        monkeypatch.delenv("MEGASCALE_NUM_SLICES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        info = validate_dcn()
+        assert "SKIPPED" in info
+        assert barrier.is_ready("dcn-ready")
+
+    def test_dcn_reaches_coordinator(self, valdir, monkeypatch):
+        import socket
+
+        from tpu_operator.validator.components import validate_dcn
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        try:
+            monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+            monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+            monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                               f"127.0.0.1:{port}")
+            info = validate_dcn()
+        finally:
+            listener.close()
+        assert info["NUM_SLICES"] == "2"
+        assert info["SLICE_ID"] == "1"
+        assert float(info["RTT_MS"]) >= 0
+        assert barrier.is_ready("dcn-ready")
+
+    def test_dcn_unreachable_fails(self, valdir, monkeypatch):
+        import socket
+
+        from tpu_operator.validator.components import validate_dcn
+
+        # grab an ephemeral port and close it: connects get ECONNREFUSED
+        # (an unroutable TEST-NET address doesn't work here — the sandbox
+        # proxies outbound TCP)
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                           f"127.0.0.1:{port}")
+        with pytest.raises(ValidationFailed, match="unreachable over DCN"):
+            validate_dcn(timeout=2.0)
+        assert not barrier.is_ready("dcn-ready")
+
 
 class TestWorkloadPods:
     def _client(self):

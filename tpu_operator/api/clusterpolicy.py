@@ -103,6 +103,23 @@ class DevicePluginSpec(ComponentSpec):
     resource_name: Optional[str] = field(default="google.com/tpu")
     sharing_policy: Optional[str] = field(
         default="exclusive", description="exclusive|time-shared")
+    sharing_replicas: Optional[int] = field(
+        default=1, description="Advertised replicas per chip when "
+        "time-shared (MPS/time-slicing slot)")
+
+
+@dataclass
+class TPUHealthSpec(ComponentSpec):
+    """state-tpu-health: standalone node-local telemetry/health engine
+    (the standalone-DCGM slot, object_controls.go:1644). Disabled by
+    default: the metrics exporter samples locally unless this engine owns
+    the session (DCGM_REMOTE_HOSTENGINE_INFO split)."""
+
+    port: Optional[int] = field(default=9402)
+    collection_interval_seconds: Optional[int] = field(default=15)
+
+    def is_enabled(self, default: bool = False) -> bool:
+        return super().is_enabled(default)
 
 
 @dataclass
@@ -120,6 +137,16 @@ class NodeStatusExporterSpec(ComponentSpec):
     """state-node-status-exporter: per-node validation status gauges."""
 
     port: Optional[int] = field(default=9401)
+
+
+@dataclass
+class FeatureDiscoverySpec(ComponentSpec):
+    """state-feature-discovery: on-node TPU property labels
+    (gpu-feature-discovery slot, SURVEY.md 2.4 row 5): topology, HBM size,
+    ICI bandwidth class, libtpu version, multi-host membership."""
+
+    interval_seconds: Optional[int] = field(
+        default=60, description="Re-discovery period (GFD sleep-interval)")
 
 
 @dataclass
@@ -183,10 +210,14 @@ class TPUClusterPolicySpec:
     tpu_runtime: Optional[TPURuntimeSpec] = field(
         name="tpuRuntime", default_factory=TPURuntimeSpec)
     device_plugin: Optional[DevicePluginSpec] = field(default_factory=DevicePluginSpec)
+    tpu_health: Optional[TPUHealthSpec] = field(
+        name="tpuHealth", default_factory=TPUHealthSpec)
     metrics_exporter: Optional[MetricsExporterSpec] = field(
         default_factory=MetricsExporterSpec)
     node_status_exporter: Optional[NodeStatusExporterSpec] = field(
         default_factory=NodeStatusExporterSpec)
+    feature_discovery: Optional[FeatureDiscoverySpec] = field(
+        default_factory=FeatureDiscoverySpec)
     topology_manager: Optional[TopologyManagerSpec] = field(
         default_factory=TopologyManagerSpec)
     validator: Optional[ValidatorSpec] = field(default_factory=ValidatorSpec)
@@ -204,8 +235,10 @@ class TPUClusterPolicySpec:
                                 ("libtpu", LibtpuSpec),
                                 ("tpu_runtime", TPURuntimeSpec),
                                 ("device_plugin", DevicePluginSpec),
+                                ("tpu_health", TPUHealthSpec),
                                 ("metrics_exporter", MetricsExporterSpec),
                                 ("node_status_exporter", NodeStatusExporterSpec),
+                                ("feature_discovery", FeatureDiscoverySpec),
                                 ("topology_manager", TopologyManagerSpec),
                                 ("validator", ValidatorSpec),
                                 ("upgrade_policy", DriverUpgradePolicySpec),

@@ -20,6 +20,19 @@ SLICE_CONFIG = f"{DOMAIN}/slice.config"           # nvidia.com/mig.config analog
 SLICE_CONFIG_STATE = f"{DOMAIN}/slice.config.state"  # pending|success|failed
 TPU_GENERATION = f"{DOMAIN}/tpu.generation"       # v4 | v5e | v5p | v6e
 TPU_CHIP_COUNT = f"{DOMAIN}/tpu.chips"
+
+# --- feature-discovery labels (gpu-feature-discovery slot) -----------------
+# Stamped by the on-node tpu-feature-discovery agent, never by the operator
+# itself, so the two label owners can't fight (same split as GFD's
+# nvidia.com/gpu.product vs the operator's nvidia.com/gpu.present).
+TPU_TOPOLOGY = f"{DOMAIN}/tpu.topology"           # e.g. 2x2x1
+TPU_ACCELERATOR = f"{DOMAIN}/tpu.accelerator"     # e.g. tpu-v5-lite-podslice
+TPU_MEMORY_GB = f"{DOMAIN}/tpu.memory-gb"         # HBM per chip
+TPU_ICI_GBPS = f"{DOMAIN}/tpu.ici-gbps"           # aggregate ICI per chip
+TPU_MULTIHOST = f"{DOMAIN}/tpu.multihost"         # slice spans hosts
+LIBTPU_VERSION = f"{DOMAIN}/libtpu.version"
+FEATURE_LABELS = (TPU_TOPOLOGY, TPU_ACCELERATOR, TPU_MEMORY_GB,
+                  TPU_ICI_GBPS, TPU_MULTIHOST, LIBTPU_VERSION)
 UPGRADE_STATE = f"{DOMAIN}/upgrade.state"         # upgrade controller FSM label
 UPGRADE_SKIP_DRAIN = f"{DOMAIN}/upgrade.skip-drain"
 
@@ -41,7 +54,9 @@ CONTAINER_WORKLOAD_STATES = (
     "tpu-runtime",
     "operator-validation",
     "tpu-device-plugin",
+    "tpu-health",
     "metrics-exporter",
+    "feature-discovery",
     "node-status-exporter",
     "topology-manager",
 )

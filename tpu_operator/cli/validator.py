@@ -25,7 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd")
     p.add_argument("-c", "--component", default=None,
                    choices=["driver", "runtime", "jax", "ici", "hbm",
-                            "plugin", "metrics", "sleep"])
+                            "dcn", "plugin", "metrics", "sleep"])
     p.add_argument("--pod-mode", action="store_true",
                    help="jax/plugin: spawn a workload pod via the apiserver "
                         "instead of running in-process")
@@ -92,6 +92,8 @@ def main(argv=None) -> int:
                 info = components.validate_ici()
             elif comp == "hbm":
                 info = components.validate_hbm()
+            elif comp == "dcn":
+                info = components.validate_dcn()
             elif comp == "plugin":
                 from ..validator.workload import validate_plugin
 

@@ -20,7 +20,7 @@ from ..api import labels as L
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..api.clusterpolicy import TPUClusterPolicySpec
 from ..runtime.client import Client
-from ..runtime.objects import get_nested, labels_of, name_of
+from ..runtime.objects import get_nested, label_delta, labels_of, name_of
 from ..state.operands import build_states
 from ..state.state import State, SyncContext, SyncResult, SyncStatus
 
@@ -89,8 +89,7 @@ class StateManager:
             if is_tpu_node(node):
                 count += 1
             have = labels_of(node)
-            delta = {k: v for k, v in want.items() if have.get(k) != v
-                     and not (v is None and k not in have)}
+            delta = label_delta(have, want)
             if delta:
                 self.client.patch("v1", "Node", name_of(node),
                                   {"metadata": {"labels": delta}})

@@ -55,16 +55,34 @@ def discover_chips() -> List[str]:
     return [os.path.basename(p) for p in paths]
 
 
+REPLICA_SEP = "::"  # replica ID convention: <unit>::r<j>
+
+
+def sharing_replicas() -> int:
+    """Replication factor for time-shared chips (the MPS-control-daemon
+    slot, SURVEY.md 2.2 #7: CUDA MPS shares one GPU between processes; the
+    TPU analog is advertising each allocation unit N times so N pods can
+    time-share a chip). 1 = exclusive."""
+    try:
+        n = int(os.environ.get("SHARING_REPLICAS", "1"))
+    except ValueError:
+        return 1
+    return max(1, n)
+
+
 def discover_devices() -> List[pb.Device]:
     """Advertised allocation units. Without a slice config each chip is one
     device; with one (written by the topology manager,
     topology/manager.py), each sub-slice group is one device — allocating
-    a unit grants all its chips, preserving ICI locality."""
+    a unit grants all its chips, preserving ICI locality. With sharing
+    enabled every unit is advertised ``sharing_replicas()`` times."""
     groups = slice_groups()
-    if groups:
-        return [pb.Device(ID=f"slice{i}", health="Healthy")
-                for i in range(len(groups))]
-    return [pb.Device(ID=c, health="Healthy") for c in discover_chips()]
+    units = list(groups) if groups else discover_chips()
+    n = sharing_replicas()
+    if n > 1:
+        return [pb.Device(ID=f"{u}{REPLICA_SEP}r{j}", health="Healthy")
+                for u in units for j in range(n)]
+    return [pb.Device(ID=u, health="Healthy") for u in units]
 
 
 def slice_groups() -> Optional[Dict[str, List[str]]]:
@@ -81,10 +99,15 @@ def slice_groups() -> Optional[Dict[str, List[str]]]:
 
 
 def expand_to_chips(device_ids: List[str]) -> List[str]:
+    """Replica IDs collapse to their unit; slice units expand to member
+    chips; duplicates (two replicas of one chip in a request) dedup."""
     groups = slice_groups() or {}
     chips: List[str] = []
     for device_id in device_ids:
-        chips.extend(groups.get(device_id, [device_id]))
+        unit = device_id.split(REPLICA_SEP, 1)[0]
+        for chip in groups.get(unit, [unit]):
+            if chip not in chips:
+                chips.append(chip)
     return chips
 
 
@@ -97,6 +120,16 @@ def device_host_path(device_id: str) -> str:
 # ---------------------------------------------------------------------------
 # gRPC service wiring (generic handlers over api_pb2 messages)
 # ---------------------------------------------------------------------------
+
+
+def _replica_sort_key(device_id: str):
+    """(replica index, unit): all r0s across units sort before any r1."""
+    unit, _, rep = device_id.partition(REPLICA_SEP)
+    try:
+        idx = int(rep.lstrip("r")) if rep else 0
+    except ValueError:
+        idx = 0
+    return (idx, unit)
 
 
 def _unary(fn: Callable, req_cls, resp_cls) -> grpc.RpcMethodHandler:
@@ -159,10 +192,12 @@ class TPUDevicePlugin:
 
     def GetPreferredAllocation(self, request, context):
         """Prefer low-numbered contiguous chips — neighboring chips share
-        ICI links, so contiguous allocation preserves torus locality."""
+        ICI links, so contiguous allocation preserves torus locality. With
+        sharing enabled, spread across distinct units first so one request
+        never time-shares a chip with itself."""
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
-            ids = sorted(creq.available_deviceIDs)
+            ids = sorted(creq.available_deviceIDs, key=_replica_sort_key)
             must = list(creq.must_include_deviceIDs)
             picked = must + [i for i in ids if i not in must]
             resp.container_responses.add(

@@ -1,0 +1,1 @@
+from .agent import FeatureDiscovery, compute_feature_labels  # noqa: F401

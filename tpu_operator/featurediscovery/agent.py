@@ -1,0 +1,106 @@
+"""TPU feature discovery — the gpu-feature-discovery slot.
+
+The reference deploys GFD (external image, state dir
+``assets/gpu-feature-discovery``, ``TransformGPUDiscoveryPlugin``
+object_controls.go:867) to label nodes with GPU *properties* (product,
+memory, MIG profile) discovered on-node via NFD. The TPU analog discovers
+chip properties from the hardware actually present — device nodes, the
+native libtpu probe, GKE-provided labels as hints — and stamps
+``tpu.graft.dev/tpu.*`` property labels so schedulers and the topology
+manager can select by topology/HBM/ICI class without GKE-specific keys.
+
+Ownership split (why this can't fight the operator's labeler): the
+operator's StateManager owns presence/deploy/generation/chips labels;
+this agent owns only ``labels.FEATURE_LABELS``. Stale feature labels are
+removed when the property disappears.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..api import labels as L
+from ..runtime.client import Client
+from ..runtime.objects import label_delta, labels_of, name_of
+from ..state.nodepool import NodePool
+from ..validator.components import discover_chips
+from ..workloads.hardware import CHIPS
+
+log = logging.getLogger("tpu_feature_discovery")
+
+
+def compute_feature_labels(node_labels: Dict[str, str],
+                           chips: Dict) -> Dict[str, Optional[str]]:
+    """Property labels for a node; ``None`` marks removal of a stale key.
+
+    ``chips`` is the validator-style discovery dict (count/source/devices,
+    optional kind/libtpu_version). GKE labels act as hints for topology and
+    accelerator naming; generation falls back to the operator-stamped label
+    so discovery works on non-GKE TPU-VMs too.
+    """
+    want: Dict[str, Optional[str]] = {}
+    accel = node_labels.get(L.GKE_TPU_ACCELERATOR, "")
+    topo = node_labels.get(L.GKE_TPU_TOPOLOGY,
+                           os.environ.get("TPU_TOPOLOGY", ""))
+    if accel:
+        want[L.TPU_ACCELERATOR] = accel
+    if topo:
+        want[L.TPU_TOPOLOGY] = topo
+        want[L.TPU_MULTIHOST] = str(
+            NodePool(accelerator=accel, topology=topo).multi_host).lower()
+    gen = (L.accelerator_generation(accel) if accel
+           else node_labels.get(L.TPU_GENERATION, ""))
+    spec = CHIPS.get(gen)
+    if spec is not None:
+        want[L.TPU_MEMORY_GB] = str(int(spec.hbm_gb))
+        want[L.TPU_ICI_GBPS] = str(int(spec.ici_bw_gbps))
+    if chips.get("libtpu_version"):
+        want[L.LIBTPU_VERSION] = str(chips["libtpu_version"])
+    # anything we own but can no longer derive gets removed
+    for key in L.FEATURE_LABELS:
+        if key not in want and key in node_labels:
+            want[key] = None
+    return want
+
+
+@dataclass
+class FeatureDiscovery:
+    client: Client
+    node_name: str
+
+    def apply_once(self) -> Dict[str, Optional[str]]:
+        node = self.client.get("v1", "Node", self.node_name)
+        have = labels_of(node)
+        want = compute_feature_labels(have, discover_chips())
+        delta = label_delta(have, want)
+        if delta:
+            self.client.patch("v1", "Node", name_of(node),
+                              {"metadata": {"labels": delta}})
+            log.info("node %s feature labels: %s", self.node_name, delta)
+        return delta
+
+    def run_forever(self, interval: float = 60.0) -> None:  # pragma: no cover
+        while True:
+            try:
+                self.apply_once()
+            except Exception:
+                log.exception("feature discovery failed")
+            time.sleep(interval)
+
+
+def main() -> int:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    agent = FeatureDiscovery(client=HTTPClient(KubeConfig.load()),
+                             node_name=os.environ["NODE_NAME"])
+    agent.run_forever(interval=float(os.environ.get("INTERVAL", "60")))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,176 @@
+"""TPU health engine — the standalone DCGM host-engine slot.
+
+The reference can run DCGM as its own DaemonSet (``assets/state-dcgm``,
+``TransformDCGM`` object_controls.go:1644) so that exactly one process
+owns the GPU telemetry session and dcgm-exporter connects to it remotely
+via ``DCGM_REMOTE_HOSTENGINE_INFO`` (object_controls.go:113-116). The TPU
+analog matters for the same reason: libtpu/sysfs telemetry should have a
+single node-local owner. This engine:
+
+- samples chips through the exporter's backends (fake/sysfs/jax),
+- evaluates health rules (DCGM's health-watch role): overheat, HBM
+  exhaustion, chips disappearing after first enumeration,
+- serves node-local JSON over HTTP (``/v1/samples``, ``/v1/health``) on a
+  hostPort; the metrics exporter consumes it when
+  ``TPU_HEALTH_ENGINE_INFO`` is set instead of sampling itself.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .libtpu_exporter import ChipSample, collect_local
+
+log = logging.getLogger("tpu_health_engine")
+
+DEFAULT_PORT = 9402
+
+OK = "ok"
+WARN = "warn"
+FAIL = "fail"
+
+TEMP_WARN_C = 75.0
+TEMP_FAIL_C = 90.0
+HBM_WARN_FRACTION = 0.95
+
+
+def sample_to_dict(s: ChipSample) -> Dict:
+    return {
+        "chip_id": s.chip_id,
+        "duty_cycle_pct": s.duty_cycle_pct,
+        "hbm_used": s.hbm_used,
+        "hbm_total": s.hbm_total,
+        "tensorcore_util_pct": s.tensorcore_util_pct,
+        "temperature_c": s.temperature_c,
+    }
+
+
+def sample_from_dict(d: Dict) -> ChipSample:
+    return ChipSample(
+        d.get("chip_id", ""),
+        duty_cycle_pct=d.get("duty_cycle_pct", 0.0),
+        hbm_used=d.get("hbm_used", 0),
+        hbm_total=d.get("hbm_total", 0),
+        tensorcore_util_pct=d.get("tensorcore_util_pct", 0.0),
+        temperature_c=d.get("temperature_c"))
+
+
+def evaluate_chip(s: ChipSample) -> Dict:
+    """Health verdict for one chip (DCGM health-watch analog)."""
+    status, reasons = OK, []
+    if s.temperature_c is not None:
+        if s.temperature_c >= TEMP_FAIL_C:
+            status = FAIL
+            reasons.append(f"temperature {s.temperature_c:.0f}C >= "
+                           f"{TEMP_FAIL_C:.0f}C")
+        elif s.temperature_c >= TEMP_WARN_C:
+            status = WARN
+            reasons.append(f"temperature {s.temperature_c:.0f}C >= "
+                           f"{TEMP_WARN_C:.0f}C")
+    if s.hbm_total and s.hbm_used / s.hbm_total >= HBM_WARN_FRACTION:
+        if status != FAIL:
+            status = WARN
+        reasons.append(f"HBM {s.hbm_used / s.hbm_total:.0%} full")
+    return {"chip_id": s.chip_id, "status": status, "reasons": reasons}
+
+
+class HealthEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: List[ChipSample] = []
+        self._expected_chips: Optional[int] = None
+
+    def collect_once(self) -> int:
+        samples = collect_local()
+        with self._lock:
+            self._samples = samples
+            # first successful enumeration pins the expected chip count;
+            # a later drop means a chip fell off the bus — a hard failure
+            # no per-chip rule can see
+            if self._expected_chips is None and samples:
+                self._expected_chips = len(samples)
+        return len(samples)
+
+    def samples(self) -> List[Dict]:
+        with self._lock:
+            return [sample_to_dict(s) for s in self._samples]
+
+    def health(self) -> Dict:
+        with self._lock:
+            samples = list(self._samples)
+            expected = self._expected_chips
+        chips = [evaluate_chip(s) for s in samples]
+        status = OK
+        reasons: List[str] = []
+        if expected is not None and len(samples) < expected:
+            status = FAIL
+            reasons.append(
+                f"{expected - len(samples)} of {expected} chips missing")
+        for c in chips:
+            if c["status"] == FAIL:
+                status = FAIL
+            elif c["status"] == WARN and status == OK:
+                status = WARN
+        return {"status": status, "reasons": reasons, "chips": chips}
+
+
+def serve(port: int, interval: float = 15.0,
+          stop_event: Optional[threading.Event] = None,
+          engine: Optional[HealthEngine] = None) -> ThreadingHTTPServer:
+    eng = engine or HealthEngine()
+    eng.collect_once()
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            try:
+                eng.collect_once()
+            except Exception:
+                log.exception("health collection failed")
+
+    threading.Thread(target=loop, daemon=True).start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/v1/samples":
+                body = json.dumps(eng.samples()).encode()
+                code = 200
+            elif self.path == "/v1/health":
+                health = eng.health()
+                body = json.dumps(health).encode()
+                code = 200 if health["status"] != FAIL else 503
+            elif self.path == "/healthz":
+                body, code = b"ok", 200
+            else:
+                body, code = b"not found", 404
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    log.info("tpu health engine on :%d", server.server_address[1])
+    return server
+
+
+def main() -> int:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    serve(int(os.environ.get("HEALTH_PORT", str(DEFAULT_PORT))),
+          interval=float(os.environ.get("COLLECTION_INTERVAL", "15")))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

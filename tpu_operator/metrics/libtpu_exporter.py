@@ -92,7 +92,27 @@ def collect_jax() -> List[ChipSample]:
     return out
 
 
-def collect() -> List[ChipSample]:
+def collect_remote(info: str) -> List[ChipSample]:
+    """Pull samples from a node-local health engine
+    (DCGM_REMOTE_HOSTENGINE_INFO analog, object_controls.go:113-116):
+    ``info`` is host:port; the engine owns the telemetry session and this
+    exporter is a pure presenter."""
+    import requests
+
+    from .health_engine import sample_from_dict
+
+    host, _, port = info.rpartition(":")
+    host = host or "localhost"
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # bare IPv6 hostIP must be bracketed in URLs
+    url = f"http://{host}:{port}/v1/samples"
+    resp = requests.get(url, timeout=5)
+    resp.raise_for_status()
+    return [sample_from_dict(d) for d in resp.json()]
+
+
+def collect_local() -> List[ChipSample]:
+    """On-node sampling chain (what the health engine itself runs)."""
     if os.environ.get("TPU_FAKE_CHIPS"):
         return collect_fake()
     samples = collect_sysfs()
@@ -101,6 +121,13 @@ def collect() -> List[ChipSample]:
     if os.environ.get("LIBTPU_EXPORTER_USE_JAX", "").lower() == "true":
         return collect_jax()
     return []
+
+
+def collect() -> List[ChipSample]:
+    remote = os.environ.get("TPU_HEALTH_ENGINE_INFO")
+    if remote:
+        return collect_remote(remote)
+    return collect_local()
 
 
 class LibtpuExporter:
@@ -121,7 +148,14 @@ class LibtpuExporter:
                            labelnames=("node",), registry=self.registry)
 
     def collect_once(self) -> int:
-        samples = collect()
+        # a failed collection (health engine down, sysfs gone) must clear
+        # the series, not leave them — and must not kill the exporter: the
+        # engine DaemonSet has no startup ordering relative to this one
+        try:
+            samples = collect()
+        except Exception:
+            log.exception("collection failed; clearing series")
+            samples = []
         # drop series for chips that disappeared — serving a vanished
         # chip's last values forever would hide the failure from alerts
         for gauge in (self.duty_cycle, self.hbm_used, self.hbm_total,

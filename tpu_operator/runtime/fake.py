@@ -107,7 +107,22 @@ class FakeClient(Client):
             meta.setdefault("generation", 1)
             meta.setdefault("creationTimestamp", "1970-01-01T00:00:00Z")
             self._store[key] = obj
+            # creating with an ownerReference to an already-deleted owner:
+            # the real apiserver accepts this and the GC controller collects
+            # it shortly after; the fake compresses that to "immediately",
+            # which closes the CR-deleted-mid-reconcile race deterministically
+            live_uids = {get_nested(o, "metadata", "uid")
+                         for o in self._store.values()}
+            orphaned = any(
+                r.get("uid") and r.get("uid") not in live_uids
+                for r in meta.get("ownerReferences") or [])
         self._publish("ADDED", obj)
+        if orphaned:
+            try:
+                self.delete(obj.get("apiVersion", ""), obj.get("kind", ""),
+                            name_of(obj), namespace_of(obj) or None)
+            except NotFoundError:
+                pass
         return deepcopy_obj(obj)
 
     def update(self, obj):

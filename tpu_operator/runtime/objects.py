@@ -108,6 +108,15 @@ def annotations_of(obj: Mapping) -> dict:
     return get_nested(obj, "metadata", "annotations", default={}) or {}
 
 
+def label_delta(have: Mapping, want: Mapping) -> dict:
+    """The patch-worthy subset of ``want`` against ``have``: keys whose
+    value changed, plus removals (value None) only for keys actually
+    present — a removal patch for an absent key would be a no-op write
+    that still churns resourceVersions."""
+    return {k: v for k, v in want.items()
+            if have.get(k) != v and not (v is None and k not in have)}
+
+
 def set_label(obj: dict, key: str, value: str) -> None:
     set_nested(obj, value, "metadata", "labels", key)
 

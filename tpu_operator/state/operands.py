@@ -11,9 +11,16 @@ registration order, SURVEY.md section 2.2) onto the TPU stack:
 | 4     | tpu-runtime            | state-container-toolkit           |
 | 5     | operator-validation    | state-operator-validation         |
 | 6     | tpu-device-plugin      | state-device-plugin               |
-| 7     | metrics-exporter       | state-dcgm + state-dcgm-exporter  |
-| 8     | node-status-exporter   | state-node-status-exporter        |
-| 9     | topology-manager       | state-mig-manager                 |
+| 7     | tpu-health             | state-dcgm (standalone engine)    |
+| 8     | metrics-exporter       | state-dcgm-exporter               |
+| 9     | feature-discovery      | gpu-feature-discovery             |
+| 10    | node-status-exporter   | state-node-status-exporter        |
+| 11    | topology-manager       | state-mig-manager                 |
+
+The MPS-control-daemon slot (#7 in the reference's order) is covered by
+the device plugin's time-shared replication (deviceplugin/plugin.py
+``sharing_replicas``) rather than a separate daemon — TPU sharing is an
+advertisement policy, not a control process.
 
 Sandbox/vGPU/kata/CC states have no TPU analog (SURVEY.md section 7:
 documented out of scope).
@@ -177,6 +184,17 @@ def _device_plugin_data(ctx: SyncContext) -> dict:
     data = common_data(ctx, spec, "tpu-device-plugin", "tpu-device-plugin")
     data["ResourceName"] = spec.resource_name or "google.com/tpu"
     data["SharingPolicy"] = spec.sharing_policy or "exclusive"
+    # replication only takes effect under time-shared; exclusive pins 1
+    data["SharingReplicas"] = (spec.sharing_replicas or 1) \
+        if data["SharingPolicy"] == "time-shared" else 1
+    return data
+
+
+def _tpu_health_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.tpu_health
+    data = common_data(ctx, spec, "tpu-health", "tpu-health-engine")
+    data["Port"] = spec.port or 9402
+    data["Interval"] = spec.collection_interval_seconds or 15
     return data
 
 
@@ -186,6 +204,18 @@ def _metrics_exporter_data(ctx: SyncContext) -> dict:
     data["Port"] = spec.port or 9400
     data["Interval"] = spec.collection_interval_seconds or 15
     data["ServiceMonitor"] = bool(spec.service_monitor)
+    # standalone health engine enabled -> exporter presents its samples
+    # (DCGM_REMOTE_HOSTENGINE_INFO split, object_controls.go:113-116)
+    health = ctx.spec.tpu_health
+    data["HealthEngineInfo"] = (
+        f"$(NODE_IP):{health.port or 9402}" if health.is_enabled() else "")
+    return data
+
+
+def _feature_discovery_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.feature_discovery
+    data = common_data(ctx, spec, "feature-discovery", "tpu-feature-discovery")
+    data["Interval"] = spec.interval_seconds or 60
     return data
 
 
@@ -225,9 +255,15 @@ def build_states(manifests_root: Optional[pathlib.Path] = None) -> List[State]:
         mk("tpu-device-plugin", "google.com/tpu device plugin",
            _device_plugin_data,
            enabled_fn=lambda ctx: ctx.spec.device_plugin.is_enabled()),
+        mk("tpu-health", "standalone telemetry/health engine",
+           _tpu_health_data,
+           enabled_fn=lambda ctx: ctx.spec.tpu_health.is_enabled()),
         mk("metrics-exporter", "libtpu metrics exporter",
            _metrics_exporter_data,
            enabled_fn=lambda ctx: ctx.spec.metrics_exporter.is_enabled()),
+        mk("feature-discovery", "TPU property labels",
+           _feature_discovery_data,
+           enabled_fn=lambda ctx: ctx.spec.feature_discovery.is_enabled()),
         mk("node-status-exporter", "validation status metrics",
            _node_status_exporter_data,
            enabled_fn=lambda ctx: ctx.spec.node_status_exporter.is_enabled()),

@@ -29,6 +29,7 @@ KNOWN_STATUS_FILES = (
     "plugin-ready",
     "ici-ready",
     "hbm-ready",
+    "dcn-ready",
     "topology-ready",
     ".driver-ctr-ready",
 )

@@ -16,6 +16,10 @@ status file. Component -> proof:
                fraction of ICI peak >= threshold (the BASELINE.md north
                star; nothing like it exists for NCCL in the reference,
                where fabric checks are presence-only)
+- ``dcn``      multi-slice only: the megascale coordinator resolves and
+               accepts a TCP connect over the data-center network (the
+               fabric-enablement slot MOFED/GDS checks fill in the
+               reference, main.go:1002-1084); skipped single-slice
 - ``plugin``   google.com/tpu extended resource allocatable on this node,
                then a pod *requesting* one TPU schedules and runs
                (main.go:1086-1253 analog)
@@ -243,6 +247,51 @@ def validate_hbm(threshold: Optional[float] = None,
                 f"below the {thr:.0%} threshold")
     barrier.write_status("hbm-ready", info)
     return info
+
+
+def validate_dcn(timeout: Optional[float] = None) -> Dict[str, str]:
+    """Multi-slice DCN reachability (SURVEY.md section 5: the TPU analog
+    of the reference's fabric-enablement checks — MOFED/GDS presence,
+    validator/main.go:1002-1084 — is proving the *data-center network*
+    path between slices). Multi-slice jobs discover each other through the
+    megascale coordinator; this proof resolves and TCP-connects it. On a
+    single-slice node there is no DCN to validate — skipped, like the
+    reference's MOFED check on nodes without the Mellanox PCI label
+    (main.go:204)."""
+    import socket
+
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1") or 1)
+    coordinator = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+    if num_slices <= 1 or not coordinator:
+        info = {"SKIPPED": "single-slice node, no DCN to validate",
+                "NUM_SLICES": str(num_slices)}
+        barrier.write_status("dcn-ready", info)
+        return info
+    host, _, port_s = coordinator.partition(":")
+    port = int(port_s or 8080)
+    deadline = time.monotonic() + (
+        timeout if timeout is not None
+        else float(os.environ.get("DCN_TIMEOUT_S", "60")))
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        start = time.perf_counter()
+        try:
+            with socket.create_connection((host, port), timeout=5.0):
+                rtt_ms = (time.perf_counter() - start) * 1e3
+            info = {
+                "COORDINATOR": coordinator,
+                "NUM_SLICES": str(num_slices),
+                "SLICE_ID": os.environ.get("MEGASCALE_SLICE_ID", ""),
+                "RTT_MS": f"{rtt_ms:.2f}",
+            }
+            barrier.write_status("dcn-ready", info)
+            return info
+        except OSError as e:
+            last_err = e
+            time.sleep(1.0)
+    raise ValidationFailed(
+        f"megascale coordinator {coordinator} unreachable over DCN: "
+        f"{last_err}")
 
 
 def component_sleep() -> None:  # pragma: no cover - blocks forever
