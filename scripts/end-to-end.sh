@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Shell e2e — the tests/scripts/end-to-end.sh tier of the reference
+# (install-operator -> verify-operator -> workload -> update-clusterpolicy
+# -> restart-operator -> uninstall), run against the in-memory cluster so
+# it needs no kubeconfig or TPU hardware. CI entrypoint:
+#
+#     bash scripts/end-to-end.sh
+#
+# Each stage prints STAGE_OK <name>; the script fails fast on any error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY=${PYTHON:-python}
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+
+stage() { echo "STAGE_OK $1"; }
+
+# -- install-operator: the full bundle must render and self-validate ------
+$PY -m tpu_operator.cli.tpuop_cfg generate all > "$WORK/bundle.yaml"
+grep -q "kind: CustomResourceDefinition" "$WORK/bundle.yaml"
+grep -q "kind: TPUClusterPolicy" "$WORK/bundle.yaml"
+$PY -m tpu_operator.cli.tpuop_cfg generate bundle > "$WORK/csv.yaml"
+grep -q "BundleMetadata" "$WORK/csv.yaml"
+stage install-manifests
+
+# -- values pipeline: user overrides render a valid, merged CR ------------
+cat > "$WORK/values.yaml" <<'EOF'
+clusterPolicy:
+  spec:
+    tpuHealth:
+      enabled: true
+    metricsExporter:
+      serviceMonitor: true
+EOF
+$PY -m tpu_operator.cli.tpuop_cfg generate all --values "$WORK/values.yaml" \
+    > "$WORK/bundle-custom.yaml"
+grep -q "serviceMonitor: true" "$WORK/bundle-custom.yaml"
+if $PY -m tpu_operator.cli.tpuop_cfg generate all \
+       --values <(echo "bogusKey: {}") >/dev/null 2>"$WORK/err"; then
+  echo "FAIL: invalid values accepted"; exit 1
+fi
+grep -q "INVALID values" "$WORK/err"
+stage values-pipeline
+
+# -- offline CR validation (gpuop-cfg slot) -------------------------------
+$PY - > "$WORK/policy.yaml" <<'EOF'
+import yaml
+from tpu_operator.deploy.packaging import sample_cluster_policy
+print(yaml.safe_dump(sample_cluster_policy()), end="")
+EOF
+$PY -m tpu_operator.cli.tpuop_cfg validate clusterpolicy -f "$WORK/policy.yaml"
+stage validate-clusterpolicy
+
+# -- verify-operator: reconcile the fake cluster to all-operands-Ready ----
+$PY -m tpu_operator.cli.operator --fake-cluster --once > "$WORK/op1.log" 2>&1
+grep -q "reached ready" "$WORK/op1.log"
+stage verify-operator
+
+# -- restart-operator: a fresh manager must converge again (stateless) ----
+$PY -m tpu_operator.cli.operator --fake-cluster --once > "$WORK/op2.log" 2>&1
+grep -q "reached ready" "$WORK/op2.log"
+stage restart-operator
+
+# -- per-node validation components (validator barrier protocol) ----------
+export TPU_VALIDATION_DIR="$WORK/validations"
+mkdir -p "$TPU_VALIDATION_DIR"
+TPU_FAKE_CHIPS=4 $PY -m tpu_operator.cli.validator -c driver
+test -f "$TPU_VALIDATION_DIR/driver-ready"
+TPU_FAKE_CHIPS=4 $PY -m tpu_operator.cli.validator -c runtime
+$PY -m tpu_operator.cli.validator -c dcn   # single-slice skip path
+test -f "$TPU_VALIDATION_DIR/dcn-ready"
+$PY -m tpu_operator.cli.validator cleanup
+test ! -f "$TPU_VALIDATION_DIR/driver-ready"
+stage validator-components
+
+# -- workload proof (the cuda-workload slot): single-device JAX matmul ----
+JAX_PLATFORMS=cpu TPU_VALIDATOR_ALLOW_CPU=true MATMUL_SIZE=256 \
+    $PY -m tpu_operator.cli.validator -c jax
+test -f "$TPU_VALIDATION_DIR/jax-ready"
+stage workload-proof
+
+echo "END_TO_END_OK"

@@ -63,6 +63,125 @@ class TestValidate:
         assert any("expected string" in e for e in errs)
 
 
+class TestValues:
+    """Values-driven bundle (Helm values.yaml slot) + the
+    validate-helm-values/validate-csv drift gates as render-time checks."""
+
+    def test_default_values_render_valid_policy(self):
+        from tpu_operator.deploy.values import load_values, render_cluster_policy
+
+        cr = render_cluster_policy(load_values())
+        errs, _ = validate_cr(cr)
+        assert errs == []
+
+    def test_user_values_deep_merge(self, tmp_path):
+        from tpu_operator.deploy.values import load_values
+
+        f = tmp_path / "values.yaml"
+        f.write_text(yaml.safe_dump({
+            "namespace": "accel-system",
+            "clusterPolicy": {"spec": {"tpuHealth": {"enabled": True}}},
+        }))
+        vals = load_values(str(f))
+        assert vals["namespace"] == "accel-system"
+        # merged, not replaced: defaults keep sibling keys
+        assert vals["clusterPolicy"]["spec"]["tpuHealth"]["enabled"] is True
+        assert vals["clusterPolicy"]["spec"]["libtpu"]["channel"] == "stable"
+
+    def test_unknown_top_level_key_rejected(self, tmp_path):
+        import pytest
+
+        from tpu_operator.deploy.values import load_values
+
+        f = tmp_path / "values.yaml"
+        f.write_text("operatorr: {}\n")
+        with pytest.raises(ValueError, match="unknown top-level"):
+            load_values(str(f))
+
+    def test_invalid_spec_fails_at_render(self, tmp_path):
+        import pytest
+
+        from tpu_operator.deploy.values import load_values, render_bundle
+
+        f = tmp_path / "values.yaml"
+        f.write_text(yaml.safe_dump({
+            "clusterPolicy": {"spec": {"devicePlugin": {"bogus": 1}}}}))
+        with pytest.raises(ValueError, match="invalid TPUClusterPolicy"):
+            render_bundle(load_values(str(f)))
+
+    def test_bundle_stream_kinds(self):
+        from tpu_operator.deploy.values import load_values, render_bundle
+
+        kinds = [d["kind"] for d in render_bundle(load_values())]
+        assert kinds == ["CustomResourceDefinition",
+                         "CustomResourceDefinition", "Namespace",
+                         "ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding", "Deployment",
+                         "TPUClusterPolicy"]
+
+    def test_operator_image_digest_form(self):
+        from tpu_operator.deploy.values import operator_image
+
+        img = operator_image({"operator": {"version": "sha256:" + "0" * 8}})
+        assert "@sha256:" in img and ":sha256" not in img.replace("@sha256", "")
+
+    def test_cli_generate_with_values(self, tmp_path, capsys):
+        f = tmp_path / "values.yaml"
+        f.write_text(yaml.safe_dump(
+            {"clusterPolicy": {"spec": {"metricsExporter":
+                                        {"serviceMonitor": True}}}}))
+        assert main(["generate", "all", "--values", str(f)]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        cr = [d for d in docs if d["kind"] == "TPUClusterPolicy"]
+        assert cr[0]["spec"]["metricsExporter"]["serviceMonitor"] is True
+
+    def test_cli_generate_invalid_values_fails(self, tmp_path, capsys):
+        f = tmp_path / "values.yaml"
+        f.write_text("unknownKey: {}\n")
+        assert main(["generate", "all", "--values", str(f)]) == 1
+        assert "INVALID values" in capsys.readouterr().err
+
+    def test_bundle_metadata_owns_both_crds(self, capsys):
+        assert main(["generate", "bundle"]) == 0
+        [meta] = list(yaml.safe_load_all(capsys.readouterr().out))
+        owned = {c["kind"] for c in
+                 meta["spec"]["customresourcedefinitions"]["owned"]}
+        assert owned == {"TPUClusterPolicy", "TPUDriver"}
+        assert meta["spec"]["relatedImages"]
+
+    def test_crds_ignore_values_file(self, tmp_path, capsys):
+        # CRD output is values-independent; a broken values file must not
+        # block `generate crds` pipelines
+        f = tmp_path / "values.yaml"
+        f.write_text("bogusKey: {}\n")
+        assert main(["generate", "crds", "--values", str(f)]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert all(d["kind"] == "CustomResourceDefinition" for d in docs)
+
+    def test_explicit_namespace_flag_beats_values(self, tmp_path, capsys):
+        f = tmp_path / "values.yaml"
+        f.write_text("namespace: accel-system\n")
+        assert main(["generate", "operator", "--values", str(f),
+                     "-n", "tpu-operator"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        ns = [d for d in docs if d["kind"] == "Namespace"]
+        assert ns[0]["metadata"]["name"] == "tpu-operator"
+
+    def test_non_string_operator_version_rejected(self, tmp_path, capsys):
+        f = tmp_path / "values.yaml"
+        f.write_text("operator:\n  version: 1.25\n")
+        assert main(["generate", "all", "--values", str(f)]) == 1
+        assert "operator.version" in capsys.readouterr().err
+
+    def test_cluster_policy_disabled(self, tmp_path):
+        from tpu_operator.deploy.values import load_values, render_bundle
+
+        f = tmp_path / "values.yaml"
+        f.write_text(yaml.safe_dump({"clusterPolicy": {"enabled": False}}))
+        kinds = [d["kind"] for d in render_bundle(load_values(str(f)))]
+        assert "TPUClusterPolicy" not in kinds
+
+
 class TestGenerate:
     def test_crds(self):
         docs = generate("crds")

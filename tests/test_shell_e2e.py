@@ -1,0 +1,26 @@
+"""Tier-3 shell e2e (tests/scripts/end-to-end.sh slot): the full install
+-> verify -> restart -> validate -> workload pipeline through the real
+CLIs, as CI would run it."""
+
+import pathlib
+import subprocess
+import sys
+
+
+def test_end_to_end_script():
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        ["bash", str(repo / "scripts" / "end-to-end.sh")],
+        capture_output=True, text=True, timeout=600,
+        env={"PATH": "/usr/bin:/bin", "PYTHON": sys.executable,
+             "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+             "HOME": "/tmp"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "END_TO_END_OK" in proc.stdout
+    stages = [ln.split()[1] for ln in proc.stdout.splitlines()
+              if ln.startswith("STAGE_OK")]
+    assert stages == ["install-manifests", "values-pipeline",
+                      "validate-clusterpolicy", "verify-operator",
+                      "restart-operator", "validator-components",
+                      "workload-proof"]

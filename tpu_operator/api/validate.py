@@ -1,0 +1,101 @@
+"""Offline CR validation — schema + image resolvability.
+
+Library core of the gpuop-cfg validation path
+(cmd/gpuop-cfg/validate/clusterpolicy analog): used by the tpuop-cfg CLI
+and by the deploy bundle renderer (a values file that renders an invalid
+CR must fail at render time).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+from . import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
+from .crd import cluster_policy_crd, tpu_driver_crd
+
+
+def _schema_errors(obj: Any, schema: dict, path: str = "") -> List[str]:
+    """Minimal openAPIV3Schema checker: types, enums, unknown properties."""
+    errs: List[str] = []
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return errs
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(obj, dict):
+            return [f"{path or '.'}: expected object, got {type(obj).__name__}"]
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        for k, v in obj.items():
+            if v is None:
+                continue
+            sub = None
+            if props and k in props:
+                sub = props[k]
+            elif addl:
+                sub = addl
+            elif props is not None:
+                errs.append(f"{path}/{k}: unknown field")
+                continue
+            if sub:
+                errs.extend(_schema_errors(v, sub, f"{path}/{k}"))
+    elif t == "array":
+        if not isinstance(obj, list):
+            return [f"{path}: expected array, got {type(obj).__name__}"]
+        for i, v in enumerate(obj):
+            errs.extend(_schema_errors(v, schema.get("items", {}),
+                                       f"{path}[{i}]"))
+    elif t == "string":
+        if not isinstance(obj, str):
+            errs.append(f"{path}: expected string, got {type(obj).__name__}")
+        elif "enum" in schema and obj not in schema["enum"]:
+            errs.append(f"{path}: {obj!r} not in {schema['enum']}")
+    elif t == "integer":
+        if not isinstance(obj, int) or isinstance(obj, bool):
+            errs.append(f"{path}: expected integer, got {type(obj).__name__}")
+    elif t == "number":
+        if not isinstance(obj, (int, float)) or isinstance(obj, bool):
+            errs.append(f"{path}: expected number, got {type(obj).__name__}")
+    elif t == "boolean":
+        if not isinstance(obj, bool):
+            errs.append(f"{path}: expected boolean, got {type(obj).__name__}")
+    return errs
+
+
+def _image_errors(cr: dict) -> List[str]:
+    """Every operand with explicit image fields must resolve."""
+    from .image import image_path
+
+    errs = []
+    spec = cr.get("spec") or {}
+    for component, body in spec.items():
+        if not isinstance(body, dict):
+            continue
+        fields = {k: body.get(k) for k in ("repository", "image", "version")}
+        if not any(fields.values()):
+            continue  # built-in defaults apply
+        try:
+            image_path(component, fields["repository"], fields["image"],
+                       fields["version"])
+        except ValueError as e:
+            errs.append(f"/spec/{component}: {e}")
+    return errs
+
+
+def validate_cr(cr: dict) -> Tuple[List[str], str]:
+    kind = cr.get("kind", "")
+    if kind == KIND_CLUSTER_POLICY:
+        crd, want_av = cluster_policy_crd(), V1
+    elif kind == KIND_TPU_DRIVER:
+        crd, want_av = tpu_driver_crd(), V1ALPHA1
+    else:
+        return ([f"unsupported kind {kind!r}"], kind)
+    errs = []
+    if cr.get("apiVersion") != want_av:
+        errs.append(f"apiVersion: want {want_av}, got {cr.get('apiVersion')}")
+    if not (cr.get("metadata") or {}).get("name"):
+        errs.append("metadata.name: required")
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    errs.extend(_schema_errors(cr.get("spec") or {},
+                               schema["properties"]["spec"], "/spec"))
+    errs.extend(_image_errors(cr))
+    return errs, kind

@@ -15,99 +15,38 @@ from __future__ import annotations
 
 import argparse
 import sys
-from typing import Any, List, Tuple
 
 import yaml
 
-from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
-from ..api.crd import cluster_policy_crd, tpu_driver_crd
+from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER
+from ..api.validate import validate_cr  # noqa: F401  (re-export; library home)
 
 
-def _schema_errors(obj: Any, schema: dict, path: str = "") -> List[str]:
-    """Minimal openAPIV3Schema checker: types, enums, unknown properties."""
-    errs: List[str] = []
-    if schema.get("x-kubernetes-preserve-unknown-fields"):
-        return errs
-    t = schema.get("type")
-    if t == "object":
-        if not isinstance(obj, dict):
-            return [f"{path or '.'}: expected object, got {type(obj).__name__}"]
-        props = schema.get("properties")
-        addl = schema.get("additionalProperties")
-        for k, v in obj.items():
-            if v is None:
-                continue
-            sub = None
-            if props and k in props:
-                sub = props[k]
-            elif addl:
-                sub = addl
-            elif props is not None:
-                errs.append(f"{path}/{k}: unknown field")
-                continue
-            if sub:
-                errs.extend(_schema_errors(v, sub, f"{path}/{k}"))
-    elif t == "array":
-        if not isinstance(obj, list):
-            return [f"{path}: expected array, got {type(obj).__name__}"]
-        for i, v in enumerate(obj):
-            errs.extend(_schema_errors(v, schema.get("items", {}),
-                                       f"{path}[{i}]"))
-    elif t == "string":
-        if not isinstance(obj, str):
-            errs.append(f"{path}: expected string, got {type(obj).__name__}")
-        elif "enum" in schema and obj not in schema["enum"]:
-            errs.append(f"{path}: {obj!r} not in {schema['enum']}")
-    elif t == "integer":
-        if not isinstance(obj, int) or isinstance(obj, bool):
-            errs.append(f"{path}: expected integer, got {type(obj).__name__}")
-    elif t == "number":
-        if not isinstance(obj, (int, float)) or isinstance(obj, bool):
-            errs.append(f"{path}: expected number, got {type(obj).__name__}")
-    elif t == "boolean":
-        if not isinstance(obj, bool):
-            errs.append(f"{path}: expected boolean, got {type(obj).__name__}")
-    return errs
+def _generate_docs(args):
+    """Resolve a generate invocation to a manifest stream, or None on a
+    values error (already printed)."""
+    from ..deploy import values as values_mod
+    from ..deploy.packaging import generate
 
-
-def _image_errors(cr: dict) -> List[str]:
-    """Every operand with explicit image fields must resolve."""
-    from ..api.image import image_path
-
-    errs = []
-    spec = cr.get("spec") or {}
-    for component, body in spec.items():
-        if not isinstance(body, dict):
-            continue
-        fields = {k: body.get(k) for k in ("repository", "image", "version")}
-        if not any(fields.values()):
-            continue  # built-in defaults apply
+    namespace = args.namespace or "tpu-operator"
+    # CRD output is values-independent: never gate it on a values file
+    if (args.values or args.what == "bundle") and args.what != "crds":
         try:
-            image_path(component, fields["repository"], fields["image"],
-                       fields["version"])
-        except ValueError as e:
-            errs.append(f"/spec/{component}: {e}")
-    return errs
-
-
-def validate_cr(cr: dict) -> Tuple[List[str], str]:
-    kind = cr.get("kind", "")
-    if kind == KIND_CLUSTER_POLICY:
-        crd, want_av = cluster_policy_crd(), V1
-    elif kind == KIND_TPU_DRIVER:
-        crd, want_av = tpu_driver_crd(), V1ALPHA1
-    else:
-        return ([f"unsupported kind {kind!r}"], kind)
-    errs = []
-    if cr.get("apiVersion") != want_av:
-        errs.append(f"apiVersion: want {want_av}, got {cr.get('apiVersion')}")
-    if not (cr.get("metadata") or {}).get("name"):
-        errs.append("metadata.name: required")
-    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
-    errs.extend(_schema_errors(cr.get("spec") or {},
-                               schema["properties"]["spec"], "/spec"))
-    errs.extend(_image_errors(cr))
-    return errs, kind
+            vals = values_mod.load_values(args.values or None)
+            if args.namespace is not None:
+                vals["namespace"] = namespace
+            if args.image:
+                print("--image is ignored with --values/bundle "
+                      "(set operator.{repository,image,version})",
+                      file=sys.stderr)
+            if args.what == "bundle":
+                return [values_mod.render_bundle_metadata(vals)]
+            return values_mod.render_bundle(
+                vals, include_crds=(args.what == "all"))
+        except (OSError, ValueError, yaml.YAMLError) as e:
+            print(f"INVALID values: {e}", file=sys.stderr)
+            return None
+    return generate(args.what, namespace=namespace, image=args.image)
 
 
 def main(argv=None) -> int:
@@ -119,16 +58,22 @@ def main(argv=None) -> int:
     v.add_argument("-f", "--file", required=True)
 
     g = sub.add_parser("generate", help="emit deployment manifests")
-    g.add_argument("what", choices=["crds", "operator", "all"])
-    g.add_argument("-n", "--namespace", default="tpu-operator")
+    g.add_argument("what", choices=["crds", "operator", "all", "bundle"])
+    g.add_argument("-n", "--namespace", default=None,
+                   help="default tpu-operator; with --values, an explicit "
+                        "flag overrides the values file")
     g.add_argument("--image", default="")
+    g.add_argument("--values", default="",
+                   help="values file merged over deploy/values.yaml "
+                        "(Helm-values slot); implies schema validation of "
+                        "the rendered ClusterPolicy")
 
     args = p.parse_args(argv)
 
     if args.cmd == "generate":
-        from ..deploy.packaging import generate
-
-        docs = generate(args.what, namespace=args.namespace, image=args.image)
+        docs = _generate_docs(args)
+        if docs is None:
+            return 1
         try:
             print(yaml.safe_dump_all(docs, sort_keys=False), end="")
             sys.stdout.flush()

@@ -1,0 +1,158 @@
+"""Values-driven bundle rendering — the Helm values.yaml slot.
+
+The reference's chart (deployments/gpu-operator/values.yaml, 546 lines)
+renders the ClusterPolicy CR plus the operator Deployment/RBAC from one
+values file, and CI keeps values and CRD schema consistent
+(``make validate-helm-values``/``validate-csv``, Makefile:233-243). Here
+the same contract is code:
+
+- ``deploy/values.yaml`` is the documented default values file,
+- ``load_values()`` deep-merges a user file over the defaults and rejects
+  unknown top-level keys,
+- ``render_bundle()`` produces the full install stream (CRDs, namespace,
+  RBAC, Deployment, ClusterPolicy) and **validates the rendered CR
+  against the CRD schema before emitting it** — the drift gate runs at
+  render time, not in a separate CI step.
+
+CLI: ``tpuop-cfg generate all --values my-values.yaml``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from .. import __version__
+from ..api import new_cluster_policy
+from .packaging import (
+    cluster_role,
+    cluster_role_binding,
+    namespace_manifest,
+    operator_deployment,
+    service_account,
+)
+
+# shipped as package data so pip installs carry it (see pyproject
+# [tool.setuptools.package-data])
+VALUES_FILE = pathlib.Path(__file__).resolve().parent / "values.yaml"
+
+TOP_LEVEL_KEYS = {"namespace", "operator", "clusterPolicy"}
+
+
+def default_values() -> Dict[str, Any]:
+    with open(VALUES_FILE) as f:
+        return yaml.safe_load(f) or {}
+
+
+def deep_merge(base: Dict, override: Dict) -> Dict:
+    """Helm-style merge: maps merge recursively, scalars/lists replace."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_values(path: Optional[str] = None) -> Dict[str, Any]:
+    values = default_values()
+    if path:
+        with open(path) as f:
+            user = yaml.safe_load(f) or {}
+        if not isinstance(user, dict):
+            raise ValueError(f"{path}: values file must be a mapping")
+        unknown = set(user) - TOP_LEVEL_KEYS
+        if unknown:
+            raise ValueError(
+                f"{path}: unknown top-level keys {sorted(unknown)} "
+                f"(known: {sorted(TOP_LEVEL_KEYS)})")
+        values = deep_merge(values, user)
+    return values
+
+
+def operator_image(values: Dict[str, Any]) -> str:
+    op = values.get("operator") or {}
+    if not isinstance(op, dict):
+        raise ValueError("operator: must be a mapping")
+    # `or` (not dict defaults) so explicit nulls fall back too; reject
+    # non-string scalars (a YAML float version would otherwise crash or
+    # render a bogus image reference)
+    repo = op.get("repository") or "ghcr.io/tpu-operator"
+    image = op.get("image") or "tpu-operator"
+    version = op.get("version") or f"v{__version__}"
+    for name, val in (("repository", repo), ("image", image),
+                      ("version", version)):
+        if not isinstance(val, str):
+            raise ValueError(
+                f"operator.{name}: expected string, got {val!r} "
+                f"(quote it in the values file)")
+    if version.startswith("sha256:"):
+        return f"{repo}/{image}@{version}"
+    return f"{repo}/{image}:{version}"
+
+
+def render_cluster_policy(values: Dict[str, Any]) -> Optional[dict]:
+    cp = values.get("clusterPolicy") or {}
+    if not cp.get("enabled", True):
+        return None
+    cr = new_cluster_policy(name=cp.get("name", "tpu-cluster-policy"),
+                            spec=cp.get("spec") or {})
+    # the validate-helm-values gate, inline: a values file that renders an
+    # invalid CR fails at render time with the schema errors
+    from ..api.validate import validate_cr
+
+    errs, _ = validate_cr(cr)
+    if errs:
+        raise ValueError("values render an invalid TPUClusterPolicy:\n  " +
+                         "\n  ".join(errs))
+    return cr
+
+
+def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dict]:
+    from ..api.crd import all_crds
+
+    ns = values.get("namespace", "tpu-operator")
+    docs: List[dict] = []
+    if include_crds:
+        docs.extend(all_crds())
+    docs.extend([
+        namespace_manifest(ns),
+        service_account(ns),
+        cluster_role(),
+        cluster_role_binding(ns),
+        operator_deployment(ns, operator_image(values)),
+    ])
+    cr = render_cluster_policy(values)
+    if cr is not None:
+        docs.append(cr)
+    return docs
+
+
+def render_bundle_metadata(values: Dict[str, Any]) -> dict:
+    """OLM CSV-slot metadata (bundle/ analog): what this bundle installs,
+    which CRDs it owns, and the images it references — the facts the
+    reference's ClusterServiceVersion carries."""
+    from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
+
+    return {
+        "apiVersion": "tpu.graft.dev/v1",
+        "kind": "BundleMetadata",
+        "metadata": {"name": f"tpu-operator.v{__version__}"},
+        "spec": {
+            "version": __version__,
+            "displayName": "TPU Operator",
+            "provider": "tpu-operator",
+            "customresourcedefinitions": {
+                "owned": [
+                    {"kind": KIND_CLUSTER_POLICY, "version": V1,
+                     "name": "tpuclusterpolicies.tpu.graft.dev"},
+                    {"kind": KIND_TPU_DRIVER, "version": V1ALPHA1,
+                     "name": "tpudrivers.tpu.graft.dev"},
+                ],
+            },
+            "relatedImages": [operator_image(values)],
+        },
+    }
