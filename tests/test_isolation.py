@@ -1,0 +1,425 @@
+"""Isolated-workload plane: chip fencing (vfio-manager slot), vTPU device
+manager (vgpu-device-manager slot), isolated device plugin
+(sandbox-device-plugin slot), the fencing/vtpu validator proofs
+(sandbox-validation slot), and the workload-config routing that puts the
+plane only on isolated/virtual nodes (SURVEY.md section 2.2 rows 13-17)."""
+
+import json
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.clusterpolicy import (
+    KIND_CLUSTER_POLICY,
+    V1,
+    TPUClusterPolicySpec,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.controllers.state_manager import desired_node_labels
+from tpu_operator.isolation.fencing import (
+    FencingAgent,
+    fenced_chips,
+    read_fencing_file,
+    resolve_fence_set,
+    write_fencing_file,
+)
+from tpu_operator.isolation.vtpu import (
+    VTPUDeviceManager,
+    VTPUProfile,
+    build_vtpu_devices,
+    load_vtpu_profiles,
+    read_vtpu_file,
+)
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.validator import barrier, components
+
+V5E_LABELS = {
+    L.GKE_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+    L.GKE_TPU_TOPOLOGY: "2x2",
+    L.GKE_ACCELERATOR_COUNT: "4",
+}
+
+PROFILES_YAML = """
+profiles:
+  vtpu-2:
+    description: halves
+    vtpusPerChip: 2
+  vtpu-4:
+    vtpusPerChip: 4
+    hbmMbPerVtpu: 3000
+"""
+
+
+@pytest.fixture
+def isolation_env(tmp_path, monkeypatch):
+    """Fake chips + tmp hostPath files for the whole plane."""
+    monkeypatch.setenv("TPU_FAKE_CHIPS", "4")
+    monkeypatch.setenv("TPU_FENCING_FILE", str(tmp_path / "fencing.json"))
+    monkeypatch.setenv("TPU_VTPU_FILE", str(tmp_path / "vtpu-config.json"))
+    monkeypatch.setenv("TPU_VALIDATION_DIR", str(tmp_path / "validations"))
+    monkeypatch.delenv("TPU_WORKLOAD_CONFIG", raising=False)
+    return tmp_path
+
+
+class TestFenceResolution:
+    def test_all_none_and_explicit(self):
+        chips = ["accel0", "accel1", "accel2"]
+        assert resolve_fence_set("all", chips) == chips
+        assert resolve_fence_set("none", chips) == []
+        assert resolve_fence_set("accel1, accel2", chips) == [
+            "accel1", "accel2"]
+
+    def test_unknown_chip_is_an_error(self):
+        with pytest.raises(ValueError, match="accel9"):
+            resolve_fence_set("accel9", ["accel0"])
+
+
+class TestFencingAgent:
+    def test_apply_all_writes_file_and_state(self, isolation_env):
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        path = str(isolation_env / "fencing.json")
+        agent = FencingAgent(c, "tpu-0", fencing_file=path)
+        assert agent.apply_once() == "success"
+        cfg = read_fencing_file(path)
+        assert cfg["fenced"] == [f"accel{i}" for i in range(4)]
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][L.FENCING_STATE] == "success"
+        assert fenced_chips() == cfg["fenced"]
+
+    def test_label_overrides_default(self, isolation_env):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.FENCING_CONFIG: "accel0,accel1"})
+        path = str(isolation_env / "fencing.json")
+        agent = FencingAgent(c, "tpu-0", default_config="all",
+                             fencing_file=path)
+        assert agent.apply_once() == "success"
+        assert read_fencing_file(path)["fenced"] == ["accel0", "accel1"]
+
+    def test_bad_config_marks_failed(self, isolation_env):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.FENCING_CONFIG: "accel77"})
+        agent = FencingAgent(c, "tpu-0",
+                             fencing_file=str(isolation_env / "fencing.json"))
+        assert agent.apply_once() == "failed"
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][L.FENCING_STATE] == "failed"
+
+
+class TestVTPU:
+    def test_profiles_load(self, tmp_path):
+        f = tmp_path / "config.yaml"
+        f.write_text(PROFILES_YAML)
+        profiles = load_vtpu_profiles(str(f))
+        assert profiles["vtpu-2"].vtpus_per_chip == 2
+        assert profiles["vtpu-4"].hbm_mb_per_vtpu == 3000
+
+    def test_build_devices_even_hbm_split(self):
+        devs = build_vtpu_devices(["accel0", "accel1"],
+                                  VTPUProfile("vtpu-2", 2), hbm_mb=16384)
+        assert len(devs) == 4
+        assert devs[0] == {"id": "accel0-vtpu0", "chip": "accel0",
+                           "hbm_mb": 8192, "fraction": 0.5}
+
+    def test_explicit_budget_wins(self):
+        devs = build_vtpu_devices(["accel0"],
+                                  VTPUProfile("vtpu-4", 4,
+                                              hbm_mb_per_vtpu=3000),
+                                  hbm_mb=16384)
+        assert {d["hbm_mb"] for d in devs} == {3000}
+
+    def test_manager_pending_until_fence_applied(self, isolation_env):
+        f = isolation_env / "config.yaml"
+        f.write_text(PROFILES_YAML)
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        mgr = VTPUDeviceManager(c, "tpu-0", str(f),
+                                default_profile="vtpu-2",
+                                vtpu_file=str(isolation_env
+                                              / "vtpu-config.json"))
+        assert mgr.apply_once() == "pending"
+        # fence lands -> inventory over the fenced chips (v5e: 16 GB HBM)
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "all")
+        assert mgr.apply_once() == "success"
+        inv = read_vtpu_file()
+        assert inv["profile"] == "vtpu-2"
+        assert len(inv["devices"]) == 4
+        assert inv["devices"][0]["hbm_mb"] == 8192
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][L.VTPU_CONFIG_STATE] == "success"
+
+    def test_empty_fence_withdraws_stale_inventory(self, isolation_env):
+        f = isolation_env / "config.yaml"
+        f.write_text(PROFILES_YAML)
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        vtpu_file = str(isolation_env / "vtpu-config.json")
+        mgr = VTPUDeviceManager(c, "tpu-0", str(f), vtpu_file=vtpu_file)
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        assert mgr.apply_once() == "success"
+        assert read_vtpu_file() is not None
+        # fence emptied (node reclaimed by the shared pool) -> the old
+        # inventory must vanish or vTPUs would double-allocate the chip
+        write_fencing_file(str(isolation_env / "fencing.json"), [], "none")
+        assert mgr.apply_once() == "pending"
+        assert read_vtpu_file() is None
+
+    def test_unknown_profile_fails(self, isolation_env):
+        f = isolation_env / "config.yaml"
+        f.write_text(PROFILES_YAML)
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.VTPU_CONFIG: "nope"})
+        mgr = VTPUDeviceManager(c, "tpu-0", str(f),
+                                vtpu_file=str(isolation_env / "v.json"))
+        assert mgr.apply_once() == "failed"
+
+
+class TestPluginPools:
+    def test_fenced_chips_leave_shared_pool(self, isolation_env):
+        from tpu_operator.deviceplugin.plugin import discover_devices
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "accel0,accel1")
+        ids = [d.ID for d in discover_devices()]
+        assert ids == ["accel2", "accel3"]
+
+    def test_isolated_pool_serves_fenced_whole_chips(self, isolation_env):
+        from tpu_operator.deviceplugin.plugin import discover_isolated_devices
+
+        assert discover_isolated_devices() == []  # nothing before the fence
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "accel0,accel1")
+        assert [d.ID for d in discover_isolated_devices()] == [
+            "accel0", "accel1"]
+
+    def test_isolated_pool_serves_vtpus_when_published(self, isolation_env):
+        from tpu_operator.deviceplugin.plugin import (
+            IsolatedTPUDevicePlugin,
+            discover_isolated_devices,
+        )
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        devs = build_vtpu_devices(["accel0"], VTPUProfile("vtpu-2", 2),
+                                  hbm_mb=16384)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "vtpus_per_chip": 2, "devices": devs}))
+        assert [d.ID for d in discover_isolated_devices()] == [
+            "accel0-vtpu0", "accel0-vtpu1"]
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        assert plugin.resource_name == "google.com/vtpu"
+
+    def test_isolated_allocate_env_contract(self, isolation_env):
+        from tpu_operator.deviceplugin import api_pb2 as pb
+        from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        devs = build_vtpu_devices(["accel0"], VTPUProfile("vtpu-2", 2),
+                                  hbm_mb=16384)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "vtpus_per_chip": 2, "devices": devs}))
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["accel0-vtpu0"])
+        resp = plugin.Allocate(req, None)
+        cresp = resp.container_responses[0]
+        assert cresp.devices[0].host_path == "/dev/accel0"
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0"
+        assert cresp.envs["TPU_WORKLOAD_ISOLATION"] == "isolated"
+        assert cresp.envs["TPU_HBM_LIMIT_MB"] == "8192"
+        assert cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
+
+    def test_whole_chip_allocate_has_no_memory_cap(self, isolation_env):
+        from tpu_operator.deviceplugin import api_pb2 as pb
+        from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "accel0,accel1")
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        assert plugin.resource_name == "google.com/tpu-isolated"
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["accel0", "accel1"])
+        resp = plugin.Allocate(req, None)
+        cresp = resp.container_responses[0]
+        assert len(cresp.devices) == 2
+        assert "TPU_HBM_LIMIT_MB" not in cresp.envs
+        assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in cresp.envs
+
+
+class TestValidatorComponents:
+    def test_fencing_fails_without_fence(self, isolation_env):
+        with pytest.raises(components.ValidationFailed, match="chip-fencing"):
+            components.validate_fencing()
+
+    def test_fencing_fails_on_empty_fence(self, isolation_env):
+        write_fencing_file(str(isolation_env / "fencing.json"), [], "none")
+        with pytest.raises(components.ValidationFailed, match="empty"):
+            components.validate_fencing()
+
+    def test_fencing_ready_written(self, isolation_env):
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "accel0,accel1")
+        info = components.validate_fencing()
+        assert info["FENCED_COUNT"] == "2"
+        assert barrier.is_ready("fencing-ready")
+
+    def test_vtpu_skipped_on_isolated_node(self, isolation_env, monkeypatch):
+        monkeypatch.setenv("TPU_WORKLOAD_CONFIG", "isolated")
+        info = components.validate_vtpu()
+        assert "SKIPPED" in info
+        assert barrier.is_ready("vtpu-ready")
+
+    def test_vtpu_requires_fenced_backing(self, isolation_env, monkeypatch):
+        monkeypatch.setenv("TPU_WORKLOAD_CONFIG", "virtual")
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        devs = build_vtpu_devices(["accel0", "accel1"],
+                                  VTPUProfile("vtpu-2", 2), hbm_mb=None)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "devices": devs}))
+        with pytest.raises(components.ValidationFailed, match="accel1"):
+            components.validate_vtpu()
+
+    def test_vtpu_ready_on_consistent_inventory(self, isolation_env,
+                                                monkeypatch):
+        monkeypatch.setenv("TPU_WORKLOAD_CONFIG", "virtual")
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        devs = build_vtpu_devices(["accel0"], VTPUProfile("vtpu-2", 2),
+                                  hbm_mb=16384)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "devices": devs}))
+        info = components.validate_vtpu()
+        assert info["VTPU_COUNT"] == "2"
+        assert barrier.is_ready("vtpu-ready")
+
+
+class TestRouting:
+    def test_virtual_config_routes_vtpu_states(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "virtual"})
+        want = desired_node_labels(c.get("v1", "Node", "tpu-0"))
+        assert want[L.deploy_label("chip-fencing")] == "true"
+        assert want[L.deploy_label("vtpu-device-manager")] == "true"
+        assert want[L.deploy_label("isolated-device-plugin")] == "true"
+        assert want.get(L.deploy_label("tpu-device-plugin")) in (None,)
+
+    def test_isolated_config_has_no_vtpu_manager(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"})
+        want = desired_node_labels(c.get("v1", "Node", "tpu-0"))
+        assert want[L.deploy_label("chip-fencing")] == "true"
+        assert want.get(L.deploy_label("vtpu-device-manager")) in (None,)
+
+    def test_sandbox_off_collapses_isolated_label(self):
+        # with the plane off, honoring the label would route the node to
+        # gated-off states and strand it without a device plugin
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"})
+        want = desired_node_labels(c.get("v1", "Node", "tpu-0"),
+                                   sandbox_enabled=False)
+        assert want[L.deploy_label("tpu-device-plugin")] == "true"
+        assert want.get(L.deploy_label("chip-fencing")) in (None,)
+
+    def test_mode_flip_triggers_reregistration(self, isolation_env):
+        from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        plugin.refresh_devices()
+        assert plugin.resource_name == "google.com/tpu-isolated"
+        assert not plugin._reregister.is_set()
+        devs = build_vtpu_devices(["accel0"], VTPUProfile("vtpu-2", 2),
+                                  hbm_mb=16384)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "devices": devs}))
+        plugin.refresh_devices()
+        assert plugin.resource_name == "google.com/vtpu"
+        assert plugin._reregister.is_set()
+
+    def test_vtpu_unknown_config_retries_not_skips(self, isolation_env,
+                                                   monkeypatch):
+        # no TPU_WORKLOAD_CONFIG, no NODE_NAME -> config undeterminable;
+        # must fail (retryable), never write vtpu-ready
+        monkeypatch.delenv("NODE_NAME", raising=False)
+        with pytest.raises(components.ValidationFailed,
+                           match="cannot determine"):
+            components.validate_vtpu()
+        assert not barrier.is_ready("vtpu-ready")
+
+    def test_default_workload_from_spec(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        node = c.get("v1", "Node", "tpu-0")
+        want = desired_node_labels(node, default_config="isolated")
+        assert want[L.deploy_label("chip-fencing")] == "true"
+        assert want.get(L.deploy_label("metrics-exporter")) in (None,)
+
+
+class TestReconcileWithSandbox:
+    def _policy(self, enabled=True, default="container"):
+        return new_cluster_policy(spec={
+            "sandboxWorkloads": {"enabled": enabled,
+                                 "defaultWorkload": default}})
+
+    def test_sandbox_off_keeps_isolated_states_disabled(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"},
+                   allocatable={"google.com/tpu": "4"})
+        c.create(self._policy(enabled=False))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-chip-fencing" not in ds
+        assert "tpu-isolated-device-plugin" not in ds
+
+    def test_sandbox_on_deploys_isolated_plane_and_converges(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "virtual"},
+                   allocatable={"google.com/tpu": "4"})
+        c.create(self._policy(enabled=True))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert {"tpu-chip-fencing", "tpu-vtpu-device-manager",
+                "tpu-isolated-validator",
+                "tpu-isolated-device-plugin"} <= ds
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "ready"
+
+    def test_default_workload_routes_unlabeled_nodes(self):
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS),
+                   allocatable={"google.com/tpu": "4"})
+        c.create(self._policy(enabled=True, default="isolated"))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        labels = node["metadata"]["labels"]
+        assert labels[L.deploy_label("chip-fencing")] == "true"
+        assert L.deploy_label("metrics-exporter") not in labels
+
+    def test_spec_roundtrip(self):
+        spec = TPUClusterPolicySpec.from_obj(self._policy())
+        assert spec.sandbox_workloads.is_enabled()
+        assert spec.chip_fencing.config == "all"
+        assert spec.vtpu_device_manager.default_profile == "vtpu-2"
+        assert spec.isolated_device_plugin.resource_name == \
+            "google.com/tpu-isolated"

@@ -161,6 +161,57 @@ class TopologyManagerSpec(ComponentSpec):
 
 
 @dataclass
+class SandboxWorkloadsSpec:
+    """Gate for the isolated/virtual workload plane (SandboxWorkloads
+    analog: the reference deploys its vm-passthrough/vm-vgpu operand set
+    only when sandboxWorkloads.enabled). ``defaultWorkload`` is the
+    workload config assumed for nodes that carry no
+    tpu.graft.dev/workload.config label."""
+
+    enabled: Optional[bool] = field(default=False)
+    default_workload: Optional[str] = field(
+        default="container", description="container|isolated|virtual")
+
+    def is_enabled(self, default: bool = False) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
+
+
+@dataclass
+class ChipFencingSpec(ComponentSpec):
+    """state-chip-fencing: take chips out of the shared pool (the
+    vfio-manager slot, object_controls.go:1870 — where the reference
+    rebinds GPUs to vfio-pci so the default driver stack can't claim
+    them, the TPU agent publishes a fence list the shared device plugin
+    honors and the isolated plugin serves)."""
+
+    config: Optional[str] = field(
+        default="all", description="Default fence set when the node has no "
+        "tpu.graft.dev/fencing.config label: all|none|comma chip list")
+
+
+@dataclass
+class VTPUDeviceManagerSpec(ComponentSpec):
+    """state-vtpu-device-manager: build fractional virtual-TPU devices
+    from a named profile (the vgpu-device-manager slot,
+    object_controls.go:1962; config label tpu.graft.dev/vtpu.config)."""
+
+    config_map: Optional[str] = field(
+        default="default-vtpu-config",
+        description="ConfigMap of named vTPU profiles")
+    default_profile: Optional[str] = field(default="vtpu-2")
+
+
+@dataclass
+class IsolatedDevicePluginSpec(ComponentSpec):
+    """state-isolated-device-plugin: advertise fenced chips
+    (google.com/tpu-isolated) or vTPU devices (google.com/vtpu) — the
+    sandbox-device-plugin slot (object_controls.go:1472)."""
+
+    resource_name: Optional[str] = field(default="google.com/tpu-isolated")
+    vtpu_resource_name: Optional[str] = field(default="google.com/vtpu")
+
+
+@dataclass
 class ValidatorSpec(ComponentSpec):
     """state-operator-validation: the readiness gate (validator/ slot)."""
 
@@ -220,6 +271,14 @@ class TPUClusterPolicySpec:
         default_factory=FeatureDiscoverySpec)
     topology_manager: Optional[TopologyManagerSpec] = field(
         default_factory=TopologyManagerSpec)
+    sandbox_workloads: Optional[SandboxWorkloadsSpec] = field(
+        default_factory=SandboxWorkloadsSpec)
+    chip_fencing: Optional[ChipFencingSpec] = field(
+        default_factory=ChipFencingSpec)
+    vtpu_device_manager: Optional[VTPUDeviceManagerSpec] = field(
+        name="vtpuDeviceManager", default_factory=VTPUDeviceManagerSpec)
+    isolated_device_plugin: Optional[IsolatedDevicePluginSpec] = field(
+        default_factory=IsolatedDevicePluginSpec)
     validator: Optional[ValidatorSpec] = field(default_factory=ValidatorSpec)
     upgrade_policy: Optional[DriverUpgradePolicySpec] = field(
         default_factory=DriverUpgradePolicySpec)
@@ -240,6 +299,11 @@ class TPUClusterPolicySpec:
                                 ("node_status_exporter", NodeStatusExporterSpec),
                                 ("feature_discovery", FeatureDiscoverySpec),
                                 ("topology_manager", TopologyManagerSpec),
+                                ("sandbox_workloads", SandboxWorkloadsSpec),
+                                ("chip_fencing", ChipFencingSpec),
+                                ("vtpu_device_manager", VTPUDeviceManagerSpec),
+                                ("isolated_device_plugin",
+                                 IsolatedDevicePluginSpec),
                                 ("validator", ValidatorSpec),
                                 ("upgrade_policy", DriverUpgradePolicySpec),
                                 ("host_paths", HostPathsSpec)):

@@ -15,9 +15,13 @@ GKE_NODEPOOL = "cloud.google.com/gke-nodepool"                # pool identity
 DOMAIN = "tpu.graft.dev"
 TPU_PRESENT = f"{DOMAIN}/present"                 # nvidia.com/gpu.present analog
 DEPLOY_PREFIX = f"{DOMAIN}/deploy."               # nvidia.com/gpu.deploy.<state> analog
-WORKLOAD_CONFIG = f"{DOMAIN}/workload.config"     # container | isolated
+WORKLOAD_CONFIG = f"{DOMAIN}/workload.config"     # container | isolated | virtual
 SLICE_CONFIG = f"{DOMAIN}/slice.config"           # nvidia.com/mig.config analog
 SLICE_CONFIG_STATE = f"{DOMAIN}/slice.config.state"  # pending|success|failed
+FENCING_CONFIG = f"{DOMAIN}/fencing.config"       # all | none | chip list
+FENCING_STATE = f"{DOMAIN}/fencing.state"         # success|failed
+VTPU_CONFIG = f"{DOMAIN}/vtpu.config"             # nvidia.com/vgpu.config analog
+VTPU_CONFIG_STATE = f"{DOMAIN}/vtpu.config.state"  # pending|success|failed
 TPU_GENERATION = f"{DOMAIN}/tpu.generation"       # v4 | v5e | v5p | v6e
 TPU_CHIP_COUNT = f"{DOMAIN}/tpu.chips"
 
@@ -42,13 +46,20 @@ STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
 
 # --- extended resources ---------------------------------------------------
 TPU_RESOURCE = "google.com/tpu"
+TPU_ISOLATED_RESOURCE = "google.com/tpu-isolated"  # whole fenced chips
+VTPU_RESOURCE = "google.com/vtpu"                  # fractional virtual TPUs
 
 # --- barrier protocol -----------------------------------------------------
 DEFAULT_VALIDATION_DIR = "/run/tpu/validations"
 
 # deploy-label sets per workload config (state_manager.go:86-111 analog).
-# TPU has no vGPU/passthrough split; "isolated" nodes get only driver+plugin
-# (for dedicated inference pools that run their own telemetry).
+# The reference routes container | vm-passthrough | vm-vgpu; the TPU
+# analogs are container | isolated (whole fenced chips, the passthrough
+# slot) | virtual (fractional vTPU devices over fenced chips, the vGPU
+# slot). Isolated/virtual nodes trade the shared plugin + telemetry
+# operands for the fencing plane, exactly as sandbox nodes trade the
+# container operand set for the sandbox one (updateGPUStateLabels,
+# state_manager.go:363-421).
 CONTAINER_WORKLOAD_STATES = (
     "libtpu-driver",
     "tpu-runtime",
@@ -62,13 +73,25 @@ CONTAINER_WORKLOAD_STATES = (
 )
 ISOLATED_WORKLOAD_STATES = (
     "libtpu-driver",
-    "operator-validation",
-    "tpu-device-plugin",
+    "chip-fencing",
+    "isolated-validation",
+    "isolated-device-plugin",
+)
+VIRTUAL_WORKLOAD_STATES = (
+    "libtpu-driver",
+    "chip-fencing",
+    "vtpu-device-manager",
+    "isolated-validation",
+    "isolated-device-plugin",
 )
 WORKLOAD_STATE_SETS = {
     "container": CONTAINER_WORKLOAD_STATES,
     "isolated": ISOLATED_WORKLOAD_STATES,
+    "virtual": VIRTUAL_WORKLOAD_STATES,
 }
+ALL_DEPLOY_STATES = tuple(dict.fromkeys(
+    CONTAINER_WORKLOAD_STATES + ISOLATED_WORKLOAD_STATES
+    + VIRTUAL_WORKLOAD_STATES))
 
 
 def deploy_label(state: str) -> str:

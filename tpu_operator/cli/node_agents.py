@@ -193,6 +193,21 @@ def device_plugin_main(argv=None) -> int:
     return 0
 
 
+def isolated_device_plugin_main(argv=None) -> int:
+    """The sandbox-device-plugin slot: serve the fenced/vTPU pool."""
+    logging.basicConfig(level=logging.INFO)
+    from ..deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+    plugin = IsolatedTPUDevicePlugin(
+        resource_name=os.environ.get("RESOURCE_NAME"),
+        vtpu_resource_name=os.environ.get("VTPU_RESOURCE_NAME"))
+    try:
+        plugin.serve_forever(register=True)
+    except KeyboardInterrupt:
+        plugin.stop()
+    return 0
+
+
 if __name__ == "__main__":  # pragma: no cover
     prog = os.path.basename(sys.argv[0])
     mains = {
@@ -200,5 +215,6 @@ if __name__ == "__main__":  # pragma: no cover
         "libtpu-install": libtpu_install_main,
         "tpu-runtime-setup": runtime_setup_main,
         "tpu-device-plugin": device_plugin_main,
+        "tpu-isolated-device-plugin": isolated_device_plugin_main,
     }
     sys.exit(mains.get(prog, libtpu_install_main)())

@@ -1,7 +1,7 @@
 """tpu-validator entrypoint (validator/main.go:226-596 analog).
 
 Usage:
-    tpu-validator -c driver|runtime|jax|ici|plugin|metrics|sleep
+    tpu-validator -c driver|runtime|jax|ici|hbm|dcn|plugin|fencing|vtpu|metrics|sleep
     tpu-validator wait <status-file>     # initContainer gate primitive
     tpu-validator cleanup                # preStop barrier teardown
 
@@ -25,7 +25,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd")
     p.add_argument("-c", "--component", default=None,
                    choices=["driver", "runtime", "jax", "ici", "hbm",
-                            "dcn", "plugin", "metrics", "sleep"])
+                            "dcn", "plugin", "fencing", "vtpu",
+                            "metrics", "sleep"])
     p.add_argument("--pod-mode", action="store_true",
                    help="jax/plugin: spawn a workload pod via the apiserver "
                         "instead of running in-process")
@@ -99,6 +100,10 @@ def main(argv=None) -> int:
 
                 client, node, ns, image = _client_and_identity()
                 info = validate_plugin(client, node, ns, image)
+            elif comp == "fencing":
+                info = components.validate_fencing()
+            elif comp == "vtpu":
+                info = components.validate_vtpu()
             elif comp == "metrics":
                 from ..validator.metrics import serve
 

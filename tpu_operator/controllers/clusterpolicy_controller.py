@@ -97,7 +97,14 @@ class ClusterPolicyReconciler(Reconciler):
 
         spec = TPUClusterPolicySpec.from_obj(cr)
 
-        tpu_nodes = self.state_manager.label_tpu_nodes()
+        # defaultWorkload only routes unlabeled nodes when the sandbox
+        # plane is on (reference: getWorkloadConfig falls back to
+        # defaultGPUWorkloadConfig only under sandboxWorkloads.enabled)
+        sandbox = spec.sandbox_workloads
+        default_workload = (sandbox.default_workload or "container") \
+            if sandbox.is_enabled() else "container"
+        tpu_nodes = self.state_manager.label_tpu_nodes(
+            default_workload, sandbox_enabled=sandbox.is_enabled())
         OPERATOR_METRICS.tpu_nodes.set(tpu_nodes)
         if tpu_nodes == 0:
             self._set_state(cr, STATE_NOT_READY)

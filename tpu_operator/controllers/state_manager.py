@@ -38,8 +38,19 @@ def is_tpu_node(node: dict) -> bool:
     return L.TPU_RESOURCE in cap
 
 
-def desired_node_labels(node: dict) -> Dict[str, Optional[str]]:
-    """Labels this operator wants on a TPU node; None means remove."""
+def desired_node_labels(node: dict,
+                        default_config: str = "container",
+                        sandbox_enabled: bool = True) -> Dict[str, Optional[str]]:
+    """Labels this operator wants on a TPU node; None means remove.
+
+    ``default_config`` is the workload config assumed when the node
+    carries no tpu.graft.dev/workload.config label — it comes from
+    sandboxWorkloads.defaultWorkload (getWorkloadConfig analog,
+    state_manager.go: defaultGPUWorkloadConfig). With the sandbox plane
+    off, isolated/virtual labels collapse to container routing (the
+    reference returns 'container' for every node when sandboxWorkloads
+    is disabled) — otherwise a labeled node would be routed to states
+    that are gated off, ending up with no device plugin at all."""
     nl = labels_of(node)
     out: Dict[str, Optional[str]] = {}
     if not is_tpu_node(node):
@@ -58,13 +69,17 @@ def desired_node_labels(node: dict) -> Dict[str, Optional[str]]:
         get_nested(node, "status", "allocatable", L.TPU_RESOURCE, default="") or "")
     if chips:
         out[L.TPU_CHIP_COUNT] = chips
-    config = nl.get(L.WORKLOAD_CONFIG, "container")
+    config = nl.get(L.WORKLOAD_CONFIG, default_config)
     if config not in L.WORKLOAD_STATE_SETS:
         log.warning("node %s: unknown workload config %r, using 'container'",
                     name_of(node), config)
         config = "container"
+    if config != "container" and not sandbox_enabled:
+        log.info("node %s: workload config %r but sandbox plane is "
+                 "disabled; routing as 'container'", name_of(node), config)
+        config = "container"
     wanted_states = set(L.WORKLOAD_STATE_SETS[config])
-    for state in set(L.CONTAINER_WORKLOAD_STATES) | set(L.ISOLATED_WORKLOAD_STATES):
+    for state in L.ALL_DEPLOY_STATES:
         key = L.deploy_label(state)
         if state in wanted_states:
             out[key] = "true"
@@ -79,13 +94,14 @@ class StateManager:
     namespace: str
     states: List[State] = field(default_factory=build_states)
 
-    def label_tpu_nodes(self) -> int:
+    def label_tpu_nodes(self, default_config: str = "container",
+                        sandbox_enabled: bool = True) -> int:
         """Stamp discovery + deploy labels on every node; returns the TPU
         node count (labelGPUNodes analog — one LIST + patches only for
         drifted nodes)."""
         count = 0
         for node in self.client.list("v1", "Node"):
-            want = desired_node_labels(node)
+            want = desired_node_labels(node, default_config, sandbox_enabled)
             if is_tpu_node(node):
                 count += 1
             have = labels_of(node)

@@ -75,9 +75,20 @@ def discover_devices() -> List[pb.Device]:
     device; with one (written by the topology manager,
     topology/manager.py), each sub-slice group is one device — allocating
     a unit grants all its chips, preserving ICI locality. With sharing
-    enabled every unit is advertised ``sharing_replicas()`` times."""
+    enabled every unit is advertised ``sharing_replicas()`` times.
+
+    Fenced chips (isolation/fencing.py) never appear here: they belong to
+    the isolated plugin's pool — the advertisement-level equivalent of a
+    GPU bound to vfio-pci being invisible to the default device plugin."""
+    from ..isolation.fencing import fenced_chips
+
+    fenced = set(fenced_chips())
     groups = slice_groups()
-    units = list(groups) if groups else discover_chips()
+    if groups:
+        units = [u for u, members in groups.items()
+                 if not fenced.intersection(members)]
+    else:
+        units = [c for c in discover_chips() if c not in fenced]
     n = sharing_replicas()
     if n > 1:
         return [pb.Device(ID=f"{u}{REPLICA_SEP}r{j}", health="Healthy")
@@ -115,6 +126,36 @@ def device_host_path(device_id: str) -> str:
     if device_id.startswith("accel"):
         return f"/dev/{device_id}"
     return f"/dev/vfio/{device_id}"
+
+
+# ---------------------------------------------------------------------------
+# isolated pool (sandbox-device-plugin slot)
+# ---------------------------------------------------------------------------
+
+
+def discover_isolated_devices() -> List[pb.Device]:
+    """The isolated plugin's inventory: vTPU devices when the vTPU
+    manager has published a config (the vGPU slot), else the fenced
+    chips whole (the passthrough slot). Empty until chip-fencing runs —
+    the isolated plugin has nothing to serve before the fence exists."""
+    from ..isolation.fencing import fenced_chips
+    from ..isolation.vtpu import read_vtpu_file
+
+    vtpu = read_vtpu_file()
+    if vtpu and vtpu.get("devices"):
+        return [pb.Device(ID=d["id"], health="Healthy")
+                for d in vtpu["devices"]]
+    return [pb.Device(ID=c, health="Healthy") for c in fenced_chips()]
+
+
+def vtpu_lookup() -> Dict[str, dict]:
+    """vTPU device ID -> its inventory entry (chip, hbm_mb, fraction)."""
+    from ..isolation.vtpu import read_vtpu_file
+
+    vtpu = read_vtpu_file()
+    if not vtpu:
+        return {}
+    return {d["id"]: d for d in vtpu.get("devices", [])}
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +201,7 @@ class TPUDevicePlugin:
         self._devices: List[pb.Device] = []
         self._cond = threading.Condition()
         self._stopped = threading.Event()
+        self._reregister = threading.Event()  # force a kubelet re-register
         self._server: Optional[grpc.Server] = None
         self.allocations: List[Dict] = []  # audit trail of Allocate calls
 
@@ -331,10 +373,89 @@ class TPUDevicePlugin:
                     ino = os.stat(kubelet_sock).st_ino
                 except OSError:
                     ino = None
-                if ino is not None and ino != registered_ino:
+                if ino is not None and (ino != registered_ino
+                                        or self._reregister.is_set()):
                     try:
                         self.register_with_kubelet()
                         registered_ino = ino
+                        self._reregister.clear()
                     except Exception as e:
                         log.warning("kubelet registration failed: %s", e)
             self._stopped.wait(5.0)
+
+
+class IsolatedTPUDevicePlugin(TPUDevicePlugin):
+    """Second plugin instance serving the fenced pool (the
+    sandbox-device-plugin slot, object_controls.go:1472): whole fenced
+    chips as google.com/tpu-isolated, or vTPU fractions as
+    google.com/vtpu when the vTPU manager has published a profile.
+
+    A vTPU allocation grants the backing chip's device node plus a
+    memory-budget env contract (XLA_PYTHON_CLIENT_MEM_FRACTION /
+    TPU_HBM_LIMIT_MB) that the XLA client allocator enforces — the
+    runtime-level stand-in for the mediated-device isolation vGPU gets
+    from the kernel."""
+
+    ISOLATED_RESOURCE = "google.com/tpu-isolated"
+    VTPU_RESOURCE = "google.com/vtpu"
+    ISOLATED_SOCKET = "tpu-isolated-device-plugin.sock"
+
+    def __init__(self, resource_name: Optional[str] = None,
+                 vtpu_resource_name: Optional[str] = None, **kw):
+        self._whole_resource = resource_name or self.ISOLATED_RESOURCE
+        self._vtpu_resource = vtpu_resource_name or self.VTPU_RESOURCE
+        kw.setdefault("plugin_socket", self.ISOLATED_SOCKET)
+        kw.setdefault("discover", discover_isolated_devices)
+        super().__init__(resource_name=self._pick_resource(), **kw)
+
+    def _pick_resource(self) -> str:
+        return self._vtpu_resource if vtpu_lookup() else self._whole_resource
+
+    def refresh_devices(self) -> None:
+        # the advertised resource follows the pool's mode: flipping a node
+        # between whole-chip and vTPU profiles must RE-REGISTER with
+        # kubelet (kubelet binds this endpoint to the resource name given
+        # at Register time — a new device list alone would be advertised
+        # under the old resource)
+        picked = self._pick_resource()
+        if picked != self.resource_name:
+            self.resource_name = picked
+            self._reregister.set()
+            log.info("isolated pool mode changed; re-registering as %s",
+                     picked)
+        super().refresh_devices()
+
+    def Allocate(self, request, context):
+        vtpus = vtpu_lookup()
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
+            entries = [vtpus.get(i) for i in ids]
+            chips: List[str] = []
+            hbm_mb = 0
+            fraction = 0.0
+            for device_id, entry in zip(ids, entries):
+                chip = entry["chip"] if entry else device_id
+                if chip not in chips:
+                    chips.append(chip)
+                if entry:
+                    hbm_mb += int(entry.get("hbm_mb") or 0)
+                    fraction += float(entry.get("fraction") or 0.0)
+            cresp = resp.container_responses.add()
+            for chip in chips:
+                host = device_host_path(chip)
+                cresp.devices.add(container_path=host, host_path=host,
+                                  permissions="rw")
+            cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(
+                c.removeprefix("accel") for c in chips)
+            cresp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(chips)}"
+            cresp.envs["TPU_WORKLOAD_ISOLATION"] = "isolated"
+            if any(entries):
+                if hbm_mb:
+                    cresp.envs["TPU_HBM_LIMIT_MB"] = str(hbm_mb)
+                if 0.0 < fraction < 1.0 * len(chips):
+                    cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] = (
+                        f"{min(fraction / len(chips), 1.0):.4f}")
+            self.allocations.append({"devices": ids, "chips": chips})
+            log.info("isolated allocation %s -> chips %s", ids, chips)
+        return resp

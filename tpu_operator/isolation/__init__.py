@@ -1,0 +1,25 @@
+"""Isolated-workload plane: chip fencing + virtual TPU devices.
+
+The TPU analog of the reference's sandbox stack (SURVEY.md section 2.2
+rows 13-17): vfio-manager -> chip fencing, vgpu-device-manager -> vTPU
+device manager, sandbox-device-plugin -> isolated device plugin
+(deviceplugin/plugin.py), sandbox-validation -> the fencing/vtpu
+validator components (validator/components.py).
+"""
+
+from .fencing import (  # noqa: F401
+    DEFAULT_FENCING_FILE,
+    FencingAgent,
+    read_fencing_file,
+    resolve_fence_set,
+    write_fencing_file,
+)
+from .vtpu import (  # noqa: F401
+    DEFAULT_VTPU_FILE,
+    VTPUDeviceManager,
+    VTPUProfile,
+    build_vtpu_devices,
+    load_vtpu_profiles,
+    read_vtpu_file,
+    write_vtpu_file,
+)

@@ -1,0 +1,153 @@
+"""Chip fencing — the vfio-manager slot.
+
+The reference's vfio-manager unbinds GPUs from the NVIDIA driver and
+binds them to vfio-pci so the default container stack can no longer
+claim them; passthrough workloads then receive the raw PCI device
+(TransformVFIOManager, object_controls.go:1870). TPU chips have no
+driver-rebind step — libtpu opens /dev/accel* directly — so the
+TPU-native fence is an *advertisement* boundary with the same effect:
+the agent publishes the fenced chip set to a hostPath file
+(/run/tpu/fencing.json); the shared device plugin excludes fenced chips
+from google.com/tpu, and the isolated device plugin serves exactly the
+fenced set as google.com/tpu-isolated (or carves it into vTPUs). A chip
+is therefore in one pool or the other, never both — the same invariant
+vfio-pci binding enforces on GPUs.
+
+Config comes from the node label ``tpu.graft.dev/fencing.config``
+(``all`` | ``none`` | an explicit comma-separated chip list), falling
+back to the ClusterPolicy's chipFencing.config default; the agent
+reports through ``tpu.graft.dev/fencing.state`` the way the MIG/vGPU
+managers report through their ``.state`` labels.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import time
+from typing import List, Optional
+
+from ..api import labels as L
+from ..runtime.client import Client
+from ..runtime.objects import labels_of
+
+log = logging.getLogger("tpu_chip_fencing")
+
+DEFAULT_FENCING_FILE = "/run/tpu/fencing.json"
+
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+
+def resolve_fence_set(config: str, chips: List[str]) -> List[str]:
+    """``all`` -> every chip, ``none`` -> [], else the named subset.
+
+    Naming a chip that does not exist is a hard error, not a silent
+    no-op: a fence list that doesn't match the hardware means the node
+    was relabeled for different hardware, and guessing would leak an
+    unfenced chip into the shared pool.
+    """
+    config = (config or "all").strip()
+    if config == "all":
+        return list(chips)
+    if config == "none":
+        return []
+    wanted = [c.strip() for c in config.split(",") if c.strip()]
+    unknown = [c for c in wanted if c not in chips]
+    if unknown:
+        raise ValueError(f"fencing config names unknown chips {unknown} "
+                         f"(have {chips})")
+    return wanted
+
+
+def write_fencing_file(path: str, fenced: List[str], config: str) -> None:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(json.dumps({"config": config, "fenced": fenced},
+                              indent=2))
+    tmp.rename(p)
+
+
+def read_fencing_file(path: Optional[str] = None) -> Optional[dict]:
+    """Single owner of the fence-file location: explicit path, else the
+    TPU_FENCING_FILE override, else the default — every consumer (agent,
+    device plugins, validator) resolves through here so they can never
+    drift onto different files."""
+    path = path or os.environ.get("TPU_FENCING_FILE", DEFAULT_FENCING_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def fenced_chips(path: Optional[str] = None) -> List[str]:
+    """The fence list other components consult (empty when no fence is
+    active)."""
+    cfg = read_fencing_file(path)
+    if not cfg:
+        return []
+    return list(cfg.get("fenced") or [])
+
+
+class FencingAgent:
+    """Per-node reconcile loop: label -> fence file -> state label."""
+
+    def __init__(self, client: Client, node_name: str,
+                 default_config: str = "all",
+                 fencing_file: str = DEFAULT_FENCING_FILE):
+        self.client = client
+        self.node_name = node_name
+        self.default_config = default_config
+        self.fencing_file = fencing_file
+
+    def _set_state(self, state: str) -> None:
+        self.client.patch("v1", "Node", self.node_name,
+                          {"metadata": {"labels": {L.FENCING_STATE: state}}})
+
+    def apply_once(self) -> str:
+        from ..deviceplugin.plugin import discover_chips
+
+        node = self.client.get("v1", "Node", self.node_name)
+        config = labels_of(node).get(L.FENCING_CONFIG, self.default_config)
+        chips = discover_chips()
+        try:
+            fenced = resolve_fence_set(config, chips)
+        except ValueError as e:
+            log.error("%s", e)
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+        write_fencing_file(self.fencing_file, fenced, config)
+        self._set_state(STATE_SUCCESS)
+        log.info("fenced %d/%d chip(s) (config=%r)", len(fenced),
+                 len(chips), config)
+        return STATE_SUCCESS
+
+    def run_forever(self, interval: float = 15.0) -> None:  # pragma: no cover
+        while True:
+            try:
+                self.apply_once()
+            except Exception:
+                log.exception("fencing reconcile failed")
+            time.sleep(interval)
+
+
+def main() -> int:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    agent = FencingAgent(
+        client=HTTPClient(KubeConfig.load()),
+        node_name=os.environ["NODE_NAME"],
+        default_config=os.environ.get("FENCING_CONFIG", "all"),
+        fencing_file=os.environ.get("TPU_FENCING_FILE",
+                                    DEFAULT_FENCING_FILE))
+    agent.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,207 @@
+"""Virtual TPU device manager — the vgpu-device-manager slot.
+
+The reference's vgpu-device-manager reads a named profile from a
+ConfigMap (selected per node by ``nvidia.com/vgpu.config``) and creates
+mediated vGPU devices on the host (TransformVGPUDeviceManager,
+object_controls.go:1962). TPUs have no mediated-device kernel layer;
+the honest equivalent is fractional *scheduling units with an enforced
+memory budget*: each fenced chip is carved into N vTPUs, each carrying
+an HBM budget that the isolated device plugin turns into the allocation
+env contract (XLA_PYTHON_CLIENT_MEM_FRACTION + TPU_HBM_LIMIT_MB), which
+the XLA client allocator enforces at runtime. The inventory is
+published to /run/tpu/vtpu-config.json for the isolated plugin, and the
+agent reports through ``tpu.graft.dev/vtpu.config.state``
+(pending|success|failed) like its vGPU counterpart.
+
+Profile ConfigMap shape (parallel to the vGPU profiles file)::
+
+    profiles:
+      vtpu-2:
+        vtpusPerChip: 2
+        description: half-chip inference units
+      vtpu-4:
+        vtpusPerChip: 4
+        hbmMbPerVtpu: 3584   # optional explicit budget
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import yaml
+
+from ..api import labels as L
+from ..runtime.client import Client
+from ..runtime.objects import labels_of
+from .fencing import fenced_chips
+
+log = logging.getLogger("tpu_vtpu_manager")
+
+DEFAULT_VTPU_FILE = "/run/tpu/vtpu-config.json"
+
+STATE_PENDING = "pending"
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+
+@dataclass
+class VTPUProfile:
+    name: str
+    vtpus_per_chip: int
+    hbm_mb_per_vtpu: Optional[int] = None
+    description: str = ""
+
+
+def load_vtpu_profiles(config_file: str) -> Dict[str, VTPUProfile]:
+    with open(config_file) as f:
+        raw = yaml.safe_load(f) or {}
+    out = {}
+    for name, body in (raw.get("profiles") or {}).items():
+        out[name] = VTPUProfile(
+            name=name,
+            vtpus_per_chip=int(body.get("vtpusPerChip", 1)),
+            hbm_mb_per_vtpu=(int(body["hbmMbPerVtpu"])
+                             if body.get("hbmMbPerVtpu") else None),
+            description=body.get("description", ""))
+    if not out:
+        raise ValueError(f"no profiles in {config_file}")
+    return out
+
+
+def chip_hbm_mb(node_labels: Dict[str, str]) -> Optional[int]:
+    """HBM per chip: explicit env override, the feature-discovery label,
+    or the hardware table keyed by the GKE accelerator label."""
+    env = os.environ.get("TPU_CHIP_HBM_MB")
+    if env:
+        return int(env)
+    label = node_labels.get(L.TPU_MEMORY_GB)
+    if label:
+        return int(float(label) * 1024)
+    accel = node_labels.get(L.GKE_TPU_ACCELERATOR, "")
+    if accel:
+        from ..workloads.hardware import CHIPS
+
+        gen = L.accelerator_generation(accel)
+        spec = CHIPS.get(gen)
+        if spec:
+            return spec.hbm_gb * 1024
+    return None
+
+
+def build_vtpu_devices(chips: List[str], profile: VTPUProfile,
+                       hbm_mb: Optional[int]) -> List[dict]:
+    """The vTPU inventory: one entry per (chip, slot). HBM budget is the
+    profile's explicit figure, else an even split of the chip's HBM; when
+    neither is known the budget is 0 and the plugin omits the limit env
+    (fail-open on memory, fail-closed on chip assignment)."""
+    n = max(1, profile.vtpus_per_chip)
+    per = profile.hbm_mb_per_vtpu or (hbm_mb // n if hbm_mb else 0)
+    return [{"id": f"{chip}-vtpu{j}", "chip": chip, "hbm_mb": per,
+             "fraction": round(1.0 / n, 4)}
+            for chip in chips for j in range(n)]
+
+
+def write_vtpu_file(path: str, profile: VTPUProfile,
+                    devices: List[dict]) -> None:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(json.dumps({
+        "profile": profile.name,
+        "vtpus_per_chip": profile.vtpus_per_chip,
+        "devices": devices,
+    }, indent=2))
+    tmp.rename(p)
+
+
+def read_vtpu_file(path: Optional[str] = None) -> Optional[dict]:
+    try:
+        with open(path or os.environ.get("TPU_VTPU_FILE",
+                                         DEFAULT_VTPU_FILE)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class VTPUDeviceManager:
+    """Per-node reconcile: vtpu.config label -> profile -> inventory."""
+
+    def __init__(self, client: Client, node_name: str, config_file: str,
+                 default_profile: str = "vtpu-2",
+                 vtpu_file: str = DEFAULT_VTPU_FILE):
+        self.client = client
+        self.node_name = node_name
+        self.profiles = load_vtpu_profiles(config_file)
+        self.default_profile = default_profile
+        self.vtpu_file = vtpu_file
+
+    def _set_state(self, state: str) -> None:
+        self.client.patch("v1", "Node", self.node_name,
+                          {"metadata": {"labels":
+                                        {L.VTPU_CONFIG_STATE: state}}})
+
+    def apply_once(self) -> str:
+        node = self.client.get("v1", "Node", self.node_name)
+        nl = labels_of(node)
+        wanted = nl.get(L.VTPU_CONFIG, self.default_profile)
+        profile = self.profiles.get(wanted)
+        if profile is None:
+            log.error("unknown vTPU profile %r (have %s)", wanted,
+                      sorted(self.profiles))
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+        chips = fenced_chips()
+        if not chips:
+            # fence not applied (yet, or anymore) — vTPUs are carved from
+            # fenced chips only, so wait for chip-fencing (grouped-ordering
+            # analog of vgpu-device-manager waiting on the vgpu host
+            # driver). A previously published inventory must be withdrawn
+            # too: leaving it behind would let the isolated plugin keep
+            # advertising vTPUs over chips the shared pool just reclaimed
+            # (double allocation).
+            try:
+                pathlib.Path(self.vtpu_file).unlink()
+                log.info("fence empty; withdrew stale vTPU inventory")
+            except FileNotFoundError:
+                pass
+            log.info("no fenced chips; vtpu config pending")
+            self._set_state(STATE_PENDING)
+            return STATE_PENDING
+        devices = build_vtpu_devices(chips, profile, chip_hbm_mb(nl))
+        write_vtpu_file(self.vtpu_file, profile, devices)
+        self._set_state(STATE_SUCCESS)
+        log.info("applied vTPU profile %r: %d device(s) over %d chip(s)",
+                 profile.name, len(devices), len(chips))
+        return STATE_SUCCESS
+
+    def run_forever(self, interval: float = 15.0) -> None:  # pragma: no cover
+        while True:
+            try:
+                self.apply_once()
+            except Exception:
+                log.exception("vtpu reconcile failed")
+            time.sleep(interval)
+
+
+def main() -> int:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    mgr = VTPUDeviceManager(
+        client=HTTPClient(KubeConfig.load()),
+        node_name=os.environ["NODE_NAME"],
+        config_file=os.environ.get("CONFIG_FILE", "/config/config.yaml"),
+        default_profile=os.environ.get("DEFAULT_PROFILE", "vtpu-2"),
+        vtpu_file=os.environ.get("TPU_VTPU_FILE", DEFAULT_VTPU_FILE))
+    mgr.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

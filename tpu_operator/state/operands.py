@@ -16,14 +16,26 @@ registration order, SURVEY.md section 2.2) onto the TPU stack:
 | 9     | feature-discovery      | gpu-feature-discovery             |
 | 10    | node-status-exporter   | state-node-status-exporter        |
 | 11    | topology-manager       | state-mig-manager                 |
+| 12    | chip-fencing           | state-vfio-manager                |
+| 13    | vtpu-device-manager    | state-vgpu-device-manager         |
+| 14    | isolated-validation    | state-sandbox-validation          |
+| 15    | isolated-device-plugin | state-sandbox-device-plugin       |
 
 The MPS-control-daemon slot (#7 in the reference's order) is covered by
 the device plugin's time-shared replication (deviceplugin/plugin.py
 ``sharing_replicas``) rather than a separate daemon — TPU sharing is an
 advertisement policy, not a control process.
 
-Sandbox/vGPU/kata/CC states have no TPU analog (SURVEY.md section 7:
-documented out of scope).
+States 12-15 form the isolated-workload plane (tpu_operator/isolation/):
+the TPU analog of the reference's sandbox stack, deployed only when
+``sandboxWorkloads.enabled`` and routed to nodes whose workload config
+is ``isolated`` (whole fenced chips — the vm-passthrough slot) or
+``virtual`` (fractional vTPUs — the vm-vgpu slot). The vgpu-manager
+state (reference #13) has no TPU slot of its own: there is no separate
+host driver for virtualized TPUs — libtpu-driver covers isolated nodes
+too (it is in both routed state sets). kata-manager and cc-manager
+remain out of scope (no VM runtime or confidential-computing mode to
+manage on TPU nodes; SURVEY.md section 7).
 
 Each state renders ``manifests/state-<name>/*.yaml`` with data built here,
 applies via the skel, and reports readiness. Per-node deploy labels
@@ -234,8 +246,47 @@ def _topology_manager_data(ctx: SyncContext) -> dict:
     return data
 
 
+def _sandbox_enabled(ctx: SyncContext) -> bool:
+    return ctx.spec.sandbox_workloads.is_enabled()
+
+
+def _chip_fencing_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.chip_fencing
+    data = common_data(ctx, spec, "chip-fencing", "tpu-chip-fencing")
+    data["FencingConfig"] = spec.config or "all"
+    return data
+
+
+def _vtpu_device_manager_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.vtpu_device_manager
+    data = common_data(ctx, spec, "vtpu-device-manager",
+                       "tpu-vtpu-device-manager")
+    data["ConfigMapName"] = spec.config_map or "default-vtpu-config"
+    data["DefaultProfile"] = spec.default_profile or "vtpu-2"
+    return data
+
+
+def _isolated_validation_data(ctx: SyncContext) -> dict:
+    data = common_data(ctx, ctx.spec.validator, "isolated-validation",
+                       "tpu-validator")
+    # vtpu proof only gates nodes that actually carve vTPUs (the virtual
+    # workload config); the manifest keys the initContainer off this flag
+    data["VTPUEnabled"] = ctx.spec.vtpu_device_manager.is_enabled()
+    return data
+
+
+def _isolated_device_plugin_data(ctx: SyncContext) -> dict:
+    spec = ctx.spec.isolated_device_plugin
+    data = common_data(ctx, spec, "isolated-device-plugin",
+                       "tpu-device-plugin")
+    data["ResourceName"] = spec.resource_name or "google.com/tpu-isolated"
+    data["VTPUResourceName"] = spec.vtpu_resource_name or "google.com/vtpu"
+    return data
+
+
 def build_states(manifests_root: Optional[pathlib.Path] = None) -> List[State]:
-    """Ordered state list (addState x9; state_manager.go:791-810 analog)."""
+    """Ordered state list (addState registrations,
+    state_manager.go:791-810 analog)."""
     mk = lambda *a, **kw: OperandState(*a, manifests_root=manifests_root, **kw)
     return [
         mk("pre-requisites", "RuntimeClass registration",
@@ -270,4 +321,23 @@ def build_states(manifests_root: Optional[pathlib.Path] = None) -> List[State]:
         mk("topology-manager", "TPU slice shaping",
            _topology_manager_data,
            enabled_fn=lambda ctx: ctx.spec.topology_manager.is_enabled()),
+        # --- isolated-workload plane (sandbox stack analog): deployed only
+        # when sandboxWorkloads.enabled, routed to isolated/virtual nodes
+        # by the workload-config deploy labels -------------------------------
+        mk("chip-fencing", "fence chips out of the shared pool",
+           _chip_fencing_data,
+           enabled_fn=lambda ctx: _sandbox_enabled(ctx)
+           and ctx.spec.chip_fencing.is_enabled()),
+        mk("vtpu-device-manager", "fractional vTPU device inventory",
+           _vtpu_device_manager_data,
+           enabled_fn=lambda ctx: _sandbox_enabled(ctx)
+           and ctx.spec.vtpu_device_manager.is_enabled()),
+        mk("isolated-validation", "fencing/vTPU validation gate",
+           _isolated_validation_data,
+           enabled_fn=lambda ctx: _sandbox_enabled(ctx)
+           and ctx.spec.validator.is_enabled()),
+        mk("isolated-device-plugin", "fenced/vTPU pool device plugin",
+           _isolated_device_plugin_data,
+           enabled_fn=lambda ctx: _sandbox_enabled(ctx)
+           and ctx.spec.isolated_device_plugin.is_enabled()),
     ]

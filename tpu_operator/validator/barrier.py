@@ -31,6 +31,8 @@ KNOWN_STATUS_FILES = (
     "hbm-ready",
     "dcn-ready",
     "topology-ready",
+    "fencing-ready",
+    "vtpu-ready",
     ".driver-ctr-ready",
 )
 

@@ -23,6 +23,12 @@ status file. Component -> proof:
 - ``plugin``   google.com/tpu extended resource allocatable on this node,
                then a pod *requesting* one TPU schedules and runs
                (main.go:1086-1253 analog)
+- ``fencing``  isolated/virtual nodes: the fence exists, is non-empty,
+               and names real chips (sandbox-validation vfio proof slot,
+               main.go:1431-1692)
+- ``vtpu``     virtual nodes: the vTPU inventory resolves and backs onto
+               fenced chips only (vgpu-devices proof slot); skipped on
+               whole-chip isolated nodes
 - ``metrics``  node-status exporter loop (validator/metrics.go analog)
 - ``sleep``    main-container park; ``cleanup`` preStop barrier teardown
 """
@@ -292,6 +298,102 @@ def validate_dcn(timeout: Optional[float] = None) -> Dict[str, str]:
     raise ValidationFailed(
         f"megascale coordinator {coordinator} unreachable over DCN: "
         f"{last_err}")
+
+
+def validate_fencing() -> Dict[str, str]:
+    """Isolated/virtual nodes (sandbox-validation slot,
+    validator/main.go:1431-1692 vfio-pci proof analog): the fence file
+    exists, every fenced chip is a real chip on this host, and at least
+    one chip is fenced — an isolated node with an empty fence serves
+    nothing and must not pass its gate."""
+    from ..isolation.fencing import fenced_chips, read_fencing_file
+
+    cfg = read_fencing_file()
+    if cfg is None:
+        raise ValidationFailed(
+            "no fencing config published (is chip-fencing running?)")
+    fenced = fenced_chips()
+    if not fenced:
+        raise ValidationFailed(
+            f"fence is empty (config={cfg.get('config')!r}) — an isolated "
+            "node must fence at least one chip")
+    chips = discover_chips()
+    known = {os.path.basename(d) for d in chips.get("devices", [])}
+    unknown = [c for c in fenced if known and c not in known]
+    if unknown:
+        raise ValidationFailed(
+            f"fenced chips {unknown} are not present on this host "
+            f"(have {sorted(known)})")
+    info = {"FENCED_COUNT": str(len(fenced)),
+            "FENCED": ",".join(fenced),
+            "CONFIG": str(cfg.get("config", ""))}
+    barrier.write_status("fencing-ready", info)
+    return info
+
+
+def _node_workload_config() -> str:
+    """This node's workload config: TPU_WORKLOAD_CONFIG env (tests),
+    else the node label via the apiserver (best effort — the validator
+    DS has nodes/get RBAC for exactly this, the same introspection the
+    reference's sandbox validator uses to pick vfio vs vgpu proofs)."""
+    env = os.environ.get("TPU_WORKLOAD_CONFIG", "")
+    if env:
+        return env
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        return ""
+    try:
+        from ..api import labels as L
+        from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+        node = HTTPClient(KubeConfig.load()).get("v1", "Node", node_name)
+        return ((node.get("metadata") or {}).get("labels") or {}).get(
+            L.WORKLOAD_CONFIG, "")
+    except Exception:
+        return ""
+
+
+def validate_vtpu() -> Dict[str, str]:
+    """Virtual nodes (the vGPU-devices proof slot): the vTPU manager has
+    published a resolvable inventory whose backing chips are all fenced.
+    On an ``isolated`` (whole-chip) node there is no inventory to prove —
+    skipped, like the reference's MOFED check on nodes without the
+    Mellanox PCI label."""
+    from ..isolation.fencing import fenced_chips
+    from ..isolation.vtpu import read_vtpu_file
+
+    # inventory first: if one exists, validate it regardless of what the
+    # label lookup says — a published inventory is the ground truth
+    vtpu = read_vtpu_file()
+    if not vtpu or not vtpu.get("devices"):
+        config = _node_workload_config()
+        if config == "isolated":
+            info = {"SKIPPED": "whole-chip isolated node, no vTPU inventory",
+                    "WORKLOAD_CONFIG": config}
+            barrier.write_status("vtpu-ready", info)
+            return info
+        if not config:
+            # can't tell isolated from virtual: retry (WITH_WAIT), don't
+            # demand an inventory that may by design never exist here
+            raise ValidationFailed(
+                "cannot determine this node's workload config (apiserver "
+                "unreachable or NODE_NAME unset) and no vTPU inventory is "
+                "published; retrying")
+        raise ValidationFailed(
+            "no vTPU inventory published (is vtpu-device-manager running "
+            "and the fence applied?)")
+    fenced = set(fenced_chips())
+    backing = {d.get("chip") for d in vtpu["devices"]}
+    stray = sorted(c for c in backing if c not in fenced)
+    if stray:
+        raise ValidationFailed(
+            f"vTPU devices back onto unfenced chips {stray} — the shared "
+            "pool would double-allocate them")
+    info = {"PROFILE": str(vtpu.get("profile", "")),
+            "VTPU_COUNT": str(len(vtpu["devices"])),
+            "CHIP_COUNT": str(len(backing))}
+    barrier.write_status("vtpu-ready", info)
+    return info
 
 
 def component_sleep() -> None:  # pragma: no cover - blocks forever
