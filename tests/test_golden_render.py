@@ -50,6 +50,12 @@ PERMUTATIONS = {
         "devicePlugin": {"sharingPolicy": "time-shared",
                          "sharingReplicas": 4},
     },
+    "sandbox-plane-on": {
+        "sandboxWorkloads": {"enabled": True, "defaultWorkload": "virtual"},
+        "chipFencing": {"config": "all"},
+        "vtpuDeviceManager": {"defaultProfile": "vtpu-4"},
+        "isolatedDevicePlugin": {"resourceName": "example.com/tpu-dedicated"},
+    },
 }
 
 

@@ -100,6 +100,40 @@ class TestFencingAgent:
         assert agent.apply_once() == "success"
         assert read_fencing_file(path)["fenced"] == ["accel0", "accel1"]
 
+    def test_cleanup_withdraws_fence_and_vtpu(self, isolation_env):
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        path = str(isolation_env / "fencing.json")
+        agent = FencingAgent(c, "tpu-0", fencing_file=path)
+        agent.apply_once()
+        (isolation_env / "vtpu-config.json").write_text("{}")
+        agent.cleanup()
+        assert read_fencing_file(path) is None
+        assert not (isolation_env / "vtpu-config.json").exists()
+
+    def test_isolated_node_withdraws_stale_vtpu(self, isolation_env):
+        # virtual -> isolated flip: the vtpu manager is gone; the fencing
+        # agent (still scheduled) must withdraw the stale inventory
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"})
+        (isolation_env / "vtpu-config.json").write_text(
+            '{"profile": "vtpu-2", "devices": [{"id": "x", "chip": "y"}]}')
+        agent = FencingAgent(c, "tpu-0",
+                             fencing_file=str(isolation_env / "fencing.json"))
+        assert agent.apply_once() == "success"
+        assert not (isolation_env / "vtpu-config.json").exists()
+
+    def test_virtual_node_keeps_vtpu_file(self, isolation_env):
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "virtual"})
+        (isolation_env / "vtpu-config.json").write_text("{}")
+        agent = FencingAgent(c, "tpu-0",
+                             fencing_file=str(isolation_env / "fencing.json"))
+        agent.apply_once()
+        assert (isolation_env / "vtpu-config.json").exists()
+
     def test_bad_config_marks_failed(self, isolation_env):
         c = FakeClient()
         c.add_node("tpu-0", labels={**V5E_LABELS,
@@ -238,6 +272,39 @@ class TestPluginPools:
         assert cresp.envs["TPU_HBM_LIMIT_MB"] == "8192"
         assert cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
 
+    def test_allocate_fraction_is_min_per_chip(self, isolation_env):
+        # one half-share on accel0, both halves of accel1: the per-device
+        # XLA fraction must be the SMALLEST per-chip share (0.5), not the
+        # cross-chip average (0.75) which would over-grant accel0
+        from tpu_operator.deviceplugin import api_pb2 as pb
+        from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0", "accel1"], "accel0,accel1")
+        devs = build_vtpu_devices(["accel0", "accel1"],
+                                  VTPUProfile("vtpu-2", 2), hbm_mb=16384)
+        (isolation_env / "vtpu-config.json").write_text(json.dumps(
+            {"profile": "vtpu-2", "devices": devs}))
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[
+            "accel0-vtpu0", "accel1-vtpu0", "accel1-vtpu1"])
+        cresp = plugin.Allocate(req, None).container_responses[0]
+        assert cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
+        assert cresp.envs["TPU_HBM_LIMIT_MB"] == str(8192 * 3)
+
+    def test_allocate_rejects_withdrawn_vtpu_id(self, isolation_env):
+        from tpu_operator.deviceplugin import api_pb2 as pb
+        from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        plugin = IsolatedTPUDevicePlugin(socket_dir=str(isolation_env))
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["accel0-vtpu0"])  # withdrawn
+        with pytest.raises(ValueError, match="unknown isolated device"):
+            plugin.Allocate(req, None)
+
     def test_whole_chip_allocate_has_no_memory_cap(self, isolation_env):
         from tpu_operator.deviceplugin import api_pb2 as pb
         from tpu_operator.deviceplugin.plugin import IsolatedTPUDevicePlugin
@@ -277,6 +344,17 @@ class TestValidatorComponents:
         info = components.validate_vtpu()
         assert "SKIPPED" in info
         assert barrier.is_ready("vtpu-ready")
+
+    def test_vtpu_stale_inventory_not_blessed_on_isolated(self,
+                                                          isolation_env,
+                                                          monkeypatch):
+        # a leftover inventory from a virtual->isolated flip must not be
+        # validated as ground truth on a whole-chip node
+        monkeypatch.setenv("TPU_WORKLOAD_CONFIG", "isolated")
+        (isolation_env / "vtpu-config.json").write_text(
+            '{"profile": "vtpu-2", "devices": [{"id": "x", "chip": "y"}]}')
+        info = components.validate_vtpu()
+        assert "SKIPPED" in info
 
     def test_vtpu_requires_fenced_backing(self, isolation_env, monkeypatch):
         monkeypatch.setenv("TPU_WORKLOAD_CONFIG", "virtual")
@@ -403,6 +481,30 @@ class TestReconcileWithSandbox:
         rec.reconcile(Request(name="tpu-cluster-policy"))
         got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
         assert got["status"]["state"] == "ready"
+
+    def test_disabling_plane_cleans_up_and_restores_routing(self):
+        # enable -> converge -> disable: isolated DSs must be deleted and
+        # the node re-routed to the container set (the disable/enable
+        # operand lifecycle the reference's e2e exercises)
+        c = FakeClient()
+        c.add_node("tpu-0", labels={**V5E_LABELS,
+                                    L.WORKLOAD_CONFIG: "isolated"},
+                   allocatable={"google.com/tpu": "4"})
+        cr = c.create(self._policy(enabled=True))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-chip-fencing" in ds
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["sandboxWorkloads"]["enabled"] = False
+        c.update(cr)
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ds = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
+        assert "tpu-chip-fencing" not in ds
+        assert "tpu-isolated-device-plugin" not in ds
+        labels = c.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[L.deploy_label("tpu-device-plugin")] == "true"
 
     def test_default_workload_routes_unlabeled_nodes(self):
         c = FakeClient()

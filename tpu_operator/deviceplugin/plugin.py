@@ -426,21 +426,39 @@ class IsolatedTPUDevicePlugin(TPUDevicePlugin):
         super().refresh_devices()
 
     def Allocate(self, request, context):
+        from ..isolation.fencing import fenced_chips
+
         vtpus = vtpu_lookup()
+        fenced = set(fenced_chips())
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
             ids = list(creq.devicesIDs)
-            entries = [vtpus.get(i) for i in ids]
             chips: List[str] = []
-            hbm_mb = 0
-            fraction = 0.0
-            for device_id, entry in zip(ids, entries):
+            per_chip_hbm: Dict[str, int] = {}
+            per_chip_fraction: Dict[str, float] = {}
+            any_vtpu = False
+            for device_id in ids:
+                entry = vtpus.get(device_id)
+                if entry is None and device_id not in fenced:
+                    # a withdrawn vTPU id (or never-fenced chip) must fail
+                    # the RPC cleanly, not fabricate a /dev path that
+                    # doesn't exist and strand the container at mount time
+                    msg = (f"unknown isolated device {device_id!r}: not in "
+                           f"the vTPU inventory and not a fenced chip "
+                           f"(inventory withdrawn?)")
+                    log.error("%s", msg)
+                    if context is not None:
+                        context.abort(grpc.StatusCode.NOT_FOUND, msg)
+                    raise ValueError(msg)
                 chip = entry["chip"] if entry else device_id
                 if chip not in chips:
                     chips.append(chip)
                 if entry:
-                    hbm_mb += int(entry.get("hbm_mb") or 0)
-                    fraction += float(entry.get("fraction") or 0.0)
+                    any_vtpu = True
+                    per_chip_hbm[chip] = per_chip_hbm.get(chip, 0) + int(
+                        entry.get("hbm_mb") or 0)
+                    per_chip_fraction[chip] = per_chip_fraction.get(
+                        chip, 0.0) + float(entry.get("fraction") or 0.0)
             cresp = resp.container_responses.add()
             for chip in chips:
                 host = device_host_path(chip)
@@ -450,12 +468,17 @@ class IsolatedTPUDevicePlugin(TPUDevicePlugin):
                 c.removeprefix("accel") for c in chips)
             cresp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(chips)}"
             cresp.envs["TPU_WORKLOAD_ISOLATION"] = "isolated"
-            if any(entries):
-                if hbm_mb:
-                    cresp.envs["TPU_HBM_LIMIT_MB"] = str(hbm_mb)
-                if 0.0 < fraction < 1.0 * len(chips):
+            if any_vtpu:
+                # XLA's fraction applies PER DEVICE, so the safe value is
+                # the smallest per-chip share in the request — averaging
+                # would over-grant on chips where this pod owns less
+                hbm_total = sum(per_chip_hbm.values())
+                if hbm_total:
+                    cresp.envs["TPU_HBM_LIMIT_MB"] = str(hbm_total)
+                fractions = [f for f in per_chip_fraction.values() if f > 0]
+                if fractions and min(fractions) < 1.0:
                     cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] = (
-                        f"{min(fraction / len(chips), 1.0):.4f}")
+                        f"{min(min(fractions), 1.0):.4f}")
             self.allocations.append({"devices": ids, "chips": chips})
             log.info("isolated allocation %s -> chips %s", ids, chips)
         return resp

@@ -112,7 +112,8 @@ class FencingAgent:
         from ..deviceplugin.plugin import discover_chips
 
         node = self.client.get("v1", "Node", self.node_name)
-        config = labels_of(node).get(L.FENCING_CONFIG, self.default_config)
+        nl = labels_of(node)
+        config = nl.get(L.FENCING_CONFIG, self.default_config)
         chips = discover_chips()
         try:
             fenced = resolve_fence_set(config, chips)
@@ -121,10 +122,38 @@ class FencingAgent:
             self._set_state(STATE_FAILED)
             return STATE_FAILED
         write_fencing_file(self.fencing_file, fenced, config)
+        # a node flipped virtual->isolated keeps its old vTPU inventory
+        # on disk, but the vtpu manager is no longer scheduled here to
+        # withdraw it — this agent still is, so it owns that convergence
+        if nl.get(L.WORKLOAD_CONFIG) != "virtual":
+            self._withdraw_vtpu_file()
         self._set_state(STATE_SUCCESS)
         log.info("fenced %d/%d chip(s) (config=%r)", len(fenced),
                  len(chips), config)
         return STATE_SUCCESS
+
+    def _withdraw_vtpu_file(self) -> None:
+        from .vtpu import DEFAULT_VTPU_FILE
+
+        path = os.environ.get("TPU_VTPU_FILE", DEFAULT_VTPU_FILE)
+        try:
+            pathlib.Path(path).unlink()
+            log.info("node is not in virtual mode; withdrew stale vTPU "
+                     "inventory %s", path)
+        except FileNotFoundError:
+            pass
+
+    def cleanup(self) -> None:
+        """preStop teardown: when this DaemonSet leaves the node (plane
+        disabled or node re-routed to container mode), the fence must go
+        with it — a stale fence would permanently exclude every chip from
+        the shared pool. The vTPU inventory falls with the fence."""
+        try:
+            pathlib.Path(self.fencing_file).unlink()
+        except FileNotFoundError:
+            pass
+        self._withdraw_vtpu_file()
+        log.info("fence withdrawn (preStop)")
 
     def run_forever(self, interval: float = 15.0) -> None:  # pragma: no cover
         while True:
@@ -136,7 +165,13 @@ class FencingAgent:
 
 
 def main() -> int:  # pragma: no cover - container entrypoint
+    import argparse
+
     logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="tpu-chip-fencing")
+    p.add_argument("action", nargs="?", default="run",
+                   choices=["run", "cleanup"])
+    args = p.parse_args()
     from ..runtime.kubeclient import HTTPClient, KubeConfig
 
     agent = FencingAgent(
@@ -145,6 +180,9 @@ def main() -> int:  # pragma: no cover - container entrypoint
         default_config=os.environ.get("FENCING_CONFIG", "all"),
         fencing_file=os.environ.get("TPU_FENCING_FILE",
                                     DEFAULT_FENCING_FILE))
+    if args.action == "cleanup":
+        agent.cleanup()
+        return 0
     agent.run_forever()
     return 0
 

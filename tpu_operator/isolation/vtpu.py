@@ -190,7 +190,22 @@ class VTPUDeviceManager:
 
 
 def main() -> int:  # pragma: no cover - container entrypoint
+    import argparse
+
     logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="tpu-vtpu-device-manager")
+    p.add_argument("action", nargs="?", default="run",
+                   choices=["run", "cleanup"])
+    args = p.parse_args()
+    vtpu_file = os.environ.get("TPU_VTPU_FILE", DEFAULT_VTPU_FILE)
+    if args.action == "cleanup":
+        # preStop: the inventory leaves the node with this pod
+        try:
+            pathlib.Path(vtpu_file).unlink()
+            log.info("vTPU inventory withdrawn (preStop)")
+        except FileNotFoundError:
+            pass
+        return 0
     from ..runtime.kubeclient import HTTPClient, KubeConfig
 
     mgr = VTPUDeviceManager(
@@ -198,7 +213,7 @@ def main() -> int:  # pragma: no cover - container entrypoint
         node_name=os.environ["NODE_NAME"],
         config_file=os.environ.get("CONFIG_FILE", "/config/config.yaml"),
         default_profile=os.environ.get("DEFAULT_PROFILE", "vtpu-2"),
-        vtpu_file=os.environ.get("TPU_VTPU_FILE", DEFAULT_VTPU_FILE))
+        vtpu_file=vtpu_file)
     mgr.run_forever()
     return 0
 

@@ -362,16 +362,17 @@ def validate_vtpu() -> Dict[str, str]:
     from ..isolation.fencing import fenced_chips
     from ..isolation.vtpu import read_vtpu_file
 
-    # inventory first: if one exists, validate it regardless of what the
-    # label lookup says — a published inventory is the ground truth
+    config = _node_workload_config()
+    if config == "isolated":
+        # whole-chip node: never validate an inventory here — one left
+        # over from a virtual->isolated flip is stale by definition (the
+        # fencing agent withdraws it; this proof must not bless it)
+        info = {"SKIPPED": "whole-chip isolated node, no vTPU inventory",
+                "WORKLOAD_CONFIG": config}
+        barrier.write_status("vtpu-ready", info)
+        return info
     vtpu = read_vtpu_file()
     if not vtpu or not vtpu.get("devices"):
-        config = _node_workload_config()
-        if config == "isolated":
-            info = {"SKIPPED": "whole-chip isolated node, no vTPU inventory",
-                    "WORKLOAD_CONFIG": config}
-            barrier.write_status("vtpu-ready", info)
-            return info
         if not config:
             # can't tell isolated from virtual: retry (WITH_WAIT), don't
             # demand an inventory that may by design never exist here

@@ -1,0 +1,237 @@
+"""Context-parallel attention for long sequences: ring + all-to-all.
+
+Long-context workloads shard the *sequence* axis across chips; attention
+then needs cross-chip communication because every query attends to every
+(earlier) key. Two standard TPU-native strategies, both SPMD under
+``shard_map`` so XLA lowers the communication onto ICI:
+
+- **Ring attention** (``ring_attention``): K/V blocks rotate around a 1D
+  ring of devices via ``jax.lax.ppermute`` while each device's Q stays
+  put; partial results merge with an online-softmax (running max +
+  normalizer) so the result is exact, not approximate. Communication is
+  neighbor-to-neighbor only — the pattern ICI tori are built for — and
+  each hop's transfer overlaps the next block's compute.
+- **Ulysses / all-to-all** (``ulysses_attention``): ``lax.all_to_all``
+  re-shards [B, S/n, H, D] -> [B, S, H/n, D], runs plain local attention
+  over the *full* sequence with a head subset, then re-shards back.
+  Cheaper at moderate sequence lengths (2 collectives instead of n-1
+  hops), but requires n_heads % n_devices == 0.
+
+The reference operator has no analog (its parallelism surface is fabric
+*enablement*, SURVEY.md section 2.5); this module is part of the
+framework's long-context story alongside the sharded burn-in step
+(workloads/burnin.py). The single-device reference implementation doubles
+as the correctness oracle in tests and in ``run()``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Plain single-device attention, the correctness oracle.
+    q,k,v: [B, S, H, D] -> [B, S, H, D]."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _block_attend(q, k, v, q_offset, k_offset, causal: bool):
+    """One (Q-block, KV-block) tile: returns (out, lse-max m, normalizer l)
+    with scores kept in f32 for the online-softmax merge.
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B, H, Sq]
+    p = jnp.exp(scores - m[..., None])
+    # fully-masked rows: m == NEG_INF, p == 1 from exp(0) — zero them
+    p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)                           # [B, H, Sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m, l
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Per-device body (runs inside shard_map). q,k,v: [B, S_local, H, D]
+    sharded on S. K/V travel the ring; the online softmax merges each
+    incoming block into (o, l, m) running state."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = my_idx * s_local
+
+    # accumulators derive from q so they carry q's device-varying type —
+    # a plain jnp.zeros would be "replicated" and trip shard_map's
+    # varying-manual-axes check once the loop body mixes in ppermuted data
+    zero_q = jnp.zeros_like(q, jnp.float32)
+    o0 = zero_q
+    l0 = zero_q[..., 0].transpose(0, 2, 1)            # [B, H, S_local]
+    m0 = l0 + NEG_INF
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def merge(o, l, m, bo, bm, bl):
+        m_new = jnp.maximum(m, bm)
+        # rescale both accumulators onto the new max
+        alpha = jnp.exp(m - m_new)          # old-state scale
+        beta = jnp.exp(bm - m_new)          # block scale
+        alpha = jnp.where(m_new <= NEG_INF / 2, 0.0, alpha)
+        beta = jnp.where(m_new <= NEG_INF / 2, 0.0, beta)
+        l = l * alpha + bl * beta
+        o = o * alpha.transpose(0, 2, 1)[..., None] \
+            + bo * beta.transpose(0, 2, 1)[..., None]
+        return o, l, m_new
+
+    def attend(i, o, l, m, k_blk, v_blk):
+        # after i hops, the resident K/V block originated on device
+        # (my_idx - i) mod n
+        k_offset = ((my_idx - i) % n) * s_local
+        bo, bm, bl = _block_attend(q, k_blk, v_blk, q_offset, k_offset,
+                                   causal)
+        return merge(o, l, m, bo, bm, bl)
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        o, l, m = attend(i, o, l, m, k_blk, v_blk)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk
+
+    # n-1 hops: the loop permutes after each of the first n-1 blocks; the
+    # final resident block is attended outside so its K/V are never
+    # shipped a pointless extra hop around the ring
+    o, l, m, k_blk, v_blk = lax.fori_loop(0, n - 1, body, (o0, l0, m0, k, v))
+    o, l, _ = attend(n - 1, o, l, m, k_blk, v_blk)
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows output zeros
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True):
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+    q,k,v: [B, S, H, D] with S divisible by the axis size."""
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool):
+    """Per-device body: all-to-all heads<->sequence, local full-sequence
+    attention, all-to-all back. q,k,v: [B, S_local, H, D]."""
+    a2a = lambda t: lax.all_to_all(t, axis_name, split_axis=2,
+                                   concat_axis=1, tiled=True)
+    q, k, v = a2a(q), a2a(k), a2a(v)          # [B, S, H_local, D]
+    out = reference_attention(q, k, v, causal=causal)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                      causal: bool = True):
+    """All-to-all sequence parallelism (Ulysses): needs
+    n_heads % axis_size == 0."""
+    axis_size = mesh.shape[axis_name]
+    if q.shape[2] % axis_size:
+        raise ValueError(f"n_heads={q.shape[2]} not divisible by "
+                         f"axis size {axis_size}")
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContextParallelResult:
+    strategy: str
+    devices: int
+    seq_len: int
+    max_abs_err: float
+    seconds: float
+    correct: bool
+
+
+def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
+        batch: int = 1, causal: bool = True,
+        strategy: str = "ring",
+        mesh: Optional[Mesh] = None) -> ContextParallelResult:
+    """Run context-parallel attention over all devices and check it
+    against the single-device oracle."""
+    import time
+
+    devices = jax.devices()
+    if mesh is None:
+        mesh = Mesh(np.array(devices), ("sp",))
+    n = mesh.shape["sp"]
+    if seq_len % n:
+        raise ValueError(f"seq_len={seq_len} not divisible by {n} devices")
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, seq_len, n_heads, head_dim)
+    dtype = jnp.float32 if devices[0].platform == "cpu" else jnp.bfloat16
+    q = jax.random.normal(kq, shape, dtype)
+    k = jax.random.normal(kk, shape, dtype)
+    v = jax.random.normal(kv, shape, dtype)
+
+    fn = ring_attention if strategy == "ring" else ulysses_attention
+    sharded = jax.jit(functools.partial(fn, mesh=mesh, causal=causal))
+    out = sharded(q, k, v)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    out = sharded(q, k, v)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    ref = jax.jit(functools.partial(reference_attention, causal=causal))(
+        q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    tol = 1e-4 if dtype == jnp.float32 else 2e-2
+    return ContextParallelResult(strategy=strategy, devices=n,
+                                 seq_len=seq_len, max_abs_err=err,
+                                 seconds=dt, correct=err < tol)
+
+
+def main() -> int:
+    import json
+
+    results = [run(strategy=s).__dict__ for s in ("ring", "ulysses")]
+    print(json.dumps(results))
+    return 0 if all(r["correct"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
