@@ -134,6 +134,40 @@ class TestFencingAgent:
         agent.apply_once()
         assert (isolation_env / "vtpu-config.json").exists()
 
+    def test_unlabeled_virtual_by_default_keeps_vtpu_file(self,
+                                                          isolation_env):
+        # node routed 'virtual' via sandboxWorkloads.defaultWorkload has
+        # no label; the agent must resolve the default, not withdraw the
+        # inventory and fight the vtpu manager forever
+        c = FakeClient()
+        c.add_node("tpu-0", labels=dict(V5E_LABELS))
+        (isolation_env / "vtpu-config.json").write_text("{}")
+        agent = FencingAgent(c, "tpu-0",
+                             fencing_file=str(isolation_env / "fencing.json"),
+                             default_workload="virtual")
+        agent.apply_once()
+        assert (isolation_env / "vtpu-config.json").exists()
+
+    def test_shared_plugin_withdraws_stale_files_on_start(self,
+                                                          isolation_env):
+        from tpu_operator.deviceplugin.plugin import (
+            IsolatedTPUDevicePlugin,
+            TPUDevicePlugin,
+        )
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        (isolation_env / "vtpu-config.json").write_text("{}")
+        # isolated plugin never withdraws — the fence belongs where it runs
+        IsolatedTPUDevicePlugin(
+            socket_dir=str(isolation_env))._converge_node_regime()
+        assert (isolation_env / "fencing.json").exists()
+        # shared plugin runs only on container-routed nodes: leftovers go
+        TPUDevicePlugin(
+            socket_dir=str(isolation_env))._converge_node_regime()
+        assert not (isolation_env / "fencing.json").exists()
+        assert not (isolation_env / "vtpu-config.json").exists()
+
     def test_bad_config_marks_failed(self, isolation_env):
         c = FakeClient()
         c.add_node("tpu-0", labels={**V5E_LABELS,

@@ -308,12 +308,33 @@ class TPUDevicePlugin:
             except Exception:
                 log.exception("device re-discovery failed")
 
+    def _converge_node_regime(self) -> None:
+        """This plugin is only scheduled on container-routed nodes, so
+        isolation files found here are leftovers from a node that left
+        the isolated plane (the fencing/vtpu DaemonSets are gone and
+        can't withdraw them — a preStop would instead fire on every pod
+        restart and briefly re-admit fenced chips). Withdrawing them at
+        startup is the convergence point for the plane's exit path."""
+        from ..isolation.fencing import DEFAULT_FENCING_FILE
+        from ..isolation.vtpu import DEFAULT_VTPU_FILE
+
+        for env_key, default in (("TPU_FENCING_FILE", DEFAULT_FENCING_FILE),
+                                 ("TPU_VTPU_FILE", DEFAULT_VTPU_FILE)):
+            path = os.environ.get(env_key, default)
+            try:
+                os.unlink(path)
+                log.info("withdrew stale isolation file %s (node is "
+                         "container-routed)", path)
+            except FileNotFoundError:
+                pass
+
     def start(self) -> None:
         os.makedirs(self.socket_dir, exist_ok=True)
         try:
             os.unlink(self.socket_path)
         except FileNotFoundError:
             pass
+        self._converge_node_regime()
         self.refresh_devices()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((self._handlers(),))
@@ -410,6 +431,11 @@ class IsolatedTPUDevicePlugin(TPUDevicePlugin):
 
     def _pick_resource(self) -> str:
         return self._vtpu_resource if vtpu_lookup() else self._whole_resource
+
+    def _converge_node_regime(self) -> None:
+        # the isolated plugin runs where the fence BELONGS — never
+        # withdraw it here
+        pass
 
     def refresh_devices(self) -> None:
         # the advertised resource follows the pool's mode: flipping a node

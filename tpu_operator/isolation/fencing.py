@@ -98,11 +98,16 @@ class FencingAgent:
 
     def __init__(self, client: Client, node_name: str,
                  default_config: str = "all",
-                 fencing_file: str = DEFAULT_FENCING_FILE):
+                 fencing_file: str = DEFAULT_FENCING_FILE,
+                 default_workload: str = "isolated"):
         self.client = client
         self.node_name = node_name
         self.default_config = default_config
         self.fencing_file = fencing_file
+        # what an unlabeled node on this DaemonSet is routed as — comes
+        # from sandboxWorkloads.defaultWorkload via the manifest, because
+        # the operator routes by default without stamping the label
+        self.default_workload = default_workload
 
     def _set_state(self, state: str) -> None:
         self.client.patch("v1", "Node", self.node_name,
@@ -124,8 +129,11 @@ class FencingAgent:
         write_fencing_file(self.fencing_file, fenced, config)
         # a node flipped virtual->isolated keeps its old vTPU inventory
         # on disk, but the vtpu manager is no longer scheduled here to
-        # withdraw it — this agent still is, so it owns that convergence
-        if nl.get(L.WORKLOAD_CONFIG) != "virtual":
+        # withdraw it — this agent still is, so it owns that convergence.
+        # Unlabeled nodes resolve to the plane's default workload (they
+        # may well be 'virtual' by default; withdrawing there would fight
+        # the vTPU manager's republish loop forever).
+        if nl.get(L.WORKLOAD_CONFIG, self.default_workload) != "virtual":
             self._withdraw_vtpu_file()
         self._set_state(STATE_SUCCESS)
         log.info("fenced %d/%d chip(s) (config=%r)", len(fenced),
@@ -144,10 +152,11 @@ class FencingAgent:
             pass
 
     def cleanup(self) -> None:
-        """preStop teardown: when this DaemonSet leaves the node (plane
-        disabled or node re-routed to container mode), the fence must go
-        with it — a stale fence would permanently exclude every chip from
-        the shared pool. The vTPU inventory falls with the fence."""
+        """Manual/ops teardown (``tpu-chip-fencing cleanup``): withdraw
+        the fence and the vTPU inventory. NOT wired as a preStop — pod
+        restarts would briefly re-admit fenced chips to the shared pool;
+        instead the shared device plugin withdraws stale files at startup
+        on nodes that left the plane (plugin._converge_node_regime)."""
         try:
             pathlib.Path(self.fencing_file).unlink()
         except FileNotFoundError:
@@ -179,7 +188,9 @@ def main() -> int:  # pragma: no cover - container entrypoint
         node_name=os.environ["NODE_NAME"],
         default_config=os.environ.get("FENCING_CONFIG", "all"),
         fencing_file=os.environ.get("TPU_FENCING_FILE",
-                                    DEFAULT_FENCING_FILE))
+                                    DEFAULT_FENCING_FILE),
+        default_workload=os.environ.get("TPU_DEFAULT_WORKLOAD_CONFIG",
+                                        "isolated"))
     if args.action == "cleanup":
         agent.cleanup()
         return 0

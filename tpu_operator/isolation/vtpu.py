@@ -199,7 +199,8 @@ def main() -> int:  # pragma: no cover - container entrypoint
     args = p.parse_args()
     vtpu_file = os.environ.get("TPU_VTPU_FILE", DEFAULT_VTPU_FILE)
     if args.action == "cleanup":
-        # preStop: the inventory leaves the node with this pod
+        # manual/ops teardown (not a preStop: restarts must not flap the
+        # isolated plugin's advertised resource)
         try:
             pathlib.Path(vtpu_file).unlink()
             log.info("vTPU inventory withdrawn (preStop)")

@@ -1,0 +1,182 @@
+"""Multi-host / multi-slice distributed backend.
+
+The reference's distributed story is NCCL inside user workloads plus
+fabric enablement by the operator (SURVEY.md section 2.5). The TPU-native
+equivalent has two halves:
+
+- **Process bootstrap** (``initialize``): multi-host JAX runs one process
+  per host, all joined through ``jax.distributed`` at a coordinator. The
+  operator's device plugin / runtime state provide the env contract
+  (worker id, coordinator address, world size); this module turns it into
+  an idempotent ``jax.distributed.initialize`` call. Supported sources,
+  most explicit first: TPU_* envs (this framework's contract), the
+  MEGASCALE_* envs GKE sets for multi-slice jobs, else single-process.
+- **Hybrid mesh shaping** (``hybrid_mesh``): multi-slice jobs see devices
+  spanning slices; collectives *within* a slice ride ICI (fast), while
+  cross-slice traffic crosses the DCN (slow). The mesh must put the
+  outermost, least-chatty parallelism axis (data) across the DCN and keep
+  tensor/sequence axes inside a slice. ``hybrid_mesh`` groups devices by
+  their slice, checks the grouping is rectangular, and returns a Mesh
+  shaped [dcn, data, model] so shardings compose the right way by
+  construction.
+
+The JAX workloads (burn-in, collectives, ring attention) all take a Mesh,
+so they run unchanged on a hybrid mesh; the DCN validator proof
+(validator/components.py validate_dcn) checks the coordinator path this
+module depends on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import factor_axes
+
+log = logging.getLogger("tpu_operator.multihost")
+
+
+@dataclass
+class DistributedConfig:
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    auto: bool = False  # let jax/libtpu resolve the process topology
+
+    @property
+    def multi_process(self) -> bool:
+        return self.auto or self.num_processes > 1
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "DistributedConfig":
+        """Resolve the process-bootstrap contract from the environment.
+
+        Precedence: the framework's own TPU_* contract (stamped by the
+        runtime state / device plugin), then GKE's MEGASCALE_* multi-slice
+        envs, else single-process. The MEGASCALE envs identify the
+        *slice*, not the process — a slice spans several hosts, so
+        process count/ids cannot be derived from them; on those nodes
+        jax.distributed is asked to auto-resolve the topology from the
+        TPU runtime (libtpu knows its worker set), which is the supported
+        path for GKE multi-slice jobs."""
+        e = os.environ if env is None else env
+        if e.get("TPU_COORDINATOR_ADDRESS"):
+            return cls(coordinator_address=e["TPU_COORDINATOR_ADDRESS"],
+                       num_processes=int(e.get("TPU_NUM_PROCESSES", "1")),
+                       process_id=int(e.get("TPU_PROCESS_ID",
+                                            e.get("TPU_WORKER_ID", "0"))))
+        if e.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            return cls(coordinator_address=None, num_processes=0,
+                       process_id=0, auto=True)
+        return cls(coordinator_address=None, num_processes=1, process_id=0)
+
+
+_initialized = False
+
+
+def initialize(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Idempotent ``jax.distributed.initialize`` from the env contract.
+    Single-process configs are a no-op (local jax.devices() already sees
+    every chip on the host); ``auto`` configs delegate topology discovery
+    to jax/libtpu (argument-less initialize)."""
+    global _initialized
+    cfg = config or DistributedConfig.from_env()
+    if not cfg.multi_process or _initialized:
+        return cfg
+    if cfg.auto:
+        jax.distributed.initialize()
+        log.info("joined distributed runtime (auto-resolved topology)")
+    else:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id)
+        log.info("joined distributed runtime: process %d/%d via %s",
+                 cfg.process_id, cfg.num_processes, cfg.coordinator_address)
+    _initialized = True
+    return cfg
+
+
+def slice_id_of(device) -> int:
+    """A device's slice: TPU devices expose ``slice_index`` on multi-slice
+    jobs; single-slice (and CPU test) devices fall back to slice 0."""
+    return int(getattr(device, "slice_index", 0) or 0)
+
+
+def group_by_slice(devices: Sequence[jax.Device],
+                   slice_getter: Callable = slice_id_of,
+                   ) -> List[List[jax.Device]]:
+    groups: Dict[int, List[jax.Device]] = {}
+    for d in devices:
+        groups.setdefault(slice_getter(d), []).append(d)
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"slices are not the same size: "
+            f"{ {k: len(v) for k, v in groups.items()} } — a hybrid mesh "
+            "needs a rectangular slice grouping")
+    return [groups[k] for k in sorted(groups)]
+
+
+def hybrid_mesh(devices: Optional[Sequence[jax.Device]] = None,
+                model_parallel: Optional[int] = None,
+                axis_names: Tuple[str, str, str] = ("dcn", "data", "model"),
+                slice_getter: Callable = slice_id_of) -> Mesh:
+    """Mesh shaped [num_slices, data, model]: the slice axis (DCN) is
+    outermost so only the least-communication-heavy parallelism (data /
+    gradient allreduce, overlappable with compute) crosses slices, and
+    tensor/model axes stay inside one slice's ICI torus — the scaling-book
+    recipe for multi-slice layouts."""
+    devices = list(devices if devices is not None else jax.devices())
+    slices = group_by_slice(devices, slice_getter)
+    per_slice = len(slices[0])
+    dp, mp = factor_axes(per_slice, model_parallel)
+    arr = np.array([d for g in slices for d in g]).reshape(
+        len(slices), dp, mp)
+    return Mesh(arr, axis_names)
+
+
+def training_mesh(devices: Optional[Sequence[jax.Device]] = None,
+                 model_parallel: Optional[int] = None,
+                 slice_getter: Callable = slice_id_of) -> Mesh:
+    """2D [data, model] mesh whose model axis is guaranteed to sit inside
+    one slice: devices are ordered slice-by-slice and the model factor is
+    taken from the per-slice size, so tensor-parallel collectives ride
+    ICI while the data axis (gradient allreduce, overlappable) is what
+    spans the DCN. Single-slice this degenerates to the plain 2D mesh.
+
+    Workloads written against [data, model] specs (the burn-in step) run
+    unchanged on multi-slice topologies through this."""
+    devices = list(devices if devices is not None else jax.devices())
+    slices = group_by_slice(devices, slice_getter)
+    per_slice = len(slices[0])
+    if model_parallel and model_parallel > per_slice:
+        raise ValueError(
+            f"model_parallel={model_parallel} exceeds the slice size "
+            f"{per_slice}: the model axis must not cross the DCN")
+    dp_inner, mp = factor_axes(per_slice, model_parallel)
+    ordered = [d for g in slices for d in g]
+    arr = np.array(ordered).reshape(len(slices) * dp_inner, mp)
+    return Mesh(arr, ("data", "model"))
+
+
+def mesh_for_env(devices: Optional[Sequence[jax.Device]] = None,
+                 model_parallel: Optional[int] = None) -> Mesh:
+    """The right mesh for wherever this process is running: hybrid
+    [dcn, data, model] when devices span slices, plain [data, model]
+    otherwise (the common single-slice case keeps its 2D shape so
+    existing specs work unchanged)."""
+    from .mesh import build_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n_slices = len({slice_id_of(d) for d in devices})
+    if n_slices > 1:
+        return hybrid_mesh(devices, model_parallel)
+    return build_mesh(devices, model_parallel)

@@ -254,6 +254,10 @@ def _chip_fencing_data(ctx: SyncContext) -> dict:
     spec = ctx.spec.chip_fencing
     data = common_data(ctx, spec, "chip-fencing", "tpu-chip-fencing")
     data["FencingConfig"] = spec.config or "all"
+    # agents on unlabeled nodes must resolve the same workload config the
+    # operator routed them by (the label is never stamped)
+    data["DefaultWorkload"] = \
+        ctx.spec.sandbox_workloads.default_workload or "container"
     return data
 
 
@@ -272,6 +276,8 @@ def _isolated_validation_data(ctx: SyncContext) -> dict:
     # vtpu proof only gates nodes that actually carve vTPUs (the virtual
     # workload config); the manifest keys the initContainer off this flag
     data["VTPUEnabled"] = ctx.spec.vtpu_device_manager.is_enabled()
+    data["DefaultWorkload"] = \
+        ctx.spec.sandbox_workloads.default_workload or "container"
     return data
 
 

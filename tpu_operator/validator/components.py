@@ -339,18 +339,22 @@ def _node_workload_config() -> str:
     env = os.environ.get("TPU_WORKLOAD_CONFIG", "")
     if env:
         return env
+    # a node with no label was routed by the plane's default — the
+    # manifest passes it down so the proof resolves the same config the
+    # operator did
+    default = os.environ.get("TPU_DEFAULT_WORKLOAD_CONFIG", "")
     node_name = os.environ.get("NODE_NAME", "")
     if not node_name:
-        return ""
+        return default
     try:
         from ..api import labels as L
         from ..runtime.kubeclient import HTTPClient, KubeConfig
 
         node = HTTPClient(KubeConfig.load()).get("v1", "Node", node_name)
         return ((node.get("metadata") or {}).get("labels") or {}).get(
-            L.WORKLOAD_CONFIG, "")
+            L.WORKLOAD_CONFIG, default)
     except Exception:
-        return ""
+        return default
 
 
 def validate_vtpu() -> Dict[str, str]:

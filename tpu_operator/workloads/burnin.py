@@ -24,8 +24,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import build_mesh
-
 
 @dataclass(frozen=True)
 class BurninConfig:
@@ -201,7 +199,12 @@ def run(cfg: Optional[BurninConfig] = None, steps: int = 5,
     """Run the burn-in; returns (first_loss, last_loss). Loss must fall —
     that is the correctness proof that grads flowed through every shard."""
     cfg = cfg or BurninConfig()
-    mesh = build_mesh(model_parallel=model_parallel)
+    # joins the multi-host runtime when the env contract says so (no-op
+    # single-process) and keeps the model axis inside one slice
+    from ..parallel.multihost import initialize, training_mesh
+
+    initialize()
+    mesh = training_mesh(model_parallel=model_parallel)
     step, init_state, _ = make_train_step(mesh, cfg)
     key = jax.random.PRNGKey(0)
     state = init_state(key)

@@ -194,7 +194,9 @@ def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
 
     devices = jax.devices()
     if mesh is None:
-        mesh = Mesh(np.array(devices), ("sp",))
+        from ..parallel.mesh import ring_mesh
+
+        mesh = ring_mesh(devices, axis_name="sp")
     n = mesh.shape["sp"]
     if seq_len % n:
         raise ValueError(f"seq_len={seq_len} not divisible by {n} devices")
