@@ -415,6 +415,32 @@ class TestValidatorComponents:
         assert barrier.is_ready("vtpu-ready")
 
 
+class TestNodeMetricsIsolationGauges:
+    def test_gauges_absent_on_container_nodes(self, isolation_env):
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        m = NodeMetrics("n0")
+        m.collect_once()
+        body = m.render().decode()
+        assert 'component="driver"' in body
+        # no fence on this node: a constant 0 would be indistinguishable
+        # from a real validation failure, so the series must be absent
+        assert 'component="fencing"' not in body
+        assert 'component="vtpu"' not in body
+
+    def test_gauges_emitted_where_fence_exists(self, isolation_env):
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        write_fencing_file(str(isolation_env / "fencing.json"),
+                           ["accel0"], "accel0")
+        components.validate_fencing()
+        m = NodeMetrics("n0")
+        m.collect_once()
+        body = m.render().decode()
+        assert 'tpu_operator_node_component_ready{component="fencing",node="n0"} 1.0' in body
+        assert 'component="vtpu"' in body
+
+
 class TestRouting:
     def test_virtual_config_routes_vtpu_states(self):
         c = FakeClient()

@@ -94,6 +94,33 @@ class TestUpgradeFSM:
         assert not get_nested(node, "spec", "unschedulable", default=False)
         assert result.requeue_after == 120.0
 
+    def test_validation_waits_for_validator_pods(self):
+        # after the driver restarts, the node's validator pods must
+        # re-prove the stack before uncordon — driver readiness alone is
+        # not validation (cmd/gpu-operator/main.go:151 semantics)
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # validator pods were deleted along with the driver pod
+        assert rec._validator_pods_by_node().get("tpu-0", []) == []
+        c.simulate_kubelet(ready=True)
+        # force the recreated validator pod NotReady: validation must hold
+        for pod in rec._validator_pods_by_node().get("tpu-0", []):
+            for cond in get_nested(pod, "status", "conditions",
+                                   default=[]) or []:
+                if cond.get("type") == "Ready":
+                    cond["status"] = "False"
+            c.update(pod)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_VALIDATION
+        # validator recovers -> upgrade completes
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_DONE
+
     def test_parallel_budget_respected(self):
         c, prec = build_converged_cluster(n_nodes=3)
         rec = UpgradeReconciler(client=c, namespace="tpu-operator")

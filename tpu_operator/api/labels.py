@@ -57,7 +57,8 @@ DEFAULT_VALIDATION_DIR = "/run/tpu/validations"
 # analogs are container | isolated (whole fenced chips, the passthrough
 # slot) | virtual (fractional vTPU devices over fenced chips, the vGPU
 # slot). Isolated/virtual nodes trade the shared plugin + telemetry
-# operands for the fencing plane, exactly as sandbox nodes trade the
+# operands for the fencing plane (keeping the node-status exporter so
+# validation state stays observable), exactly as sandbox nodes trade the
 # container operand set for the sandbox one (updateGPUStateLabels,
 # state_manager.go:363-421).
 CONTAINER_WORKLOAD_STATES = (
@@ -76,6 +77,7 @@ ISOLATED_WORKLOAD_STATES = (
     "chip-fencing",
     "isolated-validation",
     "isolated-device-plugin",
+    "node-status-exporter",
 )
 VIRTUAL_WORKLOAD_STATES = (
     "libtpu-driver",
@@ -83,6 +85,7 @@ VIRTUAL_WORKLOAD_STATES = (
     "vtpu-device-manager",
     "isolated-validation",
     "isolated-device-plugin",
+    "node-status-exporter",
 )
 WORKLOAD_STATE_SETS = {
     "container": CONTAINER_WORKLOAD_STATES,

@@ -111,6 +111,45 @@ class UpgradeReconciler(Reconciler):
                 return pod
         return None
 
+    VALIDATOR_APPS = ("tpu-operator-validator", "tpu-isolated-validator")
+
+    def _validator_pods_by_node(self) -> Dict[str, List[dict]]:
+        """node -> its validation-gate pods — operator-validator on
+        container nodes, isolated-validator on isolated/virtual nodes
+        (the reference validates upgrades via its
+        app=nvidia-operator-validator pods, cmd/gpu-operator/main.go:151).
+        One LIST per app per reconcile; Terminating pods are excluded —
+        a dying validator's Ready=True is the OLD proof, not a
+        re-validation against the new driver."""
+        out: Dict[str, List[dict]] = {}
+        for app in self.VALIDATOR_APPS:
+            for pod in self.client.list(
+                    "v1", "Pod",
+                    ListOptions(namespace=self.namespace,
+                                label_selector={"app": app})):
+                if get_nested(pod, "metadata", "deletionTimestamp"):
+                    continue
+                node = get_nested(pod, "spec", "nodeName")
+                if node:
+                    out.setdefault(node, []).append(pod)
+        return out
+
+    def _validator_ds_exists(self) -> bool:
+        """Whether any validation-gate DaemonSet is deployed at all — with
+        the validator state disabled there are no gate pods to wait for
+        and upgrade validation falls back to driver-pod readiness."""
+        return any(
+            get_nested(ds, "metadata", "labels", "app") in self.VALIDATOR_APPS
+            for ds in self.client.list(
+                "apps/v1", "DaemonSet",
+                ListOptions(namespace=self.namespace)))
+
+    @staticmethod
+    def _pod_ready(pod: dict) -> bool:
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in get_nested(pod, "status", "conditions",
+                                       default=[]) or [])
+
     def _tpu_workload_pods_on(self, node_name: str) -> List[dict]:
         """Pods consuming google.com/tpu on the node — the drain set
         (the reference drains with a GPU-pod selector, main.go:105-117)."""
@@ -175,6 +214,9 @@ class UpgradeReconciler(Reconciler):
             1 for n in nodes.values()
             if labels_of(n).get(L.UPGRADE_STATE) in IN_PROGRESS_STATES)
         budget = max(1, policy.max_parallel_upgrades or 1)
+        # cluster-invariant lookups hoisted out of the node loop
+        validator_pods = self._validator_pods_by_node()
+        validator_gate_deployed = self._validator_ds_exists()
 
         for node_name, node in sorted(nodes.items()):
             pod = self._driver_pod_on(node_name)
@@ -187,9 +229,7 @@ class UpgradeReconciler(Reconciler):
             want = revisions.get(ds_name)
             have = labels_of(pod).get("controller-revision-hash")
             state = labels_of(node).get(L.UPGRADE_STATE)
-            pod_ready = any(c.get("type") == "Ready" and c.get("status") == "True"
-                            for c in get_nested(pod, "status", "conditions",
-                                                default=[]) or [])
+            pod_ready = self._pod_ready(pod)
 
             if want is None:
                 continue
@@ -228,18 +268,28 @@ class UpgradeReconciler(Reconciler):
                 state = STATE_POD_RESTART
                 self._set_node_state(node, state)
             if state == STATE_POD_RESTART:
-                try:
-                    self.client.delete("v1", "Pod", name_of(pod),
-                                       namespace_of(pod) or None)
-                    log.info("restarting driver pod on %s", node_name)
-                except NotFoundError:
-                    pass
+                # the validator pods restart WITH the driver: their
+                # initContainers re-prove the node against the new libtpu
+                # (the driver-manager preflight closed every gate), which
+                # is what STATE_VALIDATION then waits on
+                victims = [pod] + validator_pods.get(node_name, [])
+                for v in victims:
+                    try:
+                        self.client.delete("v1", "Pod", name_of(v),
+                                           namespace_of(v) or None)
+                    except NotFoundError:
+                        pass
+                log.info("restarting driver + validator pods on %s",
+                         node_name)
                 state = STATE_VALIDATION
                 self._set_node_state(node, state)
                 node_states[node_name] = state
                 continue  # must wait for kubelet to recreate
             if state == STATE_VALIDATION:
-                if have == want and pod_ready:
+                validators = validator_pods.get(node_name, [])
+                validators_ok = all(self._pod_ready(p) for p in validators) \
+                    and (bool(validators) or not validator_gate_deployed)
+                if have == want and pod_ready and validators_ok:
                     state = STATE_UNCORDON
                     self._set_node_state(node, state)
                 else:

@@ -27,6 +27,14 @@ COMPONENT_FILES = {
     "ici": "ici-ready",
 }
 
+# isolated-plane components: emitted only on nodes where the plane is
+# present (fence file published), so container nodes don't export a
+# constant 0 that is indistinguishable from a real validation failure
+ISOLATION_COMPONENT_FILES = {
+    "fencing": "fencing-ready",
+    "vtpu": "vtpu-ready",
+}
+
 
 class NodeMetrics:
     def __init__(self, node_name: str = ""):
@@ -49,6 +57,16 @@ class NodeMetrics:
             labelnames=("node",), registry=self.registry)
         self._reval_count = 0
 
+    @staticmethod
+    def _isolation_plane_present() -> bool:
+        """This node runs the isolated plane iff a fence has been
+        published (or its proof passed) — the signal the exporter can see
+        without apiserver access."""
+        from ..isolation.fencing import read_fencing_file
+
+        return read_fencing_file() is not None or \
+            barrier.is_ready("fencing-ready")
+
     def collect_once(self, revalidate: bool = False) -> None:
         if revalidate:
             self._reval_count += 1
@@ -67,6 +85,10 @@ class NodeMetrics:
         for comp, fname in COMPONENT_FILES.items():
             self.ready.labels(component=comp, node=self.node_name).set(
                 1 if barrier.is_ready(fname) else 0)
+        if self._isolation_plane_present():
+            for comp, fname in ISOLATION_COMPONENT_FILES.items():
+                self.ready.labels(component=comp, node=self.node_name).set(
+                    1 if barrier.is_ready(fname) else 0)
         info = barrier.read_status("driver-ready") or {}
         self.chips.labels(node=self.node_name).set(
             int(info.get("CHIP_COUNT", "0") or 0))
