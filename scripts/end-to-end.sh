@@ -80,4 +80,27 @@ JAX_PLATFORMS=cpu TPU_VALIDATOR_ALLOW_CPU=true MATMUL_SIZE=256 \
 test -f "$TPU_VALIDATION_DIR/jax-ready"
 stage workload-proof
 
+# -- isolated-workload plane (sandbox tier): fence -> vTPU -> proofs ------
+export TPU_FENCING_FILE="$WORK/fencing.json" TPU_VTPU_FILE="$WORK/vtpu.json"
+export TPU_FAKE_CHIPS=4 TPU_WORKLOAD_CONFIG=virtual
+if $PY -m tpu_operator.cli.validator -c fencing 2>/dev/null; then
+  echo "FAIL: fencing proof passed without a fence"; exit 1
+fi
+$PY - <<'EOF'
+import os
+from tpu_operator.isolation.fencing import write_fencing_file
+from tpu_operator.isolation.vtpu import VTPUProfile, build_vtpu_devices, write_vtpu_file
+write_fencing_file(os.environ["TPU_FENCING_FILE"], ["accel0", "accel1"],
+                   "accel0,accel1")
+write_vtpu_file(os.environ["TPU_VTPU_FILE"], VTPUProfile("vtpu-2", 2),
+                build_vtpu_devices(["accel0", "accel1"],
+                                   VTPUProfile("vtpu-2", 2), 16384))
+EOF
+$PY -m tpu_operator.cli.validator -c fencing
+test -f "$TPU_VALIDATION_DIR/fencing-ready"
+$PY -m tpu_operator.cli.validator -c vtpu
+test -f "$TPU_VALIDATION_DIR/vtpu-ready"
+unset TPU_FENCING_FILE TPU_VTPU_FILE TPU_FAKE_CHIPS TPU_WORKLOAD_CONFIG
+stage isolated-plane
+
 echo "END_TO_END_OK"

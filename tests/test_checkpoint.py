@@ -1,0 +1,76 @@
+"""Checkpoint/resume for the sharded burn-in state: the sharded pytree
+must round-trip through orbax with shardings preserved, and an
+interrupted run must resume where it stopped (preemption-safety tier,
+exercised on the 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_operator.parallel.mesh import build_mesh
+from tpu_operator.workloads.burnin import (
+    BurninConfig,
+    make_batch,
+    make_train_step,
+    run,
+)
+from tpu_operator.workloads.checkpoint import TrainCheckpointer
+
+CFG = BurninConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                   d_ff=64, seq_len=16, batch=8)
+
+
+def small_state(mesh):
+    step, init_state, _ = make_train_step(mesh, CFG)
+    return step, init_state(jax.random.PRNGKey(0))
+
+
+class TestTrainCheckpointer:
+    def test_roundtrip_preserves_values_and_shardings(self, tmp_path):
+        mesh = build_mesh(model_parallel=2)
+        step, state = small_state(mesh)
+        state, _ = step(state, make_batch(CFG, mesh, jax.random.PRNGKey(1)))
+        ckpt = TrainCheckpointer(str(tmp_path))
+        ckpt.save(state, 1)
+        assert ckpt.latest_step() == 1
+        _, fresh = small_state(mesh)
+        restored = ckpt.restore(fresh)
+        ckpt.close()
+        assert int(restored["step"]) == 1
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["embed"]),
+            np.asarray(state["params"]["embed"]), atol=0, rtol=0)
+        # shardings restored to the live mesh's placement
+        want = state["params"]["embed"].sharding
+        assert restored["params"]["embed"].sharding.is_equivalent_to(
+            want, state["params"]["embed"].ndim)
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        ckpt = TrainCheckpointer(str(tmp_path))
+        mesh = build_mesh(model_parallel=2)
+        _, state = small_state(mesh)
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(state)
+        ckpt.close()
+
+    def test_interrupted_run_resumes_to_same_result(self, tmp_path):
+        # uninterrupted 4 steps vs 2 steps + resume: identical final loss,
+        # and `first` spans the WHOLE run (sidecar), not the resumed tail
+        first_a, last_a = run(CFG, steps=4)
+        d = str(tmp_path / "ck")
+        first_0, _ = run(CFG, steps=2, checkpoint_dir=d, checkpoint_every=1)
+        first_b, last_b = run(CFG, steps=4, checkpoint_dir=d,
+                              checkpoint_every=1)
+        assert last_b == pytest.approx(last_a, rel=1e-5)
+        assert first_b == pytest.approx(first_0, rel=1e-6)
+
+    def test_rerun_past_target_returns_current_loss(self, tmp_path):
+        # a retry after the final save must not return (None, None)
+        d = str(tmp_path / "ck")
+        first_a, last_a = run(CFG, steps=2, checkpoint_dir=d,
+                              checkpoint_every=1)
+        first_b, last_b = run(CFG, steps=2, checkpoint_dir=d,
+                              checkpoint_every=1)
+        assert first_b is not None and last_b is not None
+        assert first_b == pytest.approx(first_a, rel=1e-6)

@@ -23,4 +23,4 @@ def test_end_to_end_script():
     assert stages == ["install-manifests", "values-pipeline",
                       "validate-clusterpolicy", "verify-operator",
                       "restart-operator", "validator-components",
-                      "workload-proof"]
+                      "workload-proof", "isolated-plane"]

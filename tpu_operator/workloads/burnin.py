@@ -165,7 +165,20 @@ def make_train_step(mesh: Mesh, cfg: BurninConfig, optimizer=None):
     def init_state(key):
         params = shard_params(init_params(cfg, key), mesh, cfg)
         opt_state = optimizer.init(params)
-        return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": opt_state,
+                 "step": jnp.zeros((), jnp.int32)}
+        # commit EVERY leaf to the mesh (scalars/counters replicated):
+        # uncommitted leaves would conflict with mesh-committed restores
+        # when a checkpointed state re-enters the jitted step
+        replicated = NamedSharding(mesh, P())
+
+        def commit(x):
+            if isinstance(x, jax.Array) and \
+                    not isinstance(x.sharding, NamedSharding):
+                return jax.device_put(x, replicated)
+            return x
+
+        return jax.tree_util.tree_map(commit, state)
 
     def train_step(state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch, cfg,
@@ -195,9 +208,15 @@ def make_batch(cfg: BurninConfig, mesh: Mesh, key) -> Dict:
 
 
 def run(cfg: Optional[BurninConfig] = None, steps: int = 5,
-        model_parallel: Optional[int] = None) -> Tuple[float, float]:
+        model_parallel: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0) -> Tuple[float, float]:
     """Run the burn-in; returns (first_loss, last_loss). Loss must fall —
-    that is the correctness proof that grads flowed through every shard."""
+    that is the correctness proof that grads flowed through every shard.
+
+    With ``checkpoint_dir`` the run is preemption-safe: it resumes from
+    the latest checkpoint found there and (with ``checkpoint_every`` > 0)
+    saves the sharded train state on that cadence."""
     cfg = cfg or BurninConfig()
     # joins the multi-host runtime when the env contract says so (no-op
     # single-process) and keeps the model axis inside one slice
@@ -208,13 +227,50 @@ def run(cfg: Optional[BurninConfig] = None, steps: int = 5,
     step, init_state, _ = make_train_step(mesh, cfg)
     key = jax.random.PRNGKey(0)
     state = init_state(key)
+    ckpt = None
+    start = 0
     first = last = None
-    for i in range(steps):
-        batch = make_batch(cfg, mesh, jax.random.fold_in(key, i))
-        state, loss = step(state, batch)
-        loss = float(loss)
-        first = loss if first is None else first
-        last = loss
+    meta_path = None
+    if checkpoint_dir:
+        import json
+        import pathlib
+
+        from .checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(checkpoint_dir)
+        # the run's FIRST loss lives in a sidecar, so the loss-must-fall
+        # proof spans the whole run across preemptions, not just the tail
+        meta_path = pathlib.Path(checkpoint_dir) / "run-meta.json"
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            start = int(state["step"])
+            if meta_path.exists():
+                first = json.loads(meta_path.read_text()).get("first_loss")
+    try:
+        if start >= steps:
+            # checkpoint already at/past the target: nothing to train,
+            # report the current loss so the (first, last) contract holds
+            batch = make_batch(cfg, mesh, jax.random.fold_in(key, steps - 1))
+            last = float(jax.jit(
+                lambda p, b: loss_fn(p, b, cfg, mesh))(state["params"],
+                                                       batch))
+            first = last if first is None else first
+            return first, last
+        for i in range(start, steps):
+            batch = make_batch(cfg, mesh, jax.random.fold_in(key, i))
+            state, loss = step(state, batch)
+            loss = float(loss)
+            if first is None:
+                first = loss
+                if meta_path is not None and start == 0:
+                    meta_path.parent.mkdir(parents=True, exist_ok=True)
+                    meta_path.write_text(json.dumps({"first_loss": first}))
+            last = loss
+            if ckpt and checkpoint_every and (i + 1) % checkpoint_every == 0:
+                ckpt.save(state, i + 1)
+    finally:
+        if ckpt:
+            ckpt.close()
     return first, last
 
 

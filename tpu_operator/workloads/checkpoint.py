@@ -1,0 +1,70 @@
+"""Checkpoint/resume for the sharded training workloads (orbax-backed).
+
+The reference operator is stateless (SURVEY.md section 5: restart =
+re-list + re-reconcile), so on the control-plane side checkpoint/resume
+is N/A by design. The *workload* side is where the capability belongs on
+TPU: long multi-host burn-ins and validation runs must survive
+preemption (TPU pools are routinely preempted/defragmented), which means
+saving the sharded train state to durable storage and restoring it with
+the SAME shardings on a possibly different incarnation of the slice.
+
+Orbax handles the heavy lifting (async multi-host writes, atomicity via
+finalize-rename, per-shard files); this module pins down the framework
+contract: save(state, step), latest_step(), restore(state_like) with
+sharding-preserving restore driven by the live state's shardings.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger("tpu_operator.checkpoint")
+
+
+class TrainCheckpointer:
+    """Thin, typed wrapper over orbax's CheckpointManager for the burn-in
+    train state (params/opt/step pytree)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = pathlib.Path(directory).absolute()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, state: Any, step: int, wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the shardings/dtypes of ``state_like`` (the freshly
+        initialized state): each leaf comes back placed exactly where the
+        live mesh wants it, so resume works even when the host set (and
+        hence device ordering) changed across the preemption."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            state_like)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(target))
+
+    def close(self) -> None:
+        self._mgr.close()
