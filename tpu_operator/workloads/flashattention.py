@@ -1,0 +1,143 @@
+"""Pallas flash attention: the long-context hot op as a TPU kernel.
+
+XLA's attention materializes the [Sq, Sk] score matrix in HBM once the
+fusion budget is exceeded; flash attention keeps it in VMEM by tiling Q
+and streaming K/V chunks through an online softmax (running max +
+normalizer), so HBM traffic stays O(S*D) instead of O(S^2). This kernel
+is the local-block engine of the context-parallel path
+(workloads/ringattention.py): each ring hop's (Q-block, KV-block) attend
+runs here, and the kernel's (m, l) statistics are exactly what the ring
+merge needs, so the fused path composes with ppermute instead of
+replacing it.
+
+Layout [BH, S, D]: batch*heads on the grid's first axis, one Q tile per
+second axis step, K/V streamed in ``chunk`` slices by an inner loop.
+Causal masking is positional (global offsets passed as SMEM scalars)
+because in ring attention the K block's global position depends on which
+hop it arrived on. Runs in interpret mode on CPU (tests) and compiled on
+TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref,
+                  *, chunk: int, causal: bool, scale: float):
+    """One (bh, q-tile) program: stream K/V chunks, online softmax.
+
+    offs_ref (SMEM): [q_offset, k_offset] global positions for masking.
+    q_ref: [1, Tq, D]; k_ref/v_ref: [1, Sk, D]; out_ref: [1, Tq, D];
+    m_ref/l_ref: [1, Tq, 128] stat outputs (lane 0 meaningful, the lane
+    dim exists to satisfy TPU tiling).
+    """
+    q = q_ref[0].astype(jnp.float32)  # [Tq, D]
+    tq = q.shape[0]
+    sk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
+        jnp.int32, (tq, chunk), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * chunk, chunk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * chunk, chunk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Tq, chunk]
+        if causal:
+            k_pos = offs_ref[1] + j * chunk + jax.lax.broadcasted_iota(
+                jnp.int32, (tq, chunk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        bm = jnp.max(s, axis=1, keepdims=True)            # [Tq, 1]
+        m_new = jnp.maximum(m, bm)
+        # fully-masked tiles keep exp well-defined
+        p = jnp.exp(s - jnp.where(m_new <= NEG_INF / 2, 0.0, m_new))
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        alpha = jnp.where(m_new <= NEG_INF / 2, 0.0, alpha)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((tq, q.shape[1]), jnp.float32)
+    m0 = jnp.full((tq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((tq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, sk // chunk, body, (acc0, m0, l0))
+    out_ref[0] = (acc / jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
+    m_ref[0] = jnp.broadcast_to(m, (tq, 128)).astype(jnp.float32)
+    l_ref[0] = jnp.broadcast_to(l, (tq, 128)).astype(jnp.float32)
+
+
+def flash_attention_blocks(
+        q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+        q_offset, k_offset, causal: bool = True,
+        q_tile: int = 256, chunk: int = 512,
+        interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused attend of q against (k, v) with positional causal masking.
+
+    q, k, v: [BH, S, D]. Returns (out [BH, Sq, D] — NORMALIZED,
+    m [BH, Sq], l [BH, Sq]) so a ring merge can combine blocks:
+    unnormalized partial = out * l.
+
+    ``q_offset``/``k_offset`` are global sequence positions of element 0
+    (traced values are fine — they ride in SMEM), which is how ring hops
+    express "this K block came from device (i - hop) % n".
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    q_tile = min(q_tile, sq)
+    chunk = min(chunk, sk)
+    assert sq % q_tile == 0 and sk % chunk == 0
+    offs = jnp.asarray([q_offset, k_offset], jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    grid = (bh, sq // q_tile)
+    out, m, l = pl.pallas_call(
+        partial(_flash_kernel, chunk=chunk, causal=causal, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, q_tile, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, q_tile, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, q_tile, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, q_tile, 128), lambda b, i: (b, i, 0)),
+        ),
+        interpret=interpret,
+    )(offs, q, k, v)
+    return out, m[..., 0], l[..., 0]
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Plain single-device flash attention, [B, S, H, D] layout (the
+    drop-in for reference_attention)."""
+    B, S, H, D = q.shape
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    out, _, _ = flash_attention_blocks(
+        fold(q), fold(k), fold(v), 0, 0, causal=causal, interpret=interpret)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

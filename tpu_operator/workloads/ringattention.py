@@ -76,7 +76,26 @@ def _block_attend(q, k, v, q_offset, k_offset, causal: bool):
     return out.astype(jnp.float32), m, l
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+def _block_attend_flash(q, k, v, q_offset, k_offset, causal: bool):
+    """Same contract as _block_attend, but the tile runs as the Pallas
+    flash kernel (workloads/flashattention.py): scores never leave VMEM
+    and the kernel's (m, l) statistics feed the ring merge directly.
+    Forward-only (the kernel defines no VJP); the einsum path remains
+    the default for training."""
+    from .flashattention import flash_attention_blocks
+
+    B, S, H, D = q.shape
+    sk = k.shape[1]
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, -1, D)
+    out, m, l = flash_attention_blocks(fold(q), fold(k), fold(v),
+                                       q_offset, k_offset, causal=causal)
+    unnorm = out.astype(jnp.float32) * l[..., None]
+    unnorm = unnorm.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    return unnorm, m.reshape(B, H, S), l.reshape(B, H, S)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          use_flash: bool = False):
     """Per-device body (runs inside shard_map). q,k,v: [B, S_local, H, D]
     sharded on S. K/V travel the ring; the online softmax merges each
     incoming block into (o, l, m) running state."""
@@ -106,12 +125,14 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
             + bo * beta.transpose(0, 2, 1)[..., None]
         return o, l, m_new
 
+    block_attend = _block_attend_flash if use_flash else _block_attend
+
     def attend(i, o, l, m, k_blk, v_blk):
         # after i hops, the resident K/V block originated on device
         # (my_idx - i) mod n
         k_offset = ((my_idx - i) % n) * s_local
-        bo, bm, bl = _block_attend(q, k_blk, v_blk, q_offset, k_offset,
-                                   causal)
+        bo, bm, bl = block_attend(q, k_blk, v_blk, q_offset, k_offset,
+                                  causal)
         return merge(o, l, m, bo, bm, bl)
 
     def body(i, carry):
@@ -131,13 +152,17 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                   causal: bool = True):
+                   causal: bool = True, use_flash: bool = False):
     """Exact attention with the sequence axis sharded over ``axis_name``.
-    q,k,v: [B, S, H, D] with S divisible by the axis size."""
+    q,k,v: [B, S, H, D] with S divisible by the axis size.
+
+    ``use_flash`` runs each hop's local tile as the Pallas flash kernel
+    (forward/inference path); the default einsum tile is differentiable
+    and is what the training step uses."""
     spec = P(None, axis_name, None, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal),
+                          causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
