@@ -15,69 +15,250 @@ What is measured (BASELINE.md targets):
 
 vs_baseline = value / 0.80, so >1.0 beats the target.
 
-The reference itself publishes no numbers (SURVEY.md section 6) — its
-workload proof (CUDA vectorAdd) measures nothing; this framework's proof
-doubles as a roofline benchmark.
+Hardening (round-1 postmortem: the bench died inside backend init with
+UNAVAILABLE and produced no number at all): libtpu is single-client and
+its initialization can fail or hang transiently, so the measurement runs
+in a CHILD subprocess under a per-attempt timeout, retried with backoff.
+Between attempts the parent reports which process holds the TPU device
+nodes (tpu_operator.workloads.backend.diagnose_holders). If the TPU never
+comes up the bench still emits a JSON line: with --require-tpu it reports
+`validator_bench_unavailable` and exits 1; otherwise it falls back to
+JAX_PLATFORMS=cpu to prove the harness end-to-end (vs_baseline pinned to
+0.0 so a fallback can never masquerade as a TPU number).
 
-Details (device kind, absolute TFLOP/s / GB/s, timings) go to stderr.
+Details (device kind, absolute TFLOP/s / GB/s, timings, diagnostics) go
+to stderr; stdout carries exactly one JSON line.
 """
 
+from __future__ import annotations
+
+import argparse
 import json
+import os
+import subprocess
 import sys
+import time
 
 BASELINE_FRACTION = 0.80
 
 
-def main() -> int:
-    import jax
+# ----------------------------------------------------------------- child
 
-    from tpu_operator.workloads import collectives, hardware, matmul
+def _emit(doc: dict, platform: str, ok: bool) -> int:
+    """Print the JSON line. ``_platform`` rides along for the parent (which
+    strips it); a failed correctness check invalidates the number rather
+    than letting a broken-but-fast run pass the bar."""
+    if not ok:
+        doc["metric"] += "_invalid"
+        doc["vs_baseline"] = 0.0
+    doc["_platform"] = platform
+    print(json.dumps(doc))
+    return 0 if ok else 1
 
-    platform, n_devices, kind, spec = hardware.detect()
+
+def child_main() -> int:
+    """Run the actual measurement in this process; print the JSON line."""
+    budget = float(os.environ.get("TPUOP_BENCH_CHILD_TIMEOUT", "0") or 0)
+    if budget > 30:
+        # backend init can hang at the C level (remote PJRT tunnel); dump
+        # the stack and self-terminate just before the parent's kill so
+        # the hang site lands in the parent's diagnostics.
+        import faulthandler
+
+        faulthandler.dump_traceback_later(budget - 15, exit=True)
+
+    from tpu_operator.workloads import backend, collectives, hardware, matmul
+
+    # single init try: the parent orchestrator owns retry/backoff (a fresh
+    # process per attempt also sidesteps any cached-failure state)
+    devices = backend.init_devices(
+        attempts=1, platform=os.environ.get("TPUOP_BENCH_PLATFORM") or None)
+    platform = devices[0].platform
+    kind = getattr(devices[0], "device_kind", "")
+    spec = hardware.chip_spec_for(kind)
+    n_devices = len(devices)
     print(f"# platform={platform} devices={n_devices} kind={kind!r} "
           f"spec={spec}", file=sys.stderr)
 
     if n_devices > 1:
-        res = collectives.run(size_mb=256.0, iters=10, repeats=3)
+        if platform == "tpu":
+            res = collectives.run(size_mb=256.0, iters=10, repeats=3)
+        else:  # harness proof on host devices: keep it tiny
+            res = collectives.run(size_mb=4.0, iters=2, repeats=1)
         print(f"# allreduce: {res}", file=sys.stderr)
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
-            print(json.dumps({
+            return _emit({
                 "metric": "validator_ici_allreduce_bus_bandwidth",
                 "value": round(res.bus_bw_gbps, 2), "unit": "GB/s",
-                "vs_baseline": 0.0}))
-            return 0
-        print(json.dumps({
+                "vs_baseline": 0.0}, platform, res.correct)
+        return _emit({
             "metric": "validator_ici_allreduce_fraction_of_peak",
             "value": round(value, 4), "unit": "fraction_of_ici_peak",
-            "vs_baseline": round(value / BASELINE_FRACTION, 4)}))
-        return 0
+            "vs_baseline": round(value / BASELINE_FRACTION, 4)},
+            platform, res.correct)
 
     # single chip: MXU utilization headline. Bigger squares sit closer to
     # peak (measured on v5e: 8192→0.84, 16384→0.90, 28672→0.95), so pick
     # the largest MXU-aligned size whose working set (~4 NxN bf16 buffers)
     # comfortably fits HBM.
-    if spec is None:
-        # unknown device: utilization can't be computed anyway; stay small
-        size = 8192
+    if platform != "tpu":
+        size, iters, calls = 1024, 2, 2  # harness proof only, not a number
+    elif spec is None:
+        size, iters, calls = 8192, 6, 4
     elif spec.hbm_gb >= 16:  # every known chip today (v2..v6e)
-        size = 28672
+        size, iters, calls = 28672, 6, 4
     else:
-        size = 16384
-    res = matmul.run(size=size, iters=6, calls=4, repeats=3)
+        size, iters, calls = 16384, 6, 4
+    res = matmul.run(size=size, iters=iters, calls=calls, repeats=3)
     print(f"# matmul: {res}", file=sys.stderr)
     if res.utilization is not None:
-        print(json.dumps({
+        return _emit({
             "metric": "validator_matmul_mxu_utilization",
             "value": round(res.utilization, 4),
             "unit": "fraction_of_peak_bf16",
-            "vs_baseline": round(res.utilization / BASELINE_FRACTION, 4)}))
-    else:
+            "vs_baseline": round(res.utilization / BASELINE_FRACTION, 4)},
+            platform, res.checksum_ok)
+    return _emit({
+        "metric": "validator_matmul_throughput",
+        "value": round(res.tflops, 2), "unit": "TFLOP/s",
+        "vs_baseline": 0.0}, platform, res.checksum_ok)
+
+
+# ---------------------------------------------------------------- parent
+
+def _run_child(timeout_s: float, extra_env: dict | None = None):
+    """One measurement attempt in a subprocess. Returns (json_dict|None,
+    rc, stderr_tail)."""
+    env = dict(os.environ)
+    env["TPUOP_BENCH_CHILD_TIMEOUT"] = str(timeout_s)
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    # own session so a timeout kill reaps the whole process GROUP — a
+    # hung PJRT tunnel helper left alive would keep holding the chip and
+    # poison every subsequent attempt (libtpu is single-client)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stdout, stderr = proc.communicate()
+        sys.stderr.write(stderr[-4000:])
+        return None, -1, f"TIMEOUT after {timeout_s:.0f}s\n{stderr[-2000:]}"
+    sys.stderr.write(stderr[-4000:])
+    line = None
+    for raw in stdout.splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                line = json.loads(raw)
+            except json.JSONDecodeError:
+                pass
+    return line, rc, stderr[-2000:]
+
+
+def _diagnose(note: str) -> None:
+    from tpu_operator.workloads import backend
+
+    print(f"# {note}", file=sys.stderr)
+    backend.log_holders(lambda msg: print(msg, file=sys.stderr))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true",
+                    help="internal: run the measurement in-process")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="fail (rc 1) instead of falling back to CPU")
+    ap.add_argument("--attempts", type=int, default=4)
+    ap.add_argument("--attempt-timeout", type=float, default=600.0)
+    ap.add_argument("--total-timeout", type=float, default=1800.0)
+    ap.add_argument("--backoff", type=float, default=10.0)
+    args = ap.parse_args()
+
+    if args.child:
+        return child_main()
+
+    deadline = time.monotonic() + args.total_timeout
+    delay = args.backoff
+    non_tpu_result = None  # best silent-fallback candidate, marked later
+    invalid_result = None  # TPU ran but failed its correctness check
+    min_budget = min(30.0, args.attempt_timeout)
+    for attempt in range(1, args.attempts + 1):
+        budget = min(args.attempt_timeout, deadline - time.monotonic())
+        if budget < min_budget:
+            print(f"# remaining total budget ({budget:.0f}s) below the "
+                  f"minimum attempt budget ({min_budget:.0f}s); stopping",
+                  file=sys.stderr)
+            break
+        print(f"# attempt {attempt}/{args.attempts} "
+              f"(budget {budget:.0f}s)", file=sys.stderr)
+        result, rc, tail = _run_child(budget)
+        if result is not None:
+            platform = result.pop("_platform", "unknown")
+            if rc == 0 and platform == "tpu":
+                print(json.dumps(result))
+                return 0
+            if platform == "tpu":  # ran, but the number is invalid
+                _diagnose(f"attempt {attempt}: TPU measurement failed its "
+                          f"correctness check: {result}")
+                invalid_result = result
+            elif rc == 0:
+                # JAX silently resolved a non-TPU backend; keep the number
+                # as a fallback candidate but keep trying for the chip.
+                _diagnose(f"attempt {attempt} ran on platform={platform!r},"
+                          " not tpu; retrying")
+                non_tpu_result = result
+            else:
+                _diagnose(f"attempt {attempt} failed rc={rc} on "
+                          f"platform={platform!r}")
+        else:
+            _diagnose(f"attempt {attempt} failed rc={rc}: ...{tail[-300:]!r}")
+        if attempt < args.attempts and time.monotonic() + delay < deadline:
+            print(f"# backing off {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)
+
+    if invalid_result is not None:
+        # a TPU that computes wrong results is a failure, not "unavailable"
+        # — surface the invalidated number, never a fallback
+        print(json.dumps(invalid_result))
+        return 1
+
+    if args.require_tpu:
         print(json.dumps({
-            "metric": "validator_matmul_throughput",
-            "value": round(res.tflops, 2), "unit": "TFLOP/s",
-            "vs_baseline": 0.0}))
-    return 0
+            "metric": "validator_bench_unavailable", "value": 0.0,
+            "unit": "none", "vs_baseline": 0.0}))
+        return 1
+
+    # CPU fallback: prove the harness; never report it as a TPU number.
+    if non_tpu_result is None:
+        print("# TPU unavailable; falling back to the cpu backend",
+              file=sys.stderr)
+        budget = min(300.0, max(60.0, deadline - time.monotonic()))
+        result, rc, tail = _run_child(budget, {"TPUOP_BENCH_PLATFORM": "cpu"})
+        if result is not None and rc == 0:
+            result.pop("_platform", None)
+            non_tpu_result = result
+    if non_tpu_result is not None:
+        if not non_tpu_result["metric"].endswith("_cpu_fallback"):
+            non_tpu_result["metric"] += "_cpu_fallback"
+        non_tpu_result["vs_baseline"] = 0.0
+        print(json.dumps(non_tpu_result))
+        return 0
+    print(json.dumps({
+        "metric": "validator_bench_unavailable", "value": 0.0,
+        "unit": "none", "vs_baseline": 0.0}))
+    return 1
 
 
 if __name__ == "__main__":

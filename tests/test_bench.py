@@ -1,0 +1,112 @@
+"""Bench harness + hardened backend-init tests (VERDICT round-1 item 1).
+
+The round-1 bench died inside ``jax.devices()`` and produced no JSON line;
+these tests pin the hardening contract: the parent orchestrator always
+emits exactly one JSON line, failures are retried and diagnosable, and a
+CPU fallback can never masquerade as a TPU number (vs_baseline == 0.0).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_operator.workloads import backend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(*args, env_extra=None, timeout=180):
+    env = dict(os.environ)
+    # the conftest pins tests to the cpu platform; the bench child must do
+    # the same or it would try to bring up the (absent) TPU tunnel. Drop
+    # the conftest's 8-device XLA flag so the child takes the single-chip
+    # matmul path, not an 8-way host allreduce.
+    env["TPUOP_BENCH_PLATFORM"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, BENCH, *args], capture_output=True, text=True,
+        timeout=timeout, env=env)
+
+
+def test_bench_emits_single_json_line():
+    proc = _run_bench("--attempts", "1", "--attempt-timeout", "120",
+                      "--backoff", "1")
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert set(doc) == {"metric", "value", "unit", "vs_baseline"}
+    # a run that resolved to a non-TPU platform must always be marked as
+    # a fallback with the baseline comparison zeroed — it can never pass
+    # for a TPU number
+    assert doc["metric"] == "validator_matmul_throughput_cpu_fallback"
+    assert doc["vs_baseline"] == 0.0
+    assert doc["value"] > 0
+
+
+def test_bench_child_timeout_falls_back_with_json(tmp_path):
+    # force the child to hang by pointing it at a platform that cannot
+    # initialize, with a tiny attempt budget; the parent must still emit
+    # a JSON line and exit 0 via the cpu fallback
+    proc = _run_bench(
+        "--attempts", "1", "--attempt-timeout", "35", "--backoff", "1",
+        env_extra={"TPUOP_BENCH_PLATFORM": "",  # let plugin resolution run
+                   "JAX_PLATFORMS": "tpu"})     # no real TPU in tests
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stderr[-500:]
+    doc = json.loads(lines[0])
+    if proc.returncode == 0:
+        assert doc["metric"].endswith("_cpu_fallback")
+        assert doc["vs_baseline"] == 0.0
+    else:
+        assert doc["metric"] == "validator_bench_unavailable"
+
+
+def test_bench_require_tpu_fails_closed():
+    proc = _run_bench(
+        "--require-tpu", "--attempts", "1", "--attempt-timeout", "35",
+        env_extra={"TPUOP_BENCH_PLATFORM": "", "JAX_PLATFORMS": "tpu"})
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "validator_bench_unavailable"
+    assert doc["value"] == 0.0
+
+
+def test_init_devices_pins_platform():
+    devices = backend.init_devices(attempts=1, platform="cpu")
+    assert devices and devices[0].platform == "cpu"
+
+
+def test_init_devices_retries_then_raises(monkeypatch):
+    calls = []
+
+    class Boom(RuntimeError):
+        pass
+
+    import jax
+
+    def fake_devices():
+        calls.append(1)
+        raise Boom("UNAVAILABLE: synthetic")
+
+    monkeypatch.setattr(jax, "devices", fake_devices)
+    logs = []
+    with pytest.raises(Boom):
+        backend.init_devices(attempts=3, backoff_s=0.01, log=logs.append)
+    assert len(calls) == 3
+    assert any("attempt 3/3" in l for l in logs)
+
+
+def test_diagnose_holders_runs_and_excludes_self():
+    holders = backend.diagnose_holders()
+    assert isinstance(holders, list)
+    assert os.getpid() not in [h.pid for h in holders]
+
+
+def test_describe_environment_mentions_device_nodes():
+    assert "device_nodes=" in backend.describe_environment()

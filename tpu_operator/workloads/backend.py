@@ -1,0 +1,159 @@
+"""Hardened JAX backend bring-up for validator workloads and the bench.
+
+libtpu is single-client: a second process touching the chip gets
+``UNAVAILABLE: TPU backend setup/compile error``. The reference's validator
+retries its proofs on a 5 s cadence until the layer below is actually ready
+(validator/main.go:139-180); this module gives the TPU workloads the same
+discipline for backend *initialization*:
+
+- ``init_devices()`` — call ``jax.devices()`` with bounded retries and
+  exponential backoff, clearing JAX's cached backend-failure state between
+  attempts so a retry is a real retry.
+- ``diagnose_holders()`` — best-effort report of which processes hold the
+  TPU device nodes (``/dev/accel*``, ``/dev/vfio*``) or the libtpu
+  single-client lockfile, so an UNAVAILABLE failure is attributable.
+
+No k8s dependencies: this runs inside validator pods and on bare hosts.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+_DEVICE_GLOBS = ("/dev/accel*", "/dev/vfio/*", "/dev/tpu*")
+_LOCKFILES = ("/tmp/libtpu_lockfile",)
+
+
+@dataclass
+class HolderInfo:
+    pid: int
+    cmdline: str
+    paths: List[str] = field(default_factory=list)
+
+
+def _read_cmdline(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            raw = f.read().replace(b"\x00", b" ").decode("utf-8", "replace")
+        return raw.strip()[:200] or "?"
+    except OSError:
+        return "?"
+
+
+def diagnose_holders() -> List[HolderInfo]:
+    """Scan /proc/*/fd for open handles on TPU device nodes / lockfiles.
+
+    Returns holders other than the current process. Needs no root when the
+    scanner and the holder run as the same user (both true in the validator
+    pod and on the bench host); silently skips pids it cannot inspect.
+    """
+    targets = set()
+    for pattern in _DEVICE_GLOBS:
+        targets.update(glob.glob(pattern))
+    targets.update(p for p in _LOCKFILES if os.path.exists(p))
+    if not targets:
+        return []
+    me = os.getpid()
+    holders = {}
+    for proc in glob.glob("/proc/[0-9]*"):
+        try:
+            pid = int(proc.rsplit("/", 1)[1])
+        except ValueError:
+            continue
+        if pid == me:
+            continue
+        hits = []
+        try:
+            for fd in os.listdir(f"{proc}/fd"):
+                try:
+                    dest = os.readlink(f"{proc}/fd/{fd}")
+                except OSError:
+                    continue
+                if dest in targets:
+                    hits.append(dest)
+        except OSError:
+            continue
+        if hits:
+            holders[pid] = HolderInfo(pid, _read_cmdline(pid), sorted(set(hits)))
+    return [holders[p] for p in sorted(holders)]
+
+
+def describe_environment() -> str:
+    """One-line summary of the TPU-relevant environment for diagnostics."""
+    bits = []
+    for var in ("JAX_PLATFORMS", "TPU_SKIP_MDS_QUERY", "TPU_PROCESS_BOUNDS",
+                "TPU_CHIPS_PER_PROCESS_BOUNDS", "TPU_VISIBLE_DEVICES"):
+        if os.environ.get(var):
+            bits.append(f"{var}={os.environ[var]}")
+    devs = [d for pat in _DEVICE_GLOBS for d in glob.glob(pat)]
+    bits.append(f"device_nodes={devs or 'none'}")
+    return " ".join(bits)
+
+
+def log_holders(log) -> None:
+    """Report chip holders (or the absence of any) through ``log``."""
+    holders = diagnose_holders()
+    for h in holders:
+        log(f"#   chip held by pid={h.pid} ({h.cmdline}) via {h.paths}")
+    if not holders:
+        log(f"#   no local holder found; env: {describe_environment()}")
+
+
+def _clear_backend_cache() -> None:
+    """Drop JAX's cached backend state so the next jax.devices() retries
+    initialization instead of replaying a cached failure."""
+    try:
+        import jax.extend  # not pulled in by bare `import jax`
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        try:
+            from jax._src import xla_bridge
+
+            xla_bridge._clear_backends()
+        except Exception:
+            pass
+
+
+def init_devices(attempts: int = 3, backoff_s: float = 5.0,
+                 platform: Optional[str] = None, log=None) -> "list":
+    """jax.devices() with retry/backoff on backend-init failure.
+
+    ``platform`` pins the backend via ``jax.config`` — required rather than
+    the JAX_PLATFORMS env var because out-of-tree PJRT plugins (e.g. the
+    tunneled remote-TPU plugin in this image) can override the env var at
+    import time; only jax.config wins over a plugin.
+
+    Raises the final exception (annotated with holder diagnostics) if every
+    attempt fails. ``log`` is a callable for diagnostic lines (defaults to
+    stderr).
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if log is None:
+        def log(msg):
+            print(msg, file=sys.stderr)
+
+    delay = backoff_s
+    last_exc: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return jax.devices()
+        except Exception as exc:  # RuntimeError / JaxRuntimeError
+            last_exc = exc
+            log(f"# backend init attempt {attempt}/{attempts} failed: "
+                f"{type(exc).__name__}: {str(exc)[:200]}")
+            log_holders(log)
+            if attempt < attempts:
+                time.sleep(delay)
+                delay = min(delay * 2, 60.0)
+                _clear_backend_cache()
+    assert last_exc is not None
+    raise last_exc
