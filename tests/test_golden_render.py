@@ -36,6 +36,9 @@ PERMUTATIONS = {
         "metricsExporter": {"serviceMonitor": True,
                             "collectionIntervalSeconds": 30, "port": 9999},
     },
+    "operator-servicemonitor-on": {
+        "operator": {"serviceMonitor": True},
+    },
     "validator-tuned": {
         "validator": {"matmulSize": 16384, "iciBandwidthThreshold": 0.9},
         "tpuRuntime": {"enabled": False},

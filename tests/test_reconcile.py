@@ -221,3 +221,120 @@ class TestReconcile:
             assert got["status"]["state"] == "ready"
         finally:
             mgr.stop()
+
+
+class TestRound2Fixes:
+    """VERDICT round-1 items 5 (PSA), 8 (detect_runtime), 10 (upgrade
+    annotation): namespace security labeling, TPU-node-only runtime
+    detection, and per-node auto-upgrade opt-in stamping."""
+
+    def test_psa_enabled_labels_namespace(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={"psa": {"enabled": True}}))
+        ClusterPolicyReconciler(client=c, namespace="tpu-operator").reconcile(
+            Request(name="tpu-cluster-policy"))
+        ns = c.get("v1", "Namespace", "tpu-operator")
+        for mode in L.PSA_MODES:
+            assert ns["metadata"]["labels"][
+                L.PSA_LABEL_PREFIX + mode] == L.PSA_LEVEL_PRIVILEGED
+
+    def test_psa_disabled_leaves_namespace_alone(self):
+        c = make_cluster()
+        c.create(new_cluster_policy())
+        ClusterPolicyReconciler(client=c, namespace="tpu-operator").reconcile(
+            Request(name="tpu-cluster-policy"))
+        ns = c.get_or_none("v1", "Namespace", "tpu-operator")
+        if ns is not None:
+            assert L.PSA_LABEL_PREFIX + "enforce" not in (
+                ns["metadata"].get("labels") or {})
+
+    def test_detect_runtime_ignores_non_tpu_nodes(self):
+        c = FakeClient()
+        c.add_node("cpu-0", runtime="docker://24.0")
+        c.add_node("tpu-0", labels=dict(V5P_LABELS),
+                   allocatable={"google.com/tpu": "4"},
+                   runtime="containerd://1.7.0")
+        sm = StateManager(client=c, namespace="tpu-operator")
+        assert sm.detect_runtime() == "containerd"
+
+    def test_detect_runtime_mixed_tpu_nodes_majority(self):
+        c = FakeClient()
+        for i in range(2):
+            c.add_node(f"tpu-a{i}", labels=dict(V5P_LABELS),
+                       allocatable={"google.com/tpu": "4"},
+                       runtime="containerd://1.7.0")
+        c.add_node("tpu-b0", labels=dict(V5P_LABELS),
+                   allocatable={"google.com/tpu": "4"},
+                   runtime="cri-o://1.28")
+        sm = StateManager(client=c, namespace="tpu-operator")
+        assert sm.detect_runtime() == "containerd"
+
+    def test_detect_runtime_no_tpu_nodes_falls_back(self):
+        c = FakeClient()
+        c.add_node("cpu-0", runtime="docker://24.0")
+        sm = StateManager(client=c, namespace="tpu-operator")
+        assert sm.detect_runtime() == "docker"
+
+    def test_upgrade_annotation_stamped_on_tpu_nodes(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={
+            "upgradePolicy": {"autoUpgrade": True}}))
+        ClusterPolicyReconciler(client=c, namespace="tpu-operator").reconcile(
+            Request(name="tpu-cluster-policy"))
+        tpu = c.get("v1", "Node", "tpu-0")
+        assert tpu["metadata"]["annotations"][
+            L.DRIVER_UPGRADE_ENABLED] == "true"
+        cpu = c.get("v1", "Node", "cpu-0")
+        assert L.DRIVER_UPGRADE_ENABLED not in (
+            cpu["metadata"].get("annotations") or {})
+
+    def test_upgrade_annotation_removed_when_disabled(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={
+            "upgradePolicy": {"autoUpgrade": True}}))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"] = {"autoUpgrade": False}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        tpu = c.get("v1", "Node", "tpu-0")
+        assert L.DRIVER_UPGRADE_ENABLED not in (
+            tpu["metadata"].get("annotations") or {})
+
+    def test_upgrade_annotation_suppressed_under_sandbox(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={
+            "upgradePolicy": {"autoUpgrade": True},
+            "sandboxWorkloads": {"enabled": True}}))
+        ClusterPolicyReconciler(client=c, namespace="tpu-operator").reconcile(
+            Request(name="tpu-cluster-policy"))
+        tpu = c.get("v1", "Node", "tpu-0")
+        assert L.DRIVER_UPGRADE_ENABLED not in (
+            tpu["metadata"].get("annotations") or {})
+
+    def test_psa_enable_then_disable_strips_labels(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={"psa": {"enabled": True}}))
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["psa"] = {"enabled": False}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        ns = c.get("v1", "Namespace", "tpu-operator")
+        for mode in L.PSA_MODES:
+            assert L.PSA_LABEL_PREFIX + mode not in (
+                ns["metadata"].get("labels") or {})
+
+    def test_psa_disable_preserves_admin_levels(self):
+        c = make_cluster()
+        c.create({"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "tpu-operator", "labels": {
+                      L.PSA_LABEL_PREFIX + "enforce": "baseline"}}})
+        c.create(new_cluster_policy())
+        ClusterPolicyReconciler(client=c, namespace="tpu-operator").reconcile(
+            Request(name="tpu-cluster-policy"))
+        ns = c.get("v1", "Namespace", "tpu-operator")
+        assert ns["metadata"]["labels"][
+            L.PSA_LABEL_PREFIX + "enforce"] == "baseline"

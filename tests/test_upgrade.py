@@ -165,3 +165,95 @@ class TestUpgradeFSM:
         # and all driver pods are on the new revision + nodes schedulable
         for node in c.list("v1", "Node"):
             assert not get_nested(node, "spec", "unschedulable", default=False)
+
+
+class TestPerNodeUpgradeOptOut:
+    """VERDICT round-1 item 10: the driver-upgrade-enabled annotation lets
+    an operator pause a single node's rollout without CR spec surgery."""
+
+    def test_annotation_pause_excludes_node(self):
+        c, prec = build_converged_cluster(n_nodes=2)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "false"}}})
+        change_driver_spec(c, prec)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        for _ in range(8):
+            rec.reconcile(Request(name="tpu-cluster-policy"))
+            c.simulate_kubelet(ready=True)
+        # paused node never entered the FSM; the other converged
+        assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))
+        assert labels_of(c.get("v1", "Node", "tpu-1")).get(
+            L.UPGRADE_STATE) == STATE_DONE
+
+    def test_pause_mid_rollout_strips_fsm_label(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        change_driver_spec(c, prec)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert labels_of(c.get("v1", "Node", "tpu-0")).get(L.UPGRADE_STATE)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "paused"}}})
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))
+
+    def test_cr_annotation_pauses_whole_rollout(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        change_driver_spec(c, prec)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr.setdefault("metadata", {}).setdefault("annotations", {})[
+            L.DRIVER_UPGRADE_ENABLED] = "false"
+        c.update(cr)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))
+
+    def test_node_pause_survives_policy_reconcile(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "false"}}})
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["annotations"][
+            L.DRIVER_UPGRADE_ENABLED] == "false"
+
+    def test_pause_mid_rollout_uncordons(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        change_driver_spec(c, prec)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node).get(L.UPGRADE_STATE)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "paused"}}})
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert L.UPGRADE_STATE not in labels_of(node)
+        assert not get_nested(node, "spec", "unschedulable", default=False)
+
+    def test_node_pause_survives_global_disable_cycle(self):
+        c, prec = build_converged_cluster(n_nodes=2)
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "false"}}})
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"] = {"autoUpgrade": False}
+        c.update(cr)
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        # reconciler-stamped "true" unwound; explicit pause preserved
+        anns0 = c.get("v1", "Node", "tpu-0")["metadata"].get(
+            "annotations") or {}
+        anns1 = c.get("v1", "Node", "tpu-1")["metadata"].get(
+            "annotations") or {}
+        assert anns0.get(L.DRIVER_UPGRADE_ENABLED) == "false"
+        assert L.DRIVER_UPGRADE_ENABLED not in anns1
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"] = {"autoUpgrade": True}
+        c.update(cr)
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        anns0 = c.get("v1", "Node", "tpu-0")["metadata"].get(
+            "annotations") or {}
+        assert anns0.get(L.DRIVER_UPGRADE_ENABLED) == "false"

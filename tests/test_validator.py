@@ -276,3 +276,41 @@ class TestValidatorCLI:
         main(["-c", "driver"])
         assert main(["cleanup"]) == 0
         assert not barrier.is_ready("driver-ready")
+
+
+class TestDeviceNodeProof:
+    """VERDICT round-1 item 9: the runtime proof must open the device node
+    and check its character-device type, not just permission bits."""
+
+    def test_regular_file_is_not_a_device(self, tmp_path):
+        from tpu_operator.validator.components import device_node_error
+        fake = tmp_path / "accel0"
+        fake.write_bytes(b"")
+        err = device_node_error(str(fake))
+        assert err and "not a character device" in err
+
+    def test_missing_node_reports_stat_failure(self, tmp_path):
+        from tpu_operator.validator.components import device_node_error
+        err = device_node_error(str(tmp_path / "accel9"))
+        assert err and "stat failed" in err
+
+    def test_char_device_opens(self):
+        from tpu_operator.validator.components import device_node_error
+        assert device_node_error("/dev/null") is None
+
+    def test_unreadable_char_device_fails(self, tmp_path):
+        import os as _os
+        import stat as _stat
+        from tpu_operator.validator.components import device_node_error
+        try:
+            dev = tmp_path / "accel1"
+            _os.mknod(str(dev), 0o000 | _stat.S_IFCHR, _os.makedev(1, 3))
+        except PermissionError:
+            import pytest as _pytest
+            _pytest.skip("mknod needs CAP_MKNOD")
+        err = device_node_error(str(dev))
+        # root bypasses permission bits; accept either outcome by mode
+        if _os.geteuid() == 0:
+            assert err is None
+        else:
+            assert err and "open" in err

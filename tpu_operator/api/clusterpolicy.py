@@ -58,6 +58,27 @@ class OperatorSpec:
     init_container: Optional[ComponentSpec] = None
     labels: Optional[Dict[str, str]] = None
     annotations: Optional[Dict[str, str]] = None
+    service_monitor: Optional[bool] = field(
+        default=False,
+        description="Deploy a ServiceMonitor + PrometheusRule for the "
+                    "operator's own metrics (requires prometheus-operator "
+                    "CRDs; assets/state-operator-metrics/0300 analog)")
+    service_monitor_interval_seconds: Optional[int] = field(
+        default=30, description="Operator metrics scrape interval")
+
+
+@dataclass
+class PSASpec:
+    """Pod Security Admission opt-in (PSASpec analog,
+    clusterpolicy_types.go:208-212): when enabled the reconciler stamps
+    pod-security.kubernetes.io/{enforce,audit,warn}=privileged on the
+    operand namespace so privileged driver/validator pods admit."""
+
+    enabled: Optional[bool] = field(
+        default=False, description="Label the operand namespace for PSA")
+
+    def is_enabled(self, default: bool = False) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
 
 
 @dataclass
@@ -283,6 +304,7 @@ class TPUClusterPolicySpec:
     upgrade_policy: Optional[DriverUpgradePolicySpec] = field(
         default_factory=DriverUpgradePolicySpec)
     host_paths: Optional[HostPathsSpec] = field(default_factory=HostPathsSpec)
+    psa: Optional[PSASpec] = field(default_factory=PSASpec)
 
     @classmethod
     def from_obj(cls, cr: dict) -> "TPUClusterPolicySpec":
@@ -306,7 +328,8 @@ class TPUClusterPolicySpec:
                                  IsolatedDevicePluginSpec),
                                 ("validator", ValidatorSpec),
                                 ("upgrade_policy", DriverUpgradePolicySpec),
-                                ("host_paths", HostPathsSpec)):
+                                ("host_paths", HostPathsSpec),
+                                ("psa", PSASpec)):
             if getattr(spec, f_name) is None:
                 setattr(spec, f_name, factory())
         return spec

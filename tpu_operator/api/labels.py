@@ -43,6 +43,19 @@ UPGRADE_SKIP_DRAIN = f"{DOMAIN}/upgrade.skip-drain"
 # --- annotations ----------------------------------------------------------
 LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog
 STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
+# per-node driver auto-upgrade opt-in, stamped by the policy reconciler and
+# honored by the upgrade controller; operators can delete/override it on a
+# node to exclude that node from rollouts without touching the CR spec
+# (driverAutoUpgradeAnnotationKey analog, state_manager.go:423-477)
+DRIVER_UPGRADE_ENABLED = f"{DOMAIN}/driver-upgrade-enabled"
+
+# --- Pod Security Admission (namespace labels) ----------------------------
+# stamped on the operand namespace so privileged operand pods admit under
+# PSA-enforcing clusters (setPodSecurityLabelsForNamespace analog,
+# state_manager.go:600-648)
+PSA_LABEL_PREFIX = "pod-security.kubernetes.io/"
+PSA_MODES = ("enforce", "audit", "warn")
+PSA_LEVEL_PRIVILEGED = "privileged"
 
 # --- extended resources ---------------------------------------------------
 TPU_RESOURCE = "google.com/tpu"

@@ -97,6 +97,10 @@ class ClusterPolicyReconciler(Reconciler):
 
         spec = TPUClusterPolicySpec.from_obj(cr)
 
+        # PSA labels must land before any privileged operand pod is created
+        # (state_manager.go:846-854 ordering); disable strips them again
+        self.state_manager.ensure_namespace_psa(spec.psa.is_enabled())
+
         # defaultWorkload only routes unlabeled nodes when the sandbox
         # plane is on (reference: getWorkloadConfig falls back to
         # defaultGPUWorkloadConfig only under sandboxWorkloads.enabled)
@@ -105,6 +109,11 @@ class ClusterPolicyReconciler(Reconciler):
             if sandbox.is_enabled() else "container"
         tpu_nodes = self.state_manager.label_tpu_nodes(
             default_workload, sandbox_enabled=sandbox.is_enabled())
+        # per-node upgrade opt-in rides the same node pass (reference gates
+        # it off under the sandbox plane, state_manager.go:442-444)
+        self.state_manager.apply_driver_upgrade_annotation(
+            bool(spec.upgrade_policy.auto_upgrade)
+            and not sandbox.is_enabled())
         OPERATOR_METRICS.tpu_nodes.set(tpu_nodes)
         if tpu_nodes == 0:
             self._set_state(cr, STATE_NOT_READY)

@@ -113,14 +113,92 @@ class StateManager:
         return count
 
     def detect_runtime(self) -> str:
-        """containerd/docker/cri-o from node status (getRuntime analog,
-        state_manager.go:714-751)."""
+        """Container runtime from TPU-node status only (getRuntime analog,
+        state_manager.go:714-751 — the reference records the runtime from
+        GPU nodes specifically). Mixed runtimes across TPU nodes are
+        surfaced with a warning and resolved by majority; non-TPU nodes
+        only serve as a fallback when no TPU node reports one."""
+        counts: Dict[str, int] = {}
+        fallback = ""
         for node in self.client.list("v1", "Node"):
             rt = get_nested(node, "status", "nodeInfo",
                             "containerRuntimeVersion", default="")
-            if rt:
-                return rt.split(":")[0]
-        return "containerd"
+            if not rt:
+                continue
+            name = rt.split(":")[0]
+            if is_tpu_node(node):
+                counts[name] = counts.get(name, 0) + 1
+            elif not fallback:
+                fallback = name
+        if not counts:
+            return fallback or "containerd"
+        if len(counts) > 1:
+            log.warning("mixed container runtimes across TPU nodes: %s; "
+                        "using the majority runtime", counts)
+        # majority wins; name breaks ties deterministically
+        return max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+    def ensure_namespace_psa(self, enabled: bool) -> None:
+        """Stamp pod-security.kubernetes.io/{enforce,audit,warn}=privileged
+        on the operand namespace so privileged operand pods (driver
+        installer, validator, device plugin) admit on PSA-enforcing
+        clusters (setPodSecurityLabelsForNamespace analog,
+        state_manager.go:600-648). Disabling strips exactly the
+        "privileged" values this reconciler stamps — a cluster admin's own
+        different PSA levels are never touched."""
+        ns = self.client.get_or_none("v1", "Namespace", self.namespace)
+        if ns is None:
+            if not enabled:
+                return
+            self.client.create({
+                "apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": self.namespace}})
+            ns = {"metadata": {"name": self.namespace}}
+        have = labels_of(ns)
+        if enabled:
+            delta = {L.PSA_LABEL_PREFIX + mode: L.PSA_LEVEL_PRIVILEGED
+                     for mode in L.PSA_MODES
+                     if have.get(L.PSA_LABEL_PREFIX + mode)
+                     != L.PSA_LEVEL_PRIVILEGED}
+        else:
+            delta = {L.PSA_LABEL_PREFIX + mode: None for mode in L.PSA_MODES
+                     if have.get(L.PSA_LABEL_PREFIX + mode)
+                     == L.PSA_LEVEL_PRIVILEGED}
+        if delta:
+            self.client.patch("v1", "Namespace", self.namespace,
+                              {"metadata": {"labels": delta}})
+            log.info("pod security admission labels on namespace %s: %s",
+                     self.namespace, delta)
+
+    def apply_driver_upgrade_annotation(self, enabled: bool) -> None:
+        """Stamp (or strip) the per-node driver auto-upgrade opt-in
+        annotation on TPU nodes (applyDriverAutoUpgradeAnnotation analog,
+        state_manager.go:423-477). The upgrade controller only touches
+        annotated nodes, so deleting the annotation from one node excludes
+        it from rollouts without CR spec surgery."""
+        for node in self.client.list("v1", "Node"):
+            if not is_tpu_node(node):
+                continue
+            anns = get_nested(node, "metadata", "annotations",
+                              default={}) or {}
+            have = anns.get(L.DRIVER_UPGRADE_ENABLED)
+            if enabled and have is None:
+                # only fill in the absent default — an explicit non-"true"
+                # value is an operator's per-node pause and must survive
+                # reconciles (unlike the reference, which force-overwrites
+                # and so offers no node-level pause)
+                patch_val = "true"
+            elif not enabled and have == "true":
+                # only unwind the value this reconciler stamped; an
+                # operator's explicit per-node pause ("false"/"paused")
+                # survives a global disable→re-enable cycle
+                patch_val = None  # merge-patch null deletes the key
+            else:
+                continue
+            self.client.patch(
+                "v1", "Node", name_of(node),
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: patch_val}}})
 
     def sync(self, policy: dict, spec: TPUClusterPolicySpec,
              extra: Optional[dict] = None) -> Dict[str, SyncResult]:

@@ -182,12 +182,22 @@ class UpgradeReconciler(Reconciler):
         self.client.patch("v1", "Node", name_of(node),
                           {"spec": {"unschedulable": True if on else None}})
 
+    def _release_node(self, node: dict) -> None:
+        """Strip a node's FSM label and undo any cordon the FSM applied —
+        a node paused mid-rollout (after STATE_CORDON, before
+        STATE_UNCORDON) must not be left unschedulable forever."""
+        state = labels_of(node).get(L.UPGRADE_STATE)
+        if state in IN_PROGRESS_STATES and get_nested(
+                node, "spec", "unschedulable", default=False):
+            self._cordon(node, False)
+        self._set_node_state(node, None)
+
     def remove_upgrade_state_labels(self) -> None:
-        """Auto-upgrade disabled: strip FSM labels
+        """Auto-upgrade disabled: strip FSM labels (+ leftover cordons)
         (removeNodeUpgradeStateLabels analog, upgrade_controller.go:103-121)."""
         for node in self.client.list("v1", "Node"):
             if L.UPGRADE_STATE in labels_of(node):
-                self._set_node_state(node, None)
+                self._release_node(node)
 
     # -- reconcile ---------------------------------------------------------
 
@@ -197,7 +207,13 @@ class UpgradeReconciler(Reconciler):
             return Result()
         spec = TPUClusterPolicySpec.from_obj(cr)
         policy = spec.upgrade_policy
-        if not policy.auto_upgrade:
+        # CR-level pause without spec surgery: annotating the policy CR
+        # with tpu.graft.dev/driver-upgrade-enabled != "true" halts the
+        # rollout exactly like autoUpgrade: false
+        cr_gate = (get_nested(cr, "metadata", "annotations",
+                              default={}) or {}).get(L.DRIVER_UPGRADE_ENABLED)
+        if not policy.auto_upgrade or (cr_gate is not None
+                                       and cr_gate != "true"):
             self.remove_upgrade_state_labels()
             return Result()
 
@@ -219,6 +235,20 @@ class UpgradeReconciler(Reconciler):
         validator_gate_deployed = self._validator_ds_exists()
 
         for node_name, node in sorted(nodes.items()):
+            # per-node pause: the policy reconciler stamps this annotation
+            # "true" on TPU nodes while autoUpgrade is on; an operator
+            # setting it to anything else on a node excludes that node
+            # from the rollout without touching the CR
+            # (driverAutoUpgradeAnnotationKey contract,
+            # state_manager.go:423-477). Absent = eligible, so the
+            # controller also works driven standalone.
+            anns = get_nested(node, "metadata", "annotations",
+                              default={}) or {}
+            optin = anns.get(L.DRIVER_UPGRADE_ENABLED)
+            if optin is not None and optin != "true":
+                if labels_of(node).get(L.UPGRADE_STATE):
+                    self._release_node(node)
+                continue
             pod = self._driver_pod_on(node_name)
             if pod is None:
                 continue

@@ -155,6 +155,8 @@ def _prerequisites_data(ctx: SyncContext) -> dict:
 def _operator_metrics_data(ctx: SyncContext) -> dict:
     data = common_data(ctx, None, "operator-metrics", "tpu-operator")
     data["MetricsPort"] = 8080
+    data["ServiceMonitor"] = bool(ctx.spec.operator.service_monitor)
+    data["Interval"] = ctx.spec.operator.service_monitor_interval_seconds or 30
     return data
 
 

@@ -125,6 +125,35 @@ def validate_driver() -> Dict[str, str]:
     return info
 
 
+def device_node_error(path: str) -> Optional[str]:
+    """Real device-node proof: a TPU device node must be a *character
+    device* that opens O_RDWR — permission-bit checks (os.access) pass a
+    present-but-broken node, e.g. a regular file left behind by a failed
+    driver install or a node with the wrong type/mode. Returns None when
+    healthy, else the reason."""
+    import stat as _stat
+
+    try:
+        st = os.stat(path)
+    except OSError as e:
+        return f"{path}: stat failed ({e.strerror})"
+    if not _stat.S_ISCHR(st.st_mode):
+        return f"{path}: not a character device (mode {oct(st.st_mode)})"
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError as e:
+        import errno as _errno
+
+        if e.errno == _errno.EBUSY:
+            # exclusively held by a running workload — the device is
+            # demonstrably alive; failing validation here would wedge
+            # re-proofs on busy-but-healthy nodes
+            return None
+        return f"{path}: open(O_RDWR) failed ({e.strerror})"
+    os.close(fd)
+    return None
+
+
 def validate_runtime() -> Dict[str, str]:
     if not barrier.is_ready("driver-ready"):
         if os.environ.get("WITH_WAIT", "").lower() == "true":
@@ -133,11 +162,10 @@ def validate_runtime() -> Dict[str, str]:
         else:
             raise ValidationFailed("driver-ready gate not passed")
     chips = discover_chips()
-    inaccessible = [d for d in chips.get("devices", [])
-                    if d.startswith("/dev/") and not os.access(d, os.R_OK)]
-    if chips["count"] and inaccessible and chips["source"] != "fake":
-        raise ValidationFailed(
-            f"device nodes not accessible: {inaccessible}")
+    broken = [err for d in chips.get("devices", [])
+              if d.startswith("/dev/") and (err := device_node_error(d))]
+    if chips["count"] and broken and chips["source"] != "fake":
+        raise ValidationFailed(f"device nodes not usable: {broken}")
     info = {"DEVICE_COUNT": str(chips["count"])}
     barrier.write_status("runtime-ready", info)
     return info
@@ -171,7 +199,9 @@ def validate_jax(matmul_size: Optional[int] = None,
         raise ValidationFailed("matmul produced non-finite values")
     info = {
         "MATMUL_SIZE": str(size),
-        "TFLOPS": f"{res.tflops:.2f}",
+        # 4 significant digits, not fixed-point: a tiny proof matmul on a
+        # slow host must not round to "0.00"
+        "TFLOPS": f"{res.tflops:.4g}",
         "DEVICE_KIND": res.device_kind,
     }
     if res.utilization is not None:
