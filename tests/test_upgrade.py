@@ -257,3 +257,13 @@ class TestPerNodeUpgradeOptOut:
         anns0 = c.get("v1", "Node", "tpu-0")["metadata"].get(
             "annotations") or {}
         assert anns0.get(L.DRIVER_UPGRADE_ENABLED) == "false"
+
+    def test_sandbox_plane_halts_rollout(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        change_driver_spec(c, prec)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["sandboxWorkloads"] = {"enabled": True}
+        c.update(cr)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))

@@ -43,9 +43,11 @@ UPGRADE_SKIP_DRAIN = f"{DOMAIN}/upgrade.skip-drain"
 # --- annotations ----------------------------------------------------------
 LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog
 STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
-# per-node driver auto-upgrade opt-in, stamped by the policy reconciler and
-# honored by the upgrade controller; operators can delete/override it on a
-# node to exclude that node from rollouts without touching the CR spec
+# per-node driver auto-upgrade opt-in, stamped "true" by the policy
+# reconciler; SET it to any other value ("false", "paused") on a node to
+# exclude that node from rollouts without touching the CR spec — the
+# explicit value survives reconciles (deleting it does not: the stamp
+# returns). The same annotation on the policy CR pauses the whole rollout.
 # (driverAutoUpgradeAnnotationKey analog, state_manager.go:423-477)
 DRIVER_UPGRADE_ENABLED = f"{DOMAIN}/driver-upgrade-enabled"
 

@@ -107,12 +107,11 @@ class ClusterPolicyReconciler(Reconciler):
         sandbox = spec.sandbox_workloads
         default_workload = (sandbox.default_workload or "container") \
             if sandbox.is_enabled() else "container"
+        # per-node upgrade opt-in rides the same node pass/patch (reference
+        # gates it off under the sandbox plane, state_manager.go:442-444)
         tpu_nodes = self.state_manager.label_tpu_nodes(
-            default_workload, sandbox_enabled=sandbox.is_enabled())
-        # per-node upgrade opt-in rides the same node pass (reference gates
-        # it off under the sandbox plane, state_manager.go:442-444)
-        self.state_manager.apply_driver_upgrade_annotation(
-            bool(spec.upgrade_policy.auto_upgrade)
+            default_workload, sandbox_enabled=sandbox.is_enabled(),
+            upgrade_annotation=bool(spec.upgrade_policy.auto_upgrade)
             and not sandbox.is_enabled())
         OPERATOR_METRICS.tpu_nodes.set(tpu_nodes)
         if tpu_nodes == 0:

@@ -88,6 +88,24 @@ def desired_node_labels(node: dict,
     return out
 
 
+def _upgrade_annotation_delta(node: dict, enabled: bool) -> Dict[str, Optional[str]]:
+    """Annotation merge-patch for one TPU node's auto-upgrade opt-in.
+
+    Enabled fills in only an ABSENT annotation ("true"); an operator's
+    explicit non-"true" value is a per-node pause and must survive
+    reconciles (unlike the reference, which force-overwrites and so offers
+    no node-level pause). Disabled unwinds only the "true" this reconciler
+    stamps, so an explicit pause also survives a global disable→re-enable
+    cycle."""
+    anns = get_nested(node, "metadata", "annotations", default={}) or {}
+    have = anns.get(L.DRIVER_UPGRADE_ENABLED)
+    if enabled and have is None:
+        return {L.DRIVER_UPGRADE_ENABLED: "true"}
+    if not enabled and have == "true":
+        return {L.DRIVER_UPGRADE_ENABLED: None}  # merge-patch null deletes
+    return {}
+
+
 @dataclass
 class StateManager:
     client: Client
@@ -95,21 +113,31 @@ class StateManager:
     states: List[State] = field(default_factory=build_states)
 
     def label_tpu_nodes(self, default_config: str = "container",
-                        sandbox_enabled: bool = True) -> int:
+                        sandbox_enabled: bool = True,
+                        upgrade_annotation: Optional[bool] = None) -> int:
         """Stamp discovery + deploy labels on every node; returns the TPU
-        node count (labelGPUNodes analog — one LIST + patches only for
-        drifted nodes)."""
+        node count (labelGPUNodes analog — one LIST + at most one patch
+        per drifted node). When ``upgrade_annotation`` is set, the driver
+        auto-upgrade annotation rides the same pass/patch
+        (applyDriverAutoUpgradeAnnotation analog, state_manager.go:423-477,
+        without the reference's second node LIST)."""
         count = 0
         for node in self.client.list("v1", "Node"):
+            tpu = is_tpu_node(node)
             want = desired_node_labels(node, default_config, sandbox_enabled)
-            if is_tpu_node(node):
+            if tpu:
                 count += 1
-            have = labels_of(node)
-            delta = label_delta(have, want)
+            body: dict = {}
+            delta = label_delta(labels_of(node), want)
             if delta:
-                self.client.patch("v1", "Node", name_of(node),
-                                  {"metadata": {"labels": delta}})
-                log.info("labeled node %s: %s", name_of(node), delta)
+                body = {"metadata": {"labels": delta}}
+            if upgrade_annotation is not None and tpu:
+                ann = _upgrade_annotation_delta(node, upgrade_annotation)
+                if ann:
+                    body.setdefault("metadata", {})["annotations"] = ann
+            if body:
+                self.client.patch("v1", "Node", name_of(node), body)
+                log.info("updated node %s: %s", name_of(node), body)
         return count
 
     def detect_runtime(self) -> str:
@@ -171,34 +199,20 @@ class StateManager:
                      self.namespace, delta)
 
     def apply_driver_upgrade_annotation(self, enabled: bool) -> None:
-        """Stamp (or strip) the per-node driver auto-upgrade opt-in
-        annotation on TPU nodes (applyDriverAutoUpgradeAnnotation analog,
-        state_manager.go:423-477). The upgrade controller only touches
-        annotated nodes, so deleting the annotation from one node excludes
-        it from rollouts without CR spec surgery."""
+        """Standalone pass stamping the per-node driver auto-upgrade
+        annotation on TPU nodes; the reconciler folds this into
+        label_tpu_nodes' single node pass instead
+        (applyDriverAutoUpgradeAnnotation analog,
+        state_manager.go:423-477). To exclude one node from rollouts, SET
+        the annotation to a non-"true" value — explicit values survive
+        reconciles; a deleted annotation gets re-stamped."""
         for node in self.client.list("v1", "Node"):
             if not is_tpu_node(node):
                 continue
-            anns = get_nested(node, "metadata", "annotations",
-                              default={}) or {}
-            have = anns.get(L.DRIVER_UPGRADE_ENABLED)
-            if enabled and have is None:
-                # only fill in the absent default — an explicit non-"true"
-                # value is an operator's per-node pause and must survive
-                # reconciles (unlike the reference, which force-overwrites
-                # and so offers no node-level pause)
-                patch_val = "true"
-            elif not enabled and have == "true":
-                # only unwind the value this reconciler stamped; an
-                # operator's explicit per-node pause ("false"/"paused")
-                # survives a global disable→re-enable cycle
-                patch_val = None  # merge-patch null deletes the key
-            else:
-                continue
-            self.client.patch(
-                "v1", "Node", name_of(node),
-                {"metadata": {"annotations":
-                              {L.DRIVER_UPGRADE_ENABLED: patch_val}}})
+            delta = _upgrade_annotation_delta(node, enabled)
+            if delta:
+                self.client.patch("v1", "Node", name_of(node),
+                                  {"metadata": {"annotations": delta}})
 
     def sync(self, policy: dict, spec: TPUClusterPolicySpec,
              extra: Optional[dict] = None) -> Dict[str, SyncResult]:

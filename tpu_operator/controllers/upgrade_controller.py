@@ -212,8 +212,11 @@ class UpgradeReconciler(Reconciler):
         # rollout exactly like autoUpgrade: false
         cr_gate = (get_nested(cr, "metadata", "annotations",
                               default={}) or {}).get(L.DRIVER_UPGRADE_ENABLED)
-        if not policy.auto_upgrade or (cr_gate is not None
-                                       and cr_gate != "true"):
+        if (not policy.auto_upgrade
+                or spec.sandbox_workloads.is_enabled()  # sandbox gate,
+                # upgrade_controller.go:103-121: rollouts are container-
+                # plane only; isolated/virtual nodes must not be drained
+                or (cr_gate is not None and cr_gate != "true")):
             self.remove_upgrade_state_labels()
             return Result()
 

@@ -45,6 +45,7 @@ node-labelling side lives in controllers/state_manager.py.
 
 from __future__ import annotations
 
+import os
 import pathlib
 from typing import Callable, List, Optional
 
@@ -56,7 +57,12 @@ from ..render import Renderer
 from .skel import apply_objects, delete_state_objects, objects_ready
 from .state import State, SyncContext, SyncResult, SyncStatus
 
-MANIFESTS_ROOT = pathlib.Path(__file__).resolve().parents[2] / "manifests"
+# source-tree default, overridable for installed/containerized deployments
+# where the manifests are baked at /opt/tpu-operator/manifests
+# (docker/Dockerfile; the reference bakes /opt/gpu-operator the same way)
+MANIFESTS_ROOT = pathlib.Path(
+    os.environ.get("TPU_OPERATOR_MANIFESTS", "")
+    or pathlib.Path(__file__).resolve().parents[2] / "manifests")
 
 DEFAULT_REPOSITORY = "ghcr.io/tpu-operator"
 DEFAULT_VERSION = f"v{__version__}"
