@@ -1,0 +1,516 @@
+"""Real-apiserver client tier (VERDICT round-1 item 4).
+
+`runtime/kubeclient.py` is the only code that talks to a real apiserver;
+in round 1 it was covered by a single selector-string unit. This tier
+drives the actual `HTTPClient` + `KubeConfig` through a stdlib mock HTTP
+apiserver — CRUD, status subresource, merge-patch semantics, 404/409/422
+mapping, label-selector rendering, chunked watch streams with reconnect
+and 410-style ERROR events, and both auth-loading paths. No network
+beyond 127.0.0.1, no kubernetes needed (the `tests/e2e` slot of the
+reference, gpu_operator_test.go:36-100, minus the cloud)."""
+
+import base64
+import copy
+import json
+import os
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+import yaml
+
+from tpu_operator.runtime.client import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    ListOptions,
+    NotFoundError,
+)
+from tpu_operator.runtime.kubeclient import HTTPClient, KubeConfig, plural_of
+
+# --------------------------------------------------------------------------
+# mock apiserver
+# --------------------------------------------------------------------------
+
+
+class _State:
+    """Shared store the handler mutates and tests inspect."""
+
+    def __init__(self):
+        self.objects = {}           # resource path -> object dict
+        self.requests = []          # (method, path, query, headers, body)
+        self.watch_batches = queue.Queue()  # each item: list of event dicts
+        self.watch_connections = 0
+        self.rv = 100
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State = None  # set per-fixture
+
+    def log_message(self, *a):  # silence
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _record(self, body):
+        u = urlparse(self.path)
+        self.state.requests.append(
+            (self.command, u.path, parse_qs(u.query), dict(self.headers),
+             body))
+
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n)) if n else None
+
+    def _send(self, code, doc):
+        payload = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _not_found(self):
+        self._send(404, {"kind": "Status", "status": "Failure",
+                         "reason": "NotFound", "code": 404})
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        self._record(None)
+        if q.get("watch") == ["true"]:
+            return self._serve_watch()
+        if u.path in self.state.objects:
+            return self._send(200, self.state.objects[u.path])
+        # collection GET: children exactly one path segment below, plus —
+        # for all-namespaces lists like /api/v1/pods — objects under
+        # /api/v1/namespaces/*/pods/*
+        prefix = u.path.rstrip("/") + "/"
+        items = [copy.deepcopy(o) for p, o in sorted(self.state.objects.items())
+                 if p.startswith(prefix) and "/" not in p[len(prefix):]]
+        if "/namespaces/" not in u.path:
+            import re as _re
+
+            segs = u.path.rstrip("/").split("/")
+            pat = _re.compile(
+                _re.escape("/".join(segs[:-1])) + r"/namespaces/[^/]+/"
+                + _re.escape(segs[-1]) + r"/[^/]+$")
+            items += [copy.deepcopy(o)
+                      for p, o in sorted(self.state.objects.items())
+                      if pat.match(p)]
+        if items or u.path.rstrip("/").split("/")[-1] in (
+                plural_of(k) for k in ("Pod", "Node", "ConfigMap",
+                                       "TPUClusterPolicy", "Namespace")):
+            for item in items:
+                # k8s trims these on list entries
+                item.pop("apiVersion", None)
+                item.pop("kind", None)
+            return self._send(200, {
+                "kind": "List", "items": items,
+                "metadata": {"resourceVersion": str(self.state.rv)}})
+        self._not_found()
+
+    def _serve_watch(self):
+        self.state.watch_connections += 1
+        try:
+            events = self.state.watch_batches.get(timeout=5)
+        except queue.Empty:
+            events = []
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        for evt in events:
+            self.wfile.write((json.dumps(evt) + "\n").encode())
+            self.wfile.flush()
+        # connection closes -> client must re-list + re-watch
+        self.close_connection = True
+
+    def do_POST(self):
+        body = self._read_body()
+        self._record(body)
+        u = urlparse(self.path)
+        name = (body.get("metadata") or {}).get("name")
+        path = f"{u.path.rstrip('/')}/{name}"
+        if path in self.state.objects:
+            return self._send(409, {"kind": "Status", "status": "Failure",
+                                    "reason": "AlreadyExists", "code": 409})
+        self.state.rv += 1
+        body.setdefault("metadata", {})["resourceVersion"] = str(self.state.rv)
+        self.state.objects[path] = body
+        self._send(201, body)
+
+    def do_PUT(self):
+        body = self._read_body()
+        self._record(body)
+        u = urlparse(self.path)
+        path = u.path
+        is_status = path.endswith("/status")
+        target = path[:-len("/status")] if is_status else path
+        current = self.state.objects.get(target)
+        if current is None:
+            return self._not_found()
+        sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+        have_rv = (current.get("metadata") or {}).get("resourceVersion")
+        if sent_rv and have_rv and sent_rv != have_rv:
+            return self._send(409, {"kind": "Status", "status": "Failure",
+                                    "reason": "Conflict", "code": 409})
+        if body.get("spec", {}).get("__invalid__"):
+            return self._send(422, {"kind": "Status", "status": "Failure",
+                                    "reason": "Invalid", "code": 422})
+        self.state.rv += 1
+        if is_status:
+            current = copy.deepcopy(current)
+            current["status"] = body.get("status")
+            body = current
+        body.setdefault("metadata", {})["resourceVersion"] = str(self.state.rv)
+        self.state.objects[target] = body
+        self._send(200, body)
+
+    def do_PATCH(self):
+        body = self._read_body()
+        self._record(body)
+        u = urlparse(self.path)
+        current = self.state.objects.get(u.path)
+        if current is None:
+            return self._not_found()
+
+        def merge(base, patch):
+            out = dict(base)
+            for k, v in patch.items():
+                if v is None:
+                    out.pop(k, None)
+                elif isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = merge(out[k], v)
+                else:
+                    out[k] = v
+            return out
+
+        self.state.rv += 1
+        merged = merge(current, body)
+        merged.setdefault("metadata", {})["resourceVersion"] = str(self.state.rv)
+        self.state.objects[u.path] = merged
+        self._send(200, merged)
+
+    def do_DELETE(self):
+        self._record(None)
+        u = urlparse(self.path)
+        if u.path not in self.state.objects:
+            return self._not_found()
+        del self.state.objects[u.path]
+        self._send(200, {"kind": "Status", "status": "Success"})
+
+
+@pytest.fixture()
+def apiserver():
+    state = _State()
+    handler = type("H", (_Handler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    state.server = server
+    state.url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield state
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.fixture()
+def client(apiserver):
+    cfg = KubeConfig(server=apiserver.url, token="test-token",
+                     namespace="tpu-operator")
+    c = HTTPClient(config=cfg)
+    yield c
+    c._stop.set()
+
+
+def pod(name, ns="tpu-operator", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {"containers": []}}
+
+
+# --------------------------------------------------------------------------
+# CRUD
+# --------------------------------------------------------------------------
+
+
+class TestCRUD:
+    def test_get_roundtrip_and_auth_header(self, apiserver, client):
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/p1"] = pod("p1")
+        got = client.get("v1", "Pod", "p1")
+        assert got["metadata"]["name"] == "p1"
+        method, path, _, headers, _ = apiserver.requests[-1]
+        assert (method, path) == (
+            "GET", "/api/v1/namespaces/tpu-operator/pods/p1")
+        assert headers["Authorization"] == "Bearer test-token"
+
+    def test_get_missing_raises_not_found(self, client):
+        with pytest.raises(NotFoundError):
+            client.get("v1", "Pod", "nope")
+
+    def test_get_or_none(self, apiserver, client):
+        assert client.get_or_none("v1", "Pod", "nope") is None
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/p1"] = pod("p1")
+        assert client.get_or_none("v1", "Pod", "p1") is not None
+
+    def test_cluster_scoped_url_has_no_namespace(self, apiserver, client):
+        apiserver.objects["/api/v1/nodes/n1"] = {
+            "apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}}
+        client.get("v1", "Node", "n1")
+        assert apiserver.requests[-1][1] == "/api/v1/nodes/n1"
+
+    def test_cr_group_url(self, apiserver, client):
+        apiserver.objects[
+            "/apis/tpu.graft.dev/v1/tpuclusterpolicies/p"] = {
+            "apiVersion": "tpu.graft.dev/v1", "kind": "TPUClusterPolicy",
+            "metadata": {"name": "p"}}
+        got = client.get("tpu.graft.dev/v1", "TPUClusterPolicy", "p")
+        assert got["metadata"]["name"] == "p"
+        assert apiserver.requests[-1][1] == \
+            "/apis/tpu.graft.dev/v1/tpuclusterpolicies/p"
+
+    def test_create_posts_to_collection(self, apiserver, client):
+        created = client.create(pod("p2"))
+        assert created["metadata"]["resourceVersion"]
+        assert apiserver.requests[-1][:2] == (
+            "POST", "/api/v1/namespaces/tpu-operator/pods")
+
+    def test_create_duplicate_raises_already_exists(self, apiserver, client):
+        client.create(pod("p3"))
+        with pytest.raises(AlreadyExistsError):
+            client.create(pod("p3"))
+
+    def test_update_roundtrip(self, apiserver, client):
+        client.create(pod("p4"))
+        got = client.get("v1", "Pod", "p4")
+        got["spec"]["restartPolicy"] = "Never"
+        updated = client.update(got)
+        assert updated["spec"]["restartPolicy"] == "Never"
+
+    def test_update_stale_rv_raises_conflict(self, apiserver, client):
+        client.create(pod("p5"))
+        stale = client.get("v1", "Pod", "p5")
+        fresh = client.get("v1", "Pod", "p5")
+        fresh["spec"]["x"] = 1
+        client.update(fresh)  # bumps RV server-side
+        stale["spec"]["y"] = 2
+        with pytest.raises(ConflictError):
+            client.update(stale)
+
+    def test_update_status_hits_subresource(self, apiserver, client):
+        client.create(pod("p6"))
+        got = client.get("v1", "Pod", "p6")
+        got["status"] = {"phase": "Running"}
+        client.update_status(got)
+        assert apiserver.requests[-1][1].endswith("/pods/p6/status")
+        # status PUT must not clobber spec
+        merged = apiserver.objects[
+            "/api/v1/namespaces/tpu-operator/pods/p6"]
+        assert merged["status"]["phase"] == "Running"
+        assert "containers" in merged["spec"]
+
+    def test_invalid_raises_invalid_error(self, apiserver, client):
+        client.create(pod("p7"))
+        got = client.get("v1", "Pod", "p7")
+        got["spec"]["__invalid__"] = True
+        with pytest.raises(InvalidError):
+            client.update(got)
+
+    def test_patch_sends_merge_patch(self, apiserver, client):
+        client.create(pod("p8", labels={"a": "1", "b": "2"}))
+        client.patch("v1", "Pod", "p8",
+                     {"metadata": {"labels": {"a": None, "c": "3"}}})
+        method, path, _, headers, body = apiserver.requests[-1]
+        assert method == "PATCH"
+        assert headers["Content-Type"] == "application/merge-patch+json"
+        labels = apiserver.objects[
+            "/api/v1/namespaces/tpu-operator/pods/p8"]["metadata"]["labels"]
+        assert labels == {"b": "2", "c": "3"}  # null deleted, new merged
+
+    def test_delete_and_delete_missing(self, apiserver, client):
+        client.create(pod("p9"))
+        client.delete("v1", "Pod", "p9")
+        assert "/api/v1/namespaces/tpu-operator/pods/p9" \
+            not in apiserver.objects
+        with pytest.raises(NotFoundError):
+            client.delete("v1", "Pod", "p9")
+
+    def test_apply_create_then_update(self, apiserver, client):
+        obj = pod("p10")
+        client.apply(obj)
+        obj2 = pod("p10")
+        obj2["spec"]["restartPolicy"] = "Always"
+        client.apply(obj2)
+        assert apiserver.objects[
+            "/api/v1/namespaces/tpu-operator/pods/p10"
+        ]["spec"]["restartPolicy"] == "Always"
+
+
+# --------------------------------------------------------------------------
+# list + selectors
+# --------------------------------------------------------------------------
+
+
+class TestList:
+    def test_list_fills_apiversion_and_kind(self, apiserver, client):
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/a"] = pod("a")
+        items = client.list("v1", "Pod",
+                            ListOptions(namespace="tpu-operator"))
+        assert items and items[0]["apiVersion"] == "v1"
+        assert items[0]["kind"] == "Pod"
+
+    def test_list_all_namespaces_url(self, apiserver, client):
+        client.list("v1", "Pod")
+        assert apiserver.requests[-1][1] == "/api/v1/pods"
+
+    def test_label_selector_match_labels(self, apiserver, client):
+        client.list("v1", "Pod", ListOptions(
+            namespace="tpu-operator", label_selector={"app": "x"}))
+        q = apiserver.requests[-1][2]
+        assert q["labelSelector"] == ["app=x"]
+
+    def test_label_selector_expressions(self, apiserver, client):
+        client.list("v1", "Pod", ListOptions(
+            namespace="tpu-operator",
+            label_selector={
+                "matchLabels": {"app": "x"},
+                "matchExpressions": [
+                    {"key": "tier", "operator": "In",
+                     "values": ["a", "b"]},
+                    {"key": "gone", "operator": "DoesNotExist"},
+                ]}))
+        sel = apiserver.requests[-1][2]["labelSelector"][0]
+        assert "app=x" in sel and "tier in (a,b)" in sel and "!gone" in sel
+
+    def test_field_selector(self, apiserver, client):
+        client.list("v1", "Pod", ListOptions(
+            namespace="tpu-operator",
+            field_selector={"spec.nodeName": "n1"}))
+        assert apiserver.requests[-1][2]["fieldSelector"] == \
+            ["spec.nodeName=n1"]
+
+
+# --------------------------------------------------------------------------
+# watch
+# --------------------------------------------------------------------------
+
+
+class TestWatch:
+    def test_watch_lists_then_streams_then_reconnects(self, apiserver, client):
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w1"] = pod("w1")
+        got = []
+        done = threading.Event()
+
+        def handler(evt):
+            got.append((evt.type, evt.obj["metadata"]["name"]))
+            if len(got) >= 4:
+                done.set()
+
+        # first watch connection: one MODIFIED, then the server closes the
+        # stream; the client must re-list (ADDED again) and re-watch
+        apiserver.watch_batches.put([
+            {"type": "MODIFIED", "object": pod("w1")}])
+        apiserver.watch_batches.put([
+            {"type": "DELETED", "object": pod("w1")}])
+        unsub = client.watch("v1", "Pod", handler)
+        try:
+            assert done.wait(20), f"events so far: {got}"
+        finally:
+            unsub()
+        assert got[0] == ("ADDED", "w1")      # initial list
+        assert ("MODIFIED", "w1") in got      # first stream
+        assert ("DELETED", "w1") in got       # after reconnect
+        assert apiserver.watch_connections >= 2
+
+    def test_watch_error_event_triggers_relist(self, apiserver, client):
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w2"] = pod("w2")
+        got = []
+        done = threading.Event()
+
+        def handler(evt):
+            got.append(evt.type)
+            if got.count("ADDED") >= 2:
+                done.set()
+
+        # ERROR (410 Gone analog) mid-stream: client breaks out and
+        # re-lists from scratch
+        apiserver.watch_batches.put([
+            {"type": "ERROR", "object": {"code": 410, "reason": "Gone"}}])
+        apiserver.watch_batches.put([])
+        unsub = client.watch("v1", "Pod", handler)
+        try:
+            assert done.wait(20), f"events so far: {got}"
+        finally:
+            unsub()
+
+    def test_watch_unsubscribe_stops_thread(self, apiserver, client):
+        apiserver.watch_batches.put([])
+        unsub = client.watch("v1", "Pod", lambda e: None)
+        time.sleep(0.2)
+        unsub()
+        n = apiserver.watch_connections
+        apiserver.watch_batches.put([])
+        time.sleep(1.0)
+        # no new connections after unsubscribe (allow the in-flight one)
+        assert apiserver.watch_connections <= n + 1
+
+
+# --------------------------------------------------------------------------
+# auth config loading
+# --------------------------------------------------------------------------
+
+
+class TestKubeConfig:
+    def test_in_cluster_loads_token_and_namespace(self, tmp_path, monkeypatch):
+        sa = tmp_path / "sa"
+        sa.mkdir()
+        (sa / "token").write_text("tok-123\n")
+        (sa / "namespace").write_text("operand-ns")
+        (sa / "ca.crt").write_text("CERT")
+        monkeypatch.setattr("tpu_operator.runtime.kubeclient.SA_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+        cfg = KubeConfig.load()
+        assert cfg.server == "https://10.0.0.1:6443"
+        assert cfg.token == "tok-123"
+        assert cfg.namespace == "operand-ns"
+        assert cfg.ca_file == str(sa / "ca.crt")
+
+    def test_kubeconfig_file_with_inline_data(self, tmp_path, monkeypatch):
+        ca_b64 = base64.b64encode(b"CA-PEM").decode()
+        cfg_doc = {
+            "current-context": "ctx",
+            "contexts": [{"name": "ctx", "context": {
+                "cluster": "cl", "user": "u", "namespace": "ns-x"}}],
+            "clusters": [{"name": "cl", "cluster": {
+                "server": "https://example:6443",
+                "certificate-authority-data": ca_b64}}],
+            "users": [{"name": "u", "user": {"token": "tok-abc"}}],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(cfg_doc))
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        monkeypatch.setenv("KUBECONFIG", str(path))
+        cfg = KubeConfig.load()
+        assert cfg.server == "https://example:6443"
+        assert cfg.token == "tok-abc"
+        assert cfg.namespace == "ns-x"
+        with open(cfg.ca_file, "rb") as f:
+            assert f.read() == b"CA-PEM"
+        os.unlink(cfg.ca_file)
+
+    def test_plural_irregulars(self):
+        assert plural_of("NetworkPolicy") == "networkpolicies"
+        assert plural_of("Ingress") == "ingresses"
+        assert plural_of("TPUClusterPolicy") == "tpuclusterpolicies"
+        assert plural_of("Pod") == "pods"
+        assert plural_of("DaemonSet") == "daemonsets"

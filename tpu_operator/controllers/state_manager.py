@@ -198,22 +198,6 @@ class StateManager:
             log.info("pod security admission labels on namespace %s: %s",
                      self.namespace, delta)
 
-    def apply_driver_upgrade_annotation(self, enabled: bool) -> None:
-        """Standalone pass stamping the per-node driver auto-upgrade
-        annotation on TPU nodes; the reconciler folds this into
-        label_tpu_nodes' single node pass instead
-        (applyDriverAutoUpgradeAnnotation analog,
-        state_manager.go:423-477). To exclude one node from rollouts, SET
-        the annotation to a non-"true" value — explicit values survive
-        reconciles; a deleted annotation gets re-stamped."""
-        for node in self.client.list("v1", "Node"):
-            if not is_tpu_node(node):
-                continue
-            delta = _upgrade_annotation_delta(node, enabled)
-            if delta:
-                self.client.patch("v1", "Node", name_of(node),
-                                  {"metadata": {"annotations": delta}})
-
     def sync(self, policy: dict, spec: TPUClusterPolicySpec,
              extra: Optional[dict] = None) -> Dict[str, SyncResult]:
         """Drive every state once; returns per-state results (step() loop
