@@ -1,0 +1,227 @@
+"""Every ComponentSpec knob must change rendered output — no silent no-ops.
+
+Round-2 review found four spec fields (resources/args/imagePullSecrets/
+daemonsets.labels) that were plumbed into render data but consumed by no
+template: a user setting them got a clean render and zero effect. This
+module is the structural guarantee against that class of bug: for EVERY
+operand state and EVERY ComponentSpec field (plus every daemonsets-level
+field), set the field to a unique probe value and assert (a) the rendered
+object stream changes and (b) the probe value is present in it.
+
+The reference gets the same guarantee from applyCommonDaemonsetConfig
+being a single programmatic path (object_controls.go:689-741) plus the
+per-operand transform tests (object_controls_test.go:542-1078).
+"""
+
+import yaml
+
+from tpu_operator.api.clusterpolicy import (
+    TPUClusterPolicySpec,
+    new_cluster_policy,
+)
+from tpu_operator.state.operands import build_states
+from tpu_operator.state.state import SyncContext
+
+import pytest
+
+# operand state -> spec key holding its ComponentSpec
+STATE_SPEC_KEY = {
+    "libtpu-driver": "libtpu",
+    "tpu-runtime": "tpuRuntime",
+    "operator-validation": "validator",
+    "tpu-device-plugin": "devicePlugin",
+    "tpu-health": "tpuHealth",
+    "metrics-exporter": "metricsExporter",
+    "feature-discovery": "featureDiscovery",
+    "node-status-exporter": "nodeStatusExporter",
+    "topology-manager": "topologyManager",
+    "chip-fencing": "chipFencing",
+    "vtpu-device-manager": "vtpuDeviceManager",
+    "isolated-validation": "validator",
+    "isolated-device-plugin": "isolatedDevicePlugin",
+}
+
+# every ComponentSpec field except `enabled` (probed separately: flipping
+# it removes the whole state) -> (probe value, marker that must appear)
+COMPONENT_FIELD_PROBES = {
+    "repository": ({"repository": "gcr.io/probe-repo", "image": "img",
+                    "version": "v1"}, "probe-repo"),
+    "image": ({"repository": "gcr.io/r", "image": "probe-image",
+               "version": "v1"}, "probe-image"),
+    "version": ({"repository": "gcr.io/r", "image": "img",
+                 "version": "v9.9.9-probe"}, "v9.9.9-probe"),
+    "imagePullPolicy": ({"imagePullPolicy": "Never"}, "Never"),
+    "imagePullSecrets": ({"imagePullSecrets": ["probe-pull-secret"]},
+                         "probe-pull-secret"),
+    "args": ({"args": ["--probe-arg=on"]}, "--probe-arg=on"),
+    "env": ({"env": [{"name": "PROBE_ENV_VAR", "value": "probe-env-val"}]},
+            "PROBE_ENV_VAR"),
+    "resources": ({"resources": {"limits": {"cpu": "7777m"}}}, "7777m"),
+    "labels": ({"labels": {"probe.io/label": "probe-label-val"}},
+               "probe-label-val"),
+    "annotations": ({"annotations": {"probe.io/ann": "probe-ann-val"}},
+                    "probe-ann-val"),
+    "nodeSelector": ({"nodeSelector": {"probe.io/pool": "probe-pool"}},
+                     "probe-pool"),
+    "affinity": ({"affinity": {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "probe.io/zone", "operator": "In",
+                 "values": ["probe-zone"]}]}]}}}}, "probe-zone"),
+    "tolerations": ({"tolerations": [{"key": "probe.io/taint",
+                                      "operator": "Exists"}]},
+                    "probe.io/taint"),
+    "priorityClassName": ({"priorityClassName": "probe-priority"},
+                          "probe-priority"),
+}
+
+DAEMONSETS_FIELD_PROBES = {
+    "labels": ({"labels": {"probe.io/ds-label": "probe-ds-label-val"}},
+               "probe-ds-label-val"),
+    "annotations": ({"annotations": {"probe.io/ds-ann": "probe-ds-ann-val"}},
+                    "probe-ds-ann-val"),
+    "tolerations": ({"tolerations": [{"key": "probe.io/ds-taint",
+                                      "operator": "Exists"}]},
+                    "probe.io/ds-taint"),
+    "priorityClassName": ({"priorityClassName": "probe-ds-priority"},
+                          "probe-ds-priority"),
+    "updateStrategy": ({"updateStrategy": "OnDelete"}, "OnDelete"),
+    "rollingUpdateMaxUnavailable": (
+        {"rollingUpdateMaxUnavailable": "37%"}, "37%"),
+}
+
+# all states render under this base (health + sandbox planes on)
+BASE_SPEC = {
+    "tpuHealth": {"enabled": True},
+    "sandboxWorkloads": {"enabled": True},
+}
+
+
+def render_state(state_name: str, spec_dict) -> str:
+    policy = new_cluster_policy(spec=spec_dict)
+    spec = TPUClusterPolicySpec.from_obj(policy)
+    ctx = SyncContext(client=None, policy=policy, spec=spec,
+                      namespace="tpu-operator")
+    for state in build_states():
+        if state.name == state_name:
+            assert state.enabled(ctx), \
+                f"{state_name} disabled under base spec"
+            return yaml.safe_dump_all(state.render(ctx), sort_keys=True)
+    raise AssertionError(f"no state named {state_name}")
+
+
+def merged(base, override_key, override):
+    out = {k: dict(v) for k, v in base.items()}
+    out.setdefault(override_key, {}).update(override)
+    return out
+
+
+@pytest.mark.parametrize("field", sorted(COMPONENT_FIELD_PROBES))
+@pytest.mark.parametrize("state_name", sorted(STATE_SPEC_KEY))
+def test_component_field_changes_render(state_name, field):
+    probe, marker = COMPONENT_FIELD_PROBES[field]
+    baseline = render_state(state_name, BASE_SPEC)
+    probed = render_state(
+        state_name, merged(BASE_SPEC, STATE_SPEC_KEY[state_name], probe))
+    assert probed != baseline, (
+        f"{STATE_SPEC_KEY[state_name]}.{field} is a silent no-op for "
+        f"state {state_name}")
+    assert marker in probed, (
+        f"{STATE_SPEC_KEY[state_name]}.{field}: probe value {marker!r} "
+        f"absent from render of {state_name}")
+
+
+@pytest.mark.parametrize("field", sorted(DAEMONSETS_FIELD_PROBES))
+@pytest.mark.parametrize("state_name", sorted(STATE_SPEC_KEY))
+def test_daemonsets_field_changes_render(state_name, field):
+    if state_name == "libtpu-driver" and field in (
+            "updateStrategy", "rollingUpdateMaxUnavailable"):
+        # the driver DaemonSet is always OnDelete — rolling a libtpu swap
+        # automatically would brick nodes (the reference pins its driver
+        # DS the same way: values.yaml "driver Daemonset is always set
+        # with OnDelete"; SURVEY.md section 7 hard parts)
+        pytest.skip("libtpu-driver deliberately pins OnDelete")
+    probe, marker = DAEMONSETS_FIELD_PROBES[field]
+    baseline = render_state(state_name, BASE_SPEC)
+    probed = render_state(state_name, merged(BASE_SPEC, "daemonsets", probe))
+    assert probed != baseline, (
+        f"daemonsets.{field} is a silent no-op for state {state_name}")
+    assert marker in probed, (
+        f"daemonsets.{field}: probe value {marker!r} absent from render "
+        f"of {state_name}")
+
+
+@pytest.mark.parametrize("state_name", sorted(
+    set(STATE_SPEC_KEY) - {"isolated-validation", "operator-validation"}))
+def test_enabled_false_disables_state(state_name):
+    """`enabled: false` must actually remove the operand (the one
+    ComponentSpec field the render-diff probes can't cover)."""
+    policy = new_cluster_policy(spec=merged(
+        BASE_SPEC, STATE_SPEC_KEY[state_name], {"enabled": False}))
+    spec = TPUClusterPolicySpec.from_obj(policy)
+    ctx = SyncContext(client=None, policy=policy, spec=spec,
+                      namespace="tpu-operator")
+    state = next(s for s in build_states() if s.name == state_name)
+    assert not state.enabled(ctx)
+
+
+def test_validator_enabled_false_disables_both_validation_states():
+    policy = new_cluster_policy(spec=merged(
+        BASE_SPEC, "validator", {"enabled": False}))
+    spec = TPUClusterPolicySpec.from_obj(policy)
+    ctx = SyncContext(client=None, policy=policy, spec=spec,
+                      namespace="tpu-operator")
+    for name in ("operator-validation", "isolated-validation"):
+        state = next(s for s in build_states() if s.name == name)
+        assert not state.enabled(ctx)
+
+
+def test_per_operand_overrides_beat_daemonset_defaults():
+    """comp.priorityClassName / labels / tolerations layer over the
+    daemonsets defaults (per-operand wins, both toleration sets present)."""
+    spec_dict = merged(BASE_SPEC, "daemonsets", {
+        "priorityClassName": "ds-level",
+        "labels": {"shared": "from-ds"},
+        "tolerations": [{"key": "ds-taint", "operator": "Exists"}]})
+    spec_dict = merged(spec_dict, "devicePlugin", {
+        "priorityClassName": "operand-level",
+        "labels": {"shared": "from-operand"},
+        "tolerations": [{"key": "operand-taint", "operator": "Exists"}]})
+    out = render_state("tpu-device-plugin", spec_dict)
+    docs = list(yaml.safe_load_all(out))
+    ds = next(d for d in docs if d["kind"] == "DaemonSet")
+    pod = ds["spec"]["template"]["spec"]
+    assert pod["priorityClassName"] == "operand-level"
+    assert ds["metadata"]["labels"]["shared"] == "from-operand"
+    keys = [t["key"] for t in pod["tolerations"]]
+    assert "ds-taint" in keys and "operand-taint" in keys
+
+
+def test_validator_pull_secrets_ride_along_on_every_operand():
+    """Every operand pod pulls ValidatorImage for its barrier
+    initContainer; a private validator registry must not ImagePullBackOff
+    the rest of the stack (imagePullSecrets are pod-scoped)."""
+    spec_dict = merged(BASE_SPEC, "validator",
+                       {"imagePullSecrets": ["validator-cred"]})
+    spec_dict = merged(spec_dict, "devicePlugin",
+                       {"imagePullSecrets": ["dp-cred"]})
+    out = render_state("tpu-device-plugin", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    secrets = [s["name"] for s in
+               ds["spec"]["template"]["spec"]["imagePullSecrets"]]
+    assert secrets == ["dp-cred", "validator-cred"]
+
+
+def test_template_selector_labels_survive_common_labels():
+    """User labels must never clobber the app selector label or the
+    deploy-label nodeSelector."""
+    spec_dict = merged(BASE_SPEC, "devicePlugin", {
+        "labels": {"app": "evil-override"},
+        "nodeSelector": {"tpu.graft.dev/deploy.tpu-device-plugin": "false"}})
+    out = render_state("tpu-device-plugin", spec_dict)
+    docs = list(yaml.safe_load_all(out))
+    ds = next(d for d in docs if d["kind"] == "DaemonSet")
+    assert ds["spec"]["template"]["metadata"]["labels"]["app"] == \
+        "tpu-device-plugin-daemonset"
+    sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["tpu.graft.dev/deploy.tpu-device-plugin"] == "true"

@@ -59,6 +59,67 @@ PERMUTATIONS = {
         "vtpuDeviceManager": {"defaultProfile": "vtpu-4"},
         "isolatedDevicePlugin": {"resourceName": "example.com/tpu-dedicated"},
     },
+    # every shared knob set at once (the spec permutation that would have
+    # caught the round-2 dead-knob bug): daemonsets defaults + a fully
+    # overridden operand + distinct overrides on several others
+    "everything-overridden": {
+        "operator": {"runtimeClass": "tpu-custom", "serviceMonitor": True,
+                     "serviceMonitorIntervalSeconds": 45},
+        "daemonsets": {
+            "labels": {"team/owner": "ml-infra"},
+            "annotations": {"team/contact": "ml-infra@example.com"},
+            "tolerations": [{"key": "dedicated", "operator": "Equal",
+                             "value": "tpu", "effect": "NoSchedule"}],
+            "priorityClassName": "tpu-critical",
+            "updateStrategy": "RollingUpdate",
+            "rollingUpdateMaxUnavailable": "10%",
+        },
+        "libtpu": {"repository": "gcr.io/ovr", "image": "libtpu",
+                   "version": "2.0.0", "installDir": "/opt/libtpu",
+                   "channel": "nightly",
+                   "env": [{"name": "LIBTPU_INIT_ARGS",
+                            "value": "--xla_spmd"}]},
+        "devicePlugin": {
+            "repository": "gcr.io/ovr", "image": "dp", "version": "2.0.0",
+            "imagePullPolicy": "Always",
+            "imagePullSecrets": ["regcred"],
+            "args": ["--fail-on-init-error=false"],
+            "env": [{"name": "DP_EXTRA", "value": "on"}],
+            "resources": {"requests": {"cpu": "100m", "memory": "128Mi"},
+                          "limits": {"cpu": "500m", "memory": "256Mi"}},
+            "labels": {"operand": "device-plugin"},
+            "annotations": {"operand/ann": "dp"},
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x2x2"},
+            "affinity": {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "cloud.google.com/gke-accelerator-type",
+                         "operator": "Exists"}]}]}}},
+            "tolerations": [{"key": "dp-only", "operator": "Exists"}],
+            "priorityClassName": "dp-priority",
+        },
+        "metricsExporter": {"serviceMonitor": True, "port": 9444,
+                            "resources": {"limits": {"memory": "64Mi"}}},
+        "validator": {"matmulSize": 8192, "iciBandwidthThreshold": 0.85,
+                      "env": [{"name": "WITH_WORKLOAD", "value": "false"}],
+                      "imagePullSecrets": ["validator-cred"]},
+        "tpuHealth": {"enabled": True,
+                      "annotations": {"scrape": "internal"}},
+        "featureDiscovery": {"intervalSeconds": 120,
+                             "args": ["--one-shot"]},
+        "nodeStatusExporter": {"labels": {"exporter": "node-status"}},
+        "topologyManager": {"defaultProfile": "2x2x1",
+                            "nodeSelector": {"pool": "slices"}},
+        "sandboxWorkloads": {"enabled": True},
+        "chipFencing": {"resources": {"limits": {"cpu": "200m"}}},
+        "vtpuDeviceManager": {"env": [{"name": "VTPU_LOG", "value": "debug"}]},
+        "isolatedDevicePlugin": {"tolerations": [
+            {"key": "isolated", "operator": "Exists"}]},
+        "hostPaths": {"rootFS": "/host"},
+        "psa": {"enabled": True},
+        "upgradePolicy": {"autoUpgrade": True, "maxParallelUpgrades": 2,
+                          "drainTimeoutSeconds": 120},
+    },
 }
 
 
@@ -71,7 +132,7 @@ def render_all(spec_dict) -> str:
     for state in build_states():
         if not state.enabled(ctx):
             continue
-        for obj in state.renderer().render_objects(state._data_fn(ctx)):
+        for obj in state.render(ctx):
             docs.append(obj)
     return yaml.safe_dump_all(docs, sort_keys=True)
 

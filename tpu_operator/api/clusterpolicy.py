@@ -40,9 +40,24 @@ class ComponentSpec:
     version: Optional[str] = field(description="Image tag or sha256: digest")
     image_pull_policy: Optional[str] = field(description="IfNotPresent|Always|Never")
     image_pull_secrets: Optional[List[str]] = None
-    args: Optional[List[str]] = None
+    args: Optional[List[str]] = field(
+        description="Replace the operand container's args")
     env: Optional[List[Any]] = field(description="corev1 EnvVar list")
     resources: Optional[Any] = field(description="corev1 ResourceRequirements")
+    labels: Optional[Dict[str, str]] = field(
+        description="Extra labels on this operand's objects and pods "
+                    "(merged over daemonsets.labels)")
+    annotations: Optional[Dict[str, str]] = field(
+        description="Extra annotations on this operand's objects and pods "
+                    "(merged over daemonsets.annotations)")
+    node_selector: Optional[Dict[str, str]] = field(
+        description="Extra nodeSelector terms merged into this operand's "
+                    "DaemonSet (the per-state deploy label always applies)")
+    affinity: Optional[Any] = field(description="corev1 Affinity for the pod")
+    tolerations: Optional[List[Any]] = field(
+        description="Extra tolerations appended after daemonsets.tolerations")
+    priority_class_name: Optional[str] = field(
+        description="Overrides daemonsets.priorityClassName for this operand")
 
     def is_enabled(self, default: bool = True) -> bool:
         return default if self.enabled is None else bool(self.enabled)

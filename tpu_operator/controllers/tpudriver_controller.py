@@ -36,7 +36,12 @@ from ..runtime import (
 )
 from ..runtime.objects import name_of, set_nested
 from ..state.nodepool import get_node_pools
-from ..state.operands import MANIFESTS_ROOT, common_data, resolve_image
+from ..state.operands import (
+    MANIFESTS_ROOT,
+    apply_common_config,
+    common_data,
+    resolve_image,
+)
 from ..state.skel import apply_objects, objects_ready
 from ..state.state import SyncContext
 from .validation import ValidationError, validate_node_selectors
@@ -106,9 +111,11 @@ class TPUDriverReconciler(Reconciler):
             data["InstallDir"] = spec.install_dir or "/home/kubernetes/bin"
             data["Channel"] = spec.channel or "stable"
             data["Name"] = f"tpu-libtpu-driver-{pool.name}"
-            data["NodeSelector"] = {data["DeployLabel"]: "true",
+            data["NodeSelector"] = {**data["NodeSelector"],
+                                    data["DeployLabel"]: "true",
                                     **pool.selector}
-            desired.extend(renderer.render_objects(data))
+            desired.extend(apply_common_config(
+                renderer.render_objects(data), data))
 
         state_label = self._state_label(request.name)
         applied = apply_objects(self.client, cr, state_label, desired,

@@ -99,12 +99,25 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
         "Image": resolve_image(state, comp, default_image),
         "ImagePullPolicy": (comp.image_pull_policy if comp else None)
         or "IfNotPresent",
-        "ImagePullSecrets": (comp.image_pull_secrets if comp else None) or [],
-        "PriorityClassName": ds.priority_class_name or "system-node-critical",
-        "Tolerations": (ds.tolerations or []) + DEFAULT_TOLERATIONS,
+        # every operand pod also pulls ValidatorImage for its barrier
+        # initContainer, so the validator's pull secrets must ride along
+        # (imagePullSecrets are pod-scoped)
+        "ImagePullSecrets": _dedup(
+            ((comp.image_pull_secrets if comp else None) or [])
+            + (validator.image_pull_secrets or [])),
+        "PriorityClassName": (comp.priority_class_name if comp else None)
+        or ds.priority_class_name or "system-node-critical",
+        "Tolerations": (ds.tolerations or [])
+        + ((comp.tolerations if comp else None) or [])
+        + DEFAULT_TOLERATIONS,
         "UpdateStrategy": ds.update_strategy or "RollingUpdate",
         "MaxUnavailable": ds.rolling_update_max_unavailable or "1",
-        "CommonLabels": ds.labels or {},
+        "CommonLabels": {**(ds.labels or {}),
+                         **((comp.labels if comp else None) or {})},
+        "CommonAnnotations": {**(ds.annotations or {}),
+                              **((comp.annotations if comp else None) or {})},
+        "NodeSelector": (comp.node_selector if comp else None) or {},
+        "Affinity": comp.affinity if comp else None,
         "Env": (comp.env if comp else None) or [],
         "Args": (comp.args if comp else None) or [],
         "Resources": comp.resources if comp else None,
@@ -117,6 +130,84 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
             "DevDir": hp.dev_dir or "/dev",
         },
     }
+
+
+def _dedup(items: List[str]) -> List[str]:
+    return list(dict.fromkeys(items))
+
+
+def _merge_keep_existing(target: Optional[dict], extra: dict) -> dict:
+    """Merge ``extra`` under ``target``: keys the template already set win
+    (the app selector label and deploy-label nodeSelector must never be
+    clobbered by user config)."""
+    return {**extra, **(target or {})}
+
+
+def apply_common_config(objects: List[dict], data: dict) -> List[dict]:
+    """Post-render application of the config surface every operand shares.
+
+    The reference does this programmatically per DaemonSet
+    (applyCommonDaemonsetConfig + applyCommonDaemonsetMetadata,
+    object_controls.go:689-741) so no template can silently drop a knob;
+    same here: labels/annotations go on every rendered object and its pod
+    template, scheduling + image-pull + resource knobs go on DaemonSet pod
+    specs. Identity keys the template set (selector labels, the
+    deploy-label nodeSelector) win on conflict; env and args are
+    deliberately user-wins (setContainerEnv override semantics,
+    object_controls.go:2351) — overriding a template-set env var is the
+    point of the knob.
+    """
+    labels = data.get("CommonLabels") or {}
+    annotations = data.get("CommonAnnotations") or {}
+    for obj in objects:
+        meta = obj.setdefault("metadata", {})
+        if labels:
+            meta["labels"] = _merge_keep_existing(meta.get("labels"), labels)
+        if annotations:
+            meta["annotations"] = _merge_keep_existing(
+                meta.get("annotations"), annotations)
+        if obj.get("kind") != "DaemonSet":
+            continue
+        tmpl = obj.setdefault("spec", {}).setdefault("template", {})
+        tmeta = tmpl.setdefault("metadata", {})
+        if labels:
+            tmeta["labels"] = _merge_keep_existing(tmeta.get("labels"), labels)
+        if annotations:
+            tmeta["annotations"] = _merge_keep_existing(
+                tmeta.get("annotations"), annotations)
+        pod = tmpl.setdefault("spec", {})
+        if data.get("NodeSelector"):
+            pod["nodeSelector"] = _merge_keep_existing(
+                pod.get("nodeSelector"), data["NodeSelector"])
+        if data.get("Affinity") and "affinity" not in pod:
+            pod["affinity"] = data["Affinity"]
+        if data.get("ImagePullSecrets"):
+            pod["imagePullSecrets"] = (pod.get("imagePullSecrets") or []) + [
+                {"name": s} for s in data["ImagePullSecrets"]]
+        # env/resources apply on every operand (non-init) container; args
+        # replace only the first (primary) container's. The validation
+        # initContainers' barrier args are part of the protocol, not user
+        # surface.
+        for i, ctr in enumerate(pod.get("containers") or []):
+            if data.get("Resources") is not None:
+                ctr["resources"] = data["Resources"]
+            for var in data.get("Env") or []:
+                _set_container_env(ctr, var)
+            if i == 0 and data.get("Args"):
+                ctr["args"] = list(data["Args"])
+    return objects
+
+
+def _set_container_env(ctr: dict, var: dict) -> None:
+    """Replace-or-append an EnvVar by name (setContainerEnv semantics,
+    object_controls.go:2351 analog); supports full EnvVar shapes
+    (valueFrom etc.), which the old per-template range could not."""
+    env = ctr.setdefault("env", [])
+    for i, existing in enumerate(env):
+        if existing.get("name") == var.get("name"):
+            env[i] = var
+            return
+    env.append(var)
 
 
 class OperandState(State):
@@ -138,11 +229,19 @@ class OperandState(State):
     def renderer(self) -> Renderer:
         return Renderer(self._root / f"state-{self.name}")
 
+    def render(self, ctx: SyncContext) -> List[dict]:
+        """Render the state's manifests with the shared config surface
+        applied — the one render path sync, goldens and the everything-
+        overridden test all go through."""
+        data = self._data_fn(ctx)
+        return apply_common_config(
+            self.renderer().render_objects(data), data)
+
     def sync(self, ctx: SyncContext) -> SyncResult:
         if not self.enabled(ctx):
             delete_state_objects(ctx.client, self.name)
             return SyncResult(SyncStatus.DISABLED, "disabled by spec")
-        objects = self.renderer().render_objects(self._data_fn(ctx))
+        objects = self.render(ctx)
         applied = apply_objects(ctx.client, ctx.policy, self.name, objects,
                                 ctx.namespace)
         ok, msg = objects_ready(ctx.client, applied)
@@ -178,7 +277,8 @@ def _libtpu_driver_data(ctx: SyncContext) -> dict:
     # the TPUDriver controller re-renders this template per node pool with
     # its own Name/NodeSelector (internal/state/driver.go:211 analog)
     data["Name"] = "tpu-libtpu-driver-daemonset"
-    data["NodeSelector"] = {data["DeployLabel"]: "true"}
+    data["NodeSelector"] = {**data["NodeSelector"],
+                            data["DeployLabel"]: "true"}
     return data
 
 
