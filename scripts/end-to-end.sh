@@ -21,7 +21,8 @@ $PY -m tpu_operator.cli.tpuop_cfg generate all > "$WORK/bundle.yaml"
 grep -q "kind: CustomResourceDefinition" "$WORK/bundle.yaml"
 grep -q "kind: TPUClusterPolicy" "$WORK/bundle.yaml"
 $PY -m tpu_operator.cli.tpuop_cfg generate bundle > "$WORK/csv.yaml"
-grep -q "BundleMetadata" "$WORK/csv.yaml"
+grep -q "kind: ClusterServiceVersion" "$WORK/csv.yaml"
+grep -q "operators.operatorframework.io.bundle.mediatype.v1" "$WORK/csv.yaml"
 stage install-manifests
 
 # -- values pipeline: user overrides render a valid, merged CR ------------

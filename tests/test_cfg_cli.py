@@ -141,13 +141,106 @@ class TestValues:
         assert main(["generate", "all", "--values", str(f)]) == 1
         assert "INVALID values" in capsys.readouterr().err
 
-    def test_bundle_metadata_owns_both_crds(self, capsys):
+    def test_bundle_is_a_real_csv(self, capsys):
+        """`generate bundle` emits an OLM registry+v1 bundle: a
+        structurally complete ClusterServiceVersion, both CRDs, and the
+        bundle annotations (the reference's bundle/manifests CSV +
+        metadata/annotations.yaml shape)."""
+        import json
+
+        from tpu_operator import __version__
+
         assert main(["generate", "bundle"]) == 0
-        [meta] = list(yaml.safe_load_all(capsys.readouterr().out))
-        owned = {c["kind"] for c in
-                 meta["spec"]["customresourcedefinitions"]["owned"]}
-        assert owned == {"TPUClusterPolicy", "TPUDriver"}
-        assert meta["spec"]["relatedImages"]
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        csv = docs[0]
+        assert csv["apiVersion"] == "operators.coreos.com/v1alpha1"
+        assert csv["kind"] == "ClusterServiceVersion"
+        assert csv["metadata"]["name"] == f"tpu-operator.v{__version__}"
+        assert csv["spec"]["version"] == __version__
+
+        # alm-examples must be valid JSON holding sample CRs of both kinds
+        examples = json.loads(csv["metadata"]["annotations"]["alm-examples"])
+        assert {e["kind"] for e in examples} == \
+            {"TPUClusterPolicy", "TPUDriver"}
+
+        owned = csv["spec"]["customresourcedefinitions"]["owned"]
+        assert {c["kind"] for c in owned} == {"TPUClusterPolicy", "TPUDriver"}
+        # owned CRD names/versions must match the CRDs shipped in the
+        # same bundle (the validate-csv drift gate, Makefile:233-236)
+        crds = [d for d in docs
+                if d.get("kind") == "CustomResourceDefinition"]
+        assert len(crds) == 2
+        crd_names = {c["metadata"]["name"] for c in crds}
+        assert {c["name"] for c in owned} == crd_names
+        for o in owned:
+            crd = next(c for c in crds if c["metadata"]["name"] == o["name"])
+            versions = {v["name"] for v in crd["spec"]["versions"]}
+            assert o["version"] in versions
+
+        # the install strategy embeds the real Deployment + RBAC
+        install = csv["spec"]["install"]
+        assert install["strategy"] == "deployment"
+        dep = install["spec"]["deployments"][0]
+        ctr = dep["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["image"].startswith("ghcr.io/tpu-operator/tpu-operator")
+        perms = install["spec"]["clusterPermissions"][0]
+        assert perms["serviceAccountName"] == "tpu-operator"
+        assert any("tpu.graft.dev" in r.get("apiGroups", [])
+                   for r in perms["rules"])
+
+        modes = {m["type"]: m["supported"]
+                 for m in csv["spec"]["installModes"]}
+        assert set(modes) == {"OwnNamespace", "SingleNamespace",
+                              "MultiNamespace", "AllNamespaces"}
+        assert csv["spec"]["relatedImages"]
+        assert csv["spec"]["minKubeVersion"]
+
+        # bundle annotations doc (metadata/annotations.yaml content)
+        ann = docs[-1]["annotations"]
+        assert ann["operators.operatorframework.io.bundle.mediatype.v1"] \
+            == "registry+v1"
+        assert ann["operators.operatorframework.io.bundle.package.v1"] \
+            == "tpu-operator"
+
+    def test_csv_honors_values_image(self, capsys, tmp_path):
+        f = tmp_path / "values.yaml"
+        f.write_text("operator:\n  repository: gcr.io/acme\n"
+                     "  image: op\n  version: v9\n")
+        assert main(["generate", "bundle", "--values", str(f)]) == 0
+        csv = list(yaml.safe_load_all(capsys.readouterr().out))[0]
+        assert csv["metadata"]["annotations"]["containerImage"] == \
+            "gcr.io/acme/op:v9"
+        images = [i["image"] for i in csv["spec"]["relatedImages"]]
+        assert "gcr.io/acme/op:v9" in images
+
+    def test_operator_labels_cannot_break_selector(self):
+        from tpu_operator.deploy.packaging import operator_deployment
+
+        dep = operator_deployment("ns", "img:1", {"labels": {"app": "mine"}})
+        assert dep["spec"]["template"]["metadata"]["labels"]["app"] == \
+            "tpu-operator"
+        assert dep["spec"]["selector"]["matchLabels"]["app"] == "tpu-operator"
+
+    def test_operator_replicas_zero_respected(self):
+        from tpu_operator.deploy.packaging import operator_deployment
+
+        dep = operator_deployment("ns", "img:1", {"replicas": 0})
+        assert dep["spec"]["replicas"] == 0
+
+    def test_csv_alm_example_renders_valid_cr(self):
+        """The sample ClusterPolicy advertised to OLM users must itself
+        pass schema validation."""
+        import json
+
+        from tpu_operator.api.validate import validate_cr
+        from tpu_operator.deploy.csv import render_csv
+        from tpu_operator.deploy.values import load_values
+
+        csv = render_csv(load_values())
+        examples = json.loads(csv["metadata"]["annotations"]["alm-examples"])
+        cp = next(e for e in examples if e["kind"] == "TPUClusterPolicy")
+        errs, _ = validate_cr(cp)
+        assert errs == []
 
     def test_crds_ignore_values_file(self, tmp_path, capsys):
         # CRD output is values-independent; a broken values file must not

@@ -268,14 +268,28 @@ class DriverUpgradePolicySpec:
     upgrade_controller.go:103-121 gates)."""
 
     auto_upgrade: Optional[bool] = field(default=False)
-    max_parallel_upgrades: Optional[int] = field(default=1)
+    max_parallel_upgrades: Optional[int] = field(
+        default=1, description="Concurrent upgrade units: single-host "
+        "nodes count 1 each, a multi-host slice counts as one unit")
     max_unavailable: Optional[str] = field(default="25%")
     wait_for_completion_timeout_seconds: Optional[int] = field(default=0)
     pod_deletion_timeout_seconds: Optional[int] = field(default=300)
     drain_enable: Optional[bool] = field(name="drainEnable", default=True)
-    drain_timeout_seconds: Optional[int] = field(default=300)
+    drain_timeout_seconds: Optional[int] = field(
+        default=300, description="Seconds before an in-progress drain "
+        "fails the node (eviction can block forever on a PDB)")
     drain_delete_emptydir: Optional[bool] = field(
         name="drainDeleteEmptyDir", default=False)
+    drain_force: Optional[bool] = field(
+        default=False, description="Fall back to pod deletion when the "
+        "eviction API is blocked by a PodDisruptionBudget at the drain "
+        "timeout")
+    validation_timeout_seconds: Optional[int] = field(
+        default=300, description="Seconds a node may sit in "
+        "validation-required before the upgrade FSM marks it failed")
+    failed_retry_backoff_seconds: Optional[int] = field(
+        default=60, description="Backoff before a failed node re-enters "
+        "the upgrade FSM")
 
 
 @dataclass

@@ -40,7 +40,9 @@ def _generate_docs(args):
                       "(set operator.{repository,image,version})",
                       file=sys.stderr)
             if args.what == "bundle":
-                return [values_mod.render_bundle_metadata(vals)]
+                from ..deploy.csv import render_bundle_stream
+
+                return render_bundle_stream(vals)
             return values_mod.render_bundle(
                 vals, include_crds=(args.what == "all"))
         except (OSError, ValueError, yaml.YAMLError) as e:

@@ -1,0 +1,199 @@
+"""OLM ClusterServiceVersion generation — the bundle/ slot.
+
+The reference ships a real OLM bundle per release
+(bundle/manifests/gpu-operator-certified.clusterserviceversion.yaml:
+alm-examples annotation, owned CRDs with descriptors, an install strategy
+embedding the manager Deployment + clusterPermissions, installModes,
+relatedImages) and CI keeps it consistent with the CRD
+(``make validate-csv``, Makefile:233-236). Here the CSV is generated from
+the same code that renders the Deployment/RBAC/CRDs, so it cannot drift:
+
+    tpuop-cfg generate bundle [--values my-values.yaml]
+
+emits the bundle manifest stream: the CSV, both CRDs, and the OLM bundle
+annotations document (metadata/annotations.yaml content).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from .. import __version__
+from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER
+from ..api.crd import all_crds
+from .packaging import cluster_role, operator_deployment, sample_cluster_policy
+
+PACKAGE_NAME = "tpu-operator"
+DEFAULT_CHANNEL = "stable"
+
+_DESCRIPTION = """\
+The TPU Operator manages the software stack TPU nodes need to serve
+accelerated workloads in Kubernetes: libtpu installation, device/runtime
+hookup, the google.com/tpu device plugin, telemetry exporters, feature
+discovery, topology/slice shaping, and a per-node validation gate that
+proves each layer (through a real JAX matmul + ICI collective) before
+workloads schedule. A singleton TPUClusterPolicy CR configures the whole
+stack; per-pool TPUDriver CRs manage libtpu flavors per node pool."""
+
+
+def _sample_tpudriver() -> dict:
+    from ..api.tpudriver import V1ALPHA1
+
+    return {
+        "apiVersion": V1ALPHA1,
+        "kind": KIND_TPU_DRIVER,
+        "metadata": {"name": "v5e-stable"},
+        "spec": {"channel": "stable",
+                 "nodeSelector": {
+                     "cloud.google.com/gke-tpu-accelerator": "tpu-v5e"}},
+    }
+
+
+def _owned_crds() -> List[dict]:
+    from ..api import V1, V1ALPHA1
+
+    return [
+        {
+            "name": "tpuclusterpolicies.tpu.graft.dev",
+            "kind": KIND_CLUSTER_POLICY,
+            "version": V1.split("/")[-1],
+            "displayName": "TPUClusterPolicy",
+            "description": "Singleton configuration of the whole TPU "
+                           "software stack; one sub-spec per operand.",
+            "resources": [
+                {"kind": "DaemonSet", "name": "", "version": "apps/v1"},
+                {"kind": "Service", "name": "", "version": "v1"},
+                {"kind": "RuntimeClass", "name": "",
+                 "version": "node.k8s.io/v1"},
+            ],
+            "specDescriptors": [
+                {"path": "devicePlugin.enabled",
+                 "displayName": "Device Plugin",
+                 "description": "Advertise google.com/tpu to kubelet",
+                 "x-descriptors": [
+                     "urn:alm:descriptor:com.tectonic.ui:booleanSwitch"]},
+                {"path": "validator.iciBandwidthThreshold",
+                 "displayName": "ICI bandwidth threshold",
+                 "description": "Fraction of theoretical ICI bandwidth "
+                                "the collective proof must reach"},
+                {"path": "upgradePolicy.autoUpgrade",
+                 "displayName": "Auto upgrade",
+                 "description": "Allow automatic rolling libtpu upgrades",
+                 "x-descriptors": [
+                     "urn:alm:descriptor:com.tectonic.ui:booleanSwitch"]},
+            ],
+            "statusDescriptors": [
+                {"path": "state", "displayName": "State",
+                 "description": "ignored|ready|notReady|disabled"},
+            ],
+        },
+        {
+            "name": "tpudrivers.tpu.graft.dev",
+            "kind": KIND_TPU_DRIVER,
+            "version": V1ALPHA1.split("/")[-1],
+            "displayName": "TPUDriver",
+            "description": "Per-node-pool libtpu flavor (channel/version "
+                           "per generation x topology pool).",
+            "statusDescriptors": [
+                {"path": "state", "displayName": "State"},
+            ],
+        },
+    ]
+
+
+def render_csv(values: Dict[str, Any]) -> dict:
+    """A real, structurally-complete ClusterServiceVersion for the
+    current version and values-resolved operator image."""
+    from .values import operator_image
+
+    image = operator_image(values)
+    deployment = operator_deployment(
+        values.get("namespace", "tpu-operator"), image,
+        values.get("operator") or {})
+    # OLM owns name/namespace placement; the install strategy embeds only
+    # the Deployment's spec
+    alm_examples = [sample_cluster_policy(), _sample_tpudriver()]
+    return {
+        "apiVersion": "operators.coreos.com/v1alpha1",
+        "kind": "ClusterServiceVersion",
+        "metadata": {
+            "name": f"{PACKAGE_NAME}.v{__version__}",
+            "namespace": "placeholder",
+            "labels": {
+                "operatorframework.io/arch.amd64": "supported",
+                "operatorframework.io/arch.arm64": "supported",
+                "pod-security.kubernetes.io/enforce": "privileged",
+                "pod-security.kubernetes.io/audit": "privileged",
+                "pod-security.kubernetes.io/warn": "privileged",
+            },
+            "annotations": {
+                "alm-examples": json.dumps(alm_examples, indent=2),
+                "capabilities": "Deep Insights",
+                "categories": "AI/Machine Learning, OpenShift Optional",
+                "containerImage": image,
+                "description": "Automates TPU software stack lifecycle "
+                               "management in Kubernetes",
+                "support": PACKAGE_NAME,
+            },
+        },
+        "spec": {
+            "displayName": "TPU Operator",
+            "description": _DESCRIPTION,
+            "keywords": ["tpu", "jax", "xla", "device-plugin",
+                         "accelerator", "operator"],
+            "maintainers": [{"name": "tpu-operator maintainers",
+                             "email": "maintainers@tpu-operator.dev"}],
+            "provider": {"name": PACKAGE_NAME},
+            "links": [{"name": "Source",
+                       "url": "https://github.com/tpu-operator/tpu-operator"}],
+            "maturity": "stable",
+            "version": __version__,
+            "minKubeVersion": "1.27.0",
+            "installModes": [
+                {"type": "OwnNamespace", "supported": True},
+                {"type": "SingleNamespace", "supported": True},
+                {"type": "MultiNamespace", "supported": False},
+                {"type": "AllNamespaces", "supported": False},
+            ],
+            "install": {
+                "strategy": "deployment",
+                "spec": {
+                    "clusterPermissions": [{
+                        "serviceAccountName": "tpu-operator",
+                        "rules": cluster_role()["rules"],
+                    }],
+                    "deployments": [{
+                        "name": "tpu-operator",
+                        "spec": deployment["spec"],
+                    }],
+                },
+            },
+            "customresourcedefinitions": {"owned": _owned_crds()},
+            "relatedImages": [{"name": "tpu-operator", "image": image}],
+        },
+    }
+
+
+def bundle_annotations() -> dict:
+    """metadata/annotations.yaml content of an OLM registry+v1 bundle."""
+    return {
+        "annotations": {
+            "operators.operatorframework.io.bundle.mediatype.v1":
+                "registry+v1",
+            "operators.operatorframework.io.bundle.manifests.v1":
+                "manifests/",
+            "operators.operatorframework.io.bundle.metadata.v1": "metadata/",
+            "operators.operatorframework.io.bundle.package.v1": PACKAGE_NAME,
+            "operators.operatorframework.io.bundle.channels.v1":
+                DEFAULT_CHANNEL,
+            "operators.operatorframework.io.bundle.channel.default.v1":
+                DEFAULT_CHANNEL,
+        },
+    }
+
+
+def render_bundle_stream(values: Dict[str, Any]) -> List[dict]:
+    """The full bundle: CSV + owned CRDs (the manifests/ dir content)
+    followed by the bundle annotations (the metadata/ dir content)."""
+    return [render_csv(values)] + all_crds() + [bundle_annotations()]

@@ -12,7 +12,7 @@ code, so they cannot drift from the API types:
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from .. import __version__
 from ..api.crd import all_crds
@@ -83,39 +83,70 @@ def cluster_role_binding(namespace: str) -> dict:
     }
 
 
-def operator_deployment(namespace: str, image: str) -> dict:
+def operator_deployment(namespace: str, image: str,
+                        op: Optional[dict] = None) -> dict:
+    """The manager Deployment, shaped by the values `operator:` section
+    (the chart-level operator config of the reference's values.yaml:
+    scheduling, resources, leader election, health port)."""
+    op = op or {}
+    port = int(op["healthPort"] if op.get("healthPort") is not None else 8080)
+    command = ["tpu-operator", "--health-port", str(port)]
+    if op.get("leaderElect"):
+        command.append("--leader-elect")
+    container = {
+        "name": "tpu-operator",
+        "image": image,
+        "imagePullPolicy": op.get("imagePullPolicy") or "IfNotPresent",
+        "command": command,
+        "env": [{"name": "OPERATOR_NAMESPACE",
+                 "valueFrom": {"fieldRef": {
+                     "fieldPath": "metadata.namespace"}}}]
+        + list(op.get("env") or []),
+        "ports": [{"name": "metrics", "containerPort": port}],
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": port},
+            "initialDelaySeconds": 10,
+            "periodSeconds": 20},
+        "readinessProbe": {
+            "httpGet": {"path": "/readyz", "port": port},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10},
+    }
+    if op.get("resources"):
+        container["resources"] = op["resources"]
+    pod_spec = {
+        "serviceAccountName": "tpu-operator",
+        "priorityClassName": op.get("priorityClassName")
+        or "system-cluster-critical",
+        "containers": [container],
+    }
+    for values_key, pod_key in (("imagePullSecrets", "imagePullSecrets"),
+                                ("nodeSelector", "nodeSelector"),
+                                ("affinity", "affinity"),
+                                ("tolerations", "tolerations")):
+        if op.get(values_key):
+            val = op[values_key]
+            if values_key == "imagePullSecrets":
+                val = [{"name": s} if isinstance(s, str) else s for s in val]
+            pod_spec[pod_key] = val
+    # "app" is the selector identity — user labels must not break
+    # spec.selector/template agreement (same protection operand renders
+    # give their selector labels)
+    labels = {**(op.get("labels") or {}), "app": "tpu-operator"}
+    meta = {"name": "tpu-operator", "namespace": namespace, "labels": labels}
+    pod_meta: dict = {"labels": labels}
+    if op.get("annotations"):
+        meta["annotations"] = dict(op["annotations"])
+        pod_meta["annotations"] = dict(op["annotations"])
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": {"name": "tpu-operator", "namespace": namespace,
-                     "labels": {"app": "tpu-operator"}},
+        "metadata": meta,
         "spec": {
-            "replicas": 1,
+            "replicas": int(op["replicas"]
+                            if op.get("replicas") is not None else 1),
             "selector": {"matchLabels": {"app": "tpu-operator"}},
-            "template": {
-                "metadata": {"labels": {"app": "tpu-operator"}},
-                "spec": {
-                    "serviceAccountName": "tpu-operator",
-                    "priorityClassName": "system-cluster-critical",
-                    "containers": [{
-                        "name": "tpu-operator",
-                        "image": image,
-                        "command": ["tpu-operator", "--health-port", "8080"],
-                        "env": [{"name": "OPERATOR_NAMESPACE",
-                                 "valueFrom": {"fieldRef": {
-                                     "fieldPath": "metadata.namespace"}}}],
-                        "ports": [{"name": "metrics", "containerPort": 8080}],
-                        "livenessProbe": {
-                            "httpGet": {"path": "/healthz", "port": 8080},
-                            "initialDelaySeconds": 10,
-                            "periodSeconds": 20},
-                        "readinessProbe": {
-                            "httpGet": {"path": "/readyz", "port": 8080},
-                            "initialDelaySeconds": 5,
-                            "periodSeconds": 10},
-                    }],
-                },
-            },
+            "template": {"metadata": pod_meta, "spec": pod_spec},
         },
     }
 

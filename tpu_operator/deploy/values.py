@@ -123,7 +123,8 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
         service_account(ns),
         cluster_role(),
         cluster_role_binding(ns),
-        operator_deployment(ns, operator_image(values)),
+        operator_deployment(ns, operator_image(values),
+                            values.get("operator") or {}),
     ])
     cr = render_cluster_policy(values)
     if cr is not None:
@@ -131,28 +132,5 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
     return docs
 
 
-def render_bundle_metadata(values: Dict[str, Any]) -> dict:
-    """OLM CSV-slot metadata (bundle/ analog): what this bundle installs,
-    which CRDs it owns, and the images it references — the facts the
-    reference's ClusterServiceVersion carries."""
-    from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
-
-    return {
-        "apiVersion": "tpu.graft.dev/v1",
-        "kind": "BundleMetadata",
-        "metadata": {"name": f"tpu-operator.v{__version__}"},
-        "spec": {
-            "version": __version__,
-            "displayName": "TPU Operator",
-            "provider": "tpu-operator",
-            "customresourcedefinitions": {
-                "owned": [
-                    {"kind": KIND_CLUSTER_POLICY, "version": V1,
-                     "name": "tpuclusterpolicies.tpu.graft.dev"},
-                    {"kind": KIND_TPU_DRIVER, "version": V1ALPHA1,
-                     "name": "tpudrivers.tpu.graft.dev"},
-                ],
-            },
-            "relatedImages": [operator_image(values)],
-        },
-    }
+# the former render_bundle_metadata (a custom BundleMetadata blob) is
+# replaced by deploy/csv.py's real ClusterServiceVersion bundle
