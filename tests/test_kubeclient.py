@@ -137,6 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         self._record(body)
         u = urlparse(self.path)
+        if u.path.endswith("/eviction"):
+            return self._serve_eviction(u.path[:-len("/eviction")])
         name = (body.get("metadata") or {}).get("name")
         path = f"{u.path.rstrip('/')}/{name}"
         if path in self.state.objects:
@@ -146,6 +148,48 @@ class _Handler(BaseHTTPRequestHandler):
         body.setdefault("metadata", {})["resourceVersion"] = str(self.state.rv)
         self.state.objects[path] = body
         self._send(201, body)
+
+    def _serve_eviction(self, pod_path):
+        """pods/eviction subresource: enforce PodDisruptionBudgets the way
+        the real apiserver does — 429 while the budget allows no
+        disruptions, else delete the pod."""
+        target = self.state.objects.get(pod_path)
+        if target is None:
+            return self._not_found()
+        ns = (target.get("metadata") or {}).get("namespace", "")
+        pod_labels = (target.get("metadata") or {}).get("labels") or {}
+        pdb_prefix = f"/apis/policy/v1/namespaces/{ns}/poddisruptionbudgets/"
+
+        def ready(p):
+            return any(c.get("type") == "Ready" and c.get("status") == "True"
+                       for c in (p.get("status") or {}).get(
+                           "conditions") or [])
+
+        for path, pdb in list(self.state.objects.items()):
+            if not path.startswith(pdb_prefix):
+                continue
+            sel = ((pdb.get("spec") or {}).get("selector")
+                   or {}).get("matchLabels") or {}
+            if not sel or not all(pod_labels.get(k) == v
+                                  for k, v in sel.items()):
+                continue
+            allowed = (pdb.get("status") or {}).get("disruptionsAllowed")
+            if allowed is None:
+                pods = [o for p, o in self.state.objects.items()
+                        if p.startswith(f"/api/v1/namespaces/{ns}/pods/")
+                        and all(((o.get("metadata") or {}).get("labels")
+                                 or {}).get(k) == v for k, v in sel.items())]
+                healthy = sum(1 for p in pods if ready(p))
+                min_avail = (pdb.get("spec") or {}).get("minAvailable", 0)
+                allowed = healthy - int(min_avail)
+            if allowed <= 0:
+                return self._send(429, {
+                    "kind": "Status", "status": "Failure",
+                    "reason": "TooManyRequests", "code": 429,
+                    "message": "Cannot evict pod as it would violate the "
+                               "pod's disruption budget."})
+        del self.state.objects[pod_path]
+        self._send(201, {"kind": "Status", "status": "Success"})
 
     def do_PUT(self):
         body = self._read_body()
@@ -353,6 +397,45 @@ class TestCRUD:
         assert apiserver.objects[
             "/api/v1/namespaces/tpu-operator/pods/p10"
         ]["spec"]["restartPolicy"] == "Always"
+
+
+# --------------------------------------------------------------------------
+# eviction subresource (drain path of the upgrade controller)
+# --------------------------------------------------------------------------
+
+
+class TestEviction:
+    def test_evict_posts_to_subresource_and_deletes(self, apiserver, client):
+        client.create(pod("victim"))
+        client.evict("victim")
+        method, path, _, _, body = apiserver.requests[-1]
+        assert (method, path) == (
+            "POST", "/api/v1/namespaces/tpu-operator/pods/victim/eviction")
+        assert body["kind"] == "Eviction"
+        assert "/api/v1/namespaces/tpu-operator/pods/victim" \
+            not in apiserver.objects
+
+    def test_evict_blocked_by_pdb_raises_429(self, apiserver, client):
+        from tpu_operator.runtime.client import EvictionBlockedError
+
+        p = pod("guarded", labels={"app": "g"})
+        p["status"] = {"conditions": [{"type": "Ready", "status": "True"}]}
+        client.create(p)
+        apiserver.objects[
+            "/apis/policy/v1/namespaces/tpu-operator/"
+            "poddisruptionbudgets/guard"] = {
+            "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": "guard", "namespace": "tpu-operator"},
+            "spec": {"selector": {"matchLabels": {"app": "g"}},
+                     "minAvailable": 1}}
+        with pytest.raises(EvictionBlockedError):
+            client.evict("guarded")
+        # pod survived the denied eviction
+        assert client.get_or_none("v1", "Pod", "guarded") is not None
+
+    def test_evict_missing_pod_raises_not_found(self, client):
+        with pytest.raises(NotFoundError):
+            client.evict("ghost")
 
 
 # --------------------------------------------------------------------------

@@ -1,5 +1,7 @@
 """Upgrade controller: per-node FSM, budget, drain semantics
-(upgrade_controller.go tier)."""
+(upgrade_controller.go tier) — plus the TPU-specific slice-grouped and
+failure-path semantics (eviction drain with PDBs + deadlines into
+`failed`)."""
 
 from tpu_operator.api import V1, KIND_CLUSTER_POLICY, new_cluster_policy
 from tpu_operator.api import labels as L
@@ -8,6 +10,8 @@ from tpu_operator.controllers.clusterpolicy_controller import (
 )
 from tpu_operator.controllers.upgrade_controller import (
     STATE_DONE,
+    STATE_DRAIN,
+    STATE_FAILED,
     STATE_UPGRADE_REQUIRED,
     STATE_VALIDATION,
     UpgradeReconciler,
@@ -165,6 +169,341 @@ class TestUpgradeFSM:
         # and all driver pods are on the new revision + nodes schedulable
         for node in c.list("v1", "Node"):
             assert not get_nested(node, "spec", "unschedulable", default=False)
+
+
+def build_mixed_cluster(auto_upgrade=True, max_parallel=1):
+    """2-host v5p slice (multi-host: 2x2x2 = 8 chips > 4/host) sharing one
+    gke-nodepool, plus one independent single-host node."""
+    c = FakeClient()
+    for name in ("slice-h0", "slice-h1"):
+        c.add_node(name, labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x2",
+            L.GKE_NODEPOOL: "pool-slice-a",
+            L.GKE_ACCELERATOR_COUNT: "4"},
+            allocatable={"google.com/tpu": "4"})
+    c.add_node("z-single-0", labels={
+        L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+        L.GKE_TPU_TOPOLOGY: "2x2x1",
+        L.GKE_ACCELERATOR_COUNT: "4"},
+        allocatable={"google.com/tpu": "4"})
+    c.create(new_cluster_policy(spec={
+        "upgradePolicy": {"autoUpgrade": auto_upgrade,
+                          "maxParallelUpgrades": max_parallel}}))
+    prec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    prec.reconcile(Request(name="tpu-cluster-policy"))
+    c.simulate_kubelet(ready=True)
+    prec.reconcile(Request(name="tpu-cluster-policy"))
+    return c, prec
+
+
+def node_state(c, name):
+    return labels_of(c.get("v1", "Node", name)).get(L.UPGRADE_STATE)
+
+
+class TestSliceGroupedUpgrades:
+    """Multi-host slices move through the FSM as ONE unit: no slice ever
+    runs mixed libtpu versions across its hosts (SURVEY.md section 7
+    grouped-readiness hard part; VERDICT r2 item 3)."""
+
+    def test_slice_hosts_move_together(self):
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        # budget=1: the slice (one unit) starts; the single host must wait
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_VALIDATION
+        assert node_state(c, "slice-h1") == STATE_VALIDATION
+        assert node_state(c, "z-single-0") == STATE_UPGRADE_REQUIRED
+        # both slice hosts cordoned, both driver pods deleted together
+        for name in ("slice-h0", "slice-h1"):
+            assert get_nested(c.get("v1", "Node", name), "spec",
+                              "unschedulable") is True
+        assert all(get_nested(p, "spec", "nodeName") == "z-single-0"
+                   for p in driver_pods(c))
+        # kubelet recreates on the new revision -> both validate together,
+        # then the single host takes its turn
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_DONE
+        assert node_state(c, "slice-h1") == STATE_DONE
+        for _ in range(4):
+            rec.reconcile(Request(name="tpu-cluster-policy"))
+            c.simulate_kubelet(ready=True)
+        assert node_state(c, "z-single-0") == STATE_DONE
+
+    def test_slice_never_half_validated(self):
+        """If one host of the slice fails to re-prove, the whole unit
+        stays in validation — the upgraded host is NOT uncordoned alone."""
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        c.simulate_kubelet(ready=True)
+        # force h1's recreated validator NotReady
+        for pod in rec._validator_pods_by_node().get("slice-h1", []):
+            for cond in get_nested(pod, "status", "conditions",
+                                   default=[]) or []:
+                if cond.get("type") == "Ready":
+                    cond["status"] = "False"
+            c.update(pod)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_VALIDATION
+        assert node_state(c, "slice-h1") == STATE_VALIDATION
+        # h0 stays cordoned while its slice sibling is unproven
+        assert get_nested(c.get("v1", "Node", "slice-h0"), "spec",
+                          "unschedulable") is True
+
+    def test_budget_counts_units_not_nodes(self):
+        """maxParallelUpgrades=1 still lets a whole 2-host slice proceed
+        at once (it is one unit), where 2 independent hosts could not."""
+        c, prec = build_mixed_cluster(max_parallel=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        in_flight = [n for n in ("slice-h0", "slice-h1", "z-single-0")
+                     if node_state(c, n) == STATE_VALIDATION]
+        assert sorted(in_flight) == ["slice-h0", "slice-h1"]
+
+    def test_healing_diverged_member_label(self):
+        """A wiped member label re-syncs to the unit's earliest stage
+        instead of letting hosts drift apart."""
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        c.patch("v1", "Node", "slice-h1",
+                {"metadata": {"labels": {L.UPGRADE_STATE: None}}})
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # the unit converges: both hosts end in the same state
+        assert node_state(c, "slice-h0") == node_state(c, "slice-h1")
+
+
+def add_tpu_pod(c, name, node, labels=None, ready=True):
+    conditions = [{"type": "Ready", "status": "True" if ready else "False"}]
+    c.create({"apiVersion": "v1", "kind": "Pod",
+              "metadata": {"name": name, "namespace": "default",
+                           "labels": labels or {}},
+              "spec": {"nodeName": node,
+                       "containers": [{
+                           "name": "t",
+                           "resources": {"requests":
+                                         {"google.com/tpu": "4"}}}]},
+              "status": {"phase": "Running", "conditions": conditions}})
+
+
+class TestEvictionDrain:
+    """Drain goes through the Eviction API: PodDisruptionBudgets block it
+    (429) until the drain deadline, which forces or fails per policy
+    (upgrade_controller.go:157-187 drain-spec semantics)."""
+
+    def pdb(self, c, match, min_available=1):
+        c.create({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                  "metadata": {"name": "guard", "namespace": "default"},
+                  "spec": {"selector": {"matchLabels": match},
+                           "minAvailable": min_available}})
+
+    def test_pdb_blocks_drain_until_timeout_then_failed(self):
+        clock = [1000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
+        self.pdb(c, {"app": "guarded"})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # eviction blocked: still draining, pod alive, node cordoned
+        assert node_state(c, "tpu-0") == STATE_DRAIN
+        assert c.get_or_none("v1", "Pod", "guarded", "default") is not None
+        # past the drain deadline without drainForce -> failed
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        anns = c.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert "drain timed out" in anns[L.UPGRADE_FAILED_REASON]
+        assert c.get_or_none("v1", "Pod", "guarded", "default") is not None
+
+    def test_drain_force_deletes_at_deadline(self):
+        clock = [1000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"]["drainForce"] = True
+        c.update(cr)
+        add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
+        self.pdb(c, {"app": "guarded"})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DRAIN
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # force kicked in: pod deleted, FSM moved on past drain
+        assert c.get_or_none("v1", "Pod", "guarded", "default") is None
+        assert node_state(c, "tpu-0") == STATE_VALIDATION
+
+    def test_eviction_proceeds_when_pdb_has_headroom(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        add_tpu_pod(c, "a", "tpu-0", labels={"app": "multi"})
+        # a second READY replica elsewhere keeps the budget satisfied
+        c.add_node("other", labels={L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+                                    L.GKE_TPU_TOPOLOGY: "2x2x1"})
+        add_tpu_pod(c, "b", "other", labels={"app": "multi"})
+        self.pdb(c, {"app": "multi"}, min_available=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert c.get_or_none("v1", "Pod", "a", "default") is None
+        assert c.get_or_none("v1", "Pod", "b", "default") is not None
+
+    def test_drain_respects_custom_timeout(self):
+        clock = [0.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"]["drainTimeoutSeconds"] = 10
+        c.update(cr)
+        add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
+        self.pdb(c, {"app": "guarded"})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DRAIN
+        clock[0] += 11
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+
+
+class TestUpgradeFailureSemantics:
+    """STATE_FAILED is reachable, alertable, and recoverable: validation
+    deadlines fail the node; failed nodes retry after backoff (VERDICT r2
+    weak 3 / item 4)."""
+
+    def test_validation_timeout_drives_node_to_failed(self):
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_VALIDATION
+        # the validator never re-proves (no kubelet recreation). Before
+        # the deadline: still validating
+        clock[0] += 100
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_VALIDATION
+        clock[0] += 250  # past validationTimeoutSeconds=300
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        anns = c.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert "validation timed out" in anns[L.UPGRADE_FAILED_REASON]
+
+    def test_failed_node_retries_after_backoff_and_recovers(self):
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        # within backoff: stays failed
+        clock[0] += 10
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        # past failedRetryBackoffSeconds=60: re-enters the FSM; with the
+        # kubelet recreating pods the retry completes the upgrade
+        clock[0] += 60
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DONE
+        anns = c.get("v1", "Node", "tpu-0")["metadata"].get(
+            "annotations") or {}
+        assert L.UPGRADE_FAILED_REASON not in anns
+
+    def test_failed_state_surfaced_in_metrics(self):
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        gauge = OPERATOR_METRICS.upgrade_state_nodes.labels(
+            state=STATE_FAILED)
+        assert gauge._value.get() == 1
+
+    def test_whole_slice_fails_and_retries_together(self):
+        clock = [5000.0]
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_FAILED
+        assert node_state(c, "slice-h1") == STATE_FAILED
+        clock[0] += 61
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_DONE
+        assert node_state(c, "slice-h1") == STATE_DONE
+
+
+class TestFailureReleaseAndHealing:
+    def test_disabling_upgrade_uncordons_failed_node(self):
+        """A failed node stays cordoned while the FSM owns it, but turning
+        autoUpgrade off must release the cordon along with the label."""
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        assert get_nested(c.get("v1", "Node", "tpu-0"), "spec",
+                          "unschedulable") is True
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["upgradePolicy"]["autoUpgrade"] = False
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert L.UPGRADE_STATE not in labels_of(node)
+        assert not get_nested(node, "spec", "unschedulable", default=False)
+
+    def test_unstamped_drain_state_still_times_out(self):
+        """A drain-required label with no stage-started annotation (older
+        operator version / recreated Node) must not wedge: the controller
+        stamps a deadline on first sight and the timeout then fires."""
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
+        c.create({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                  "metadata": {"name": "guard", "namespace": "default"},
+                  "spec": {"selector": {"matchLabels": {"app": "guarded"}},
+                           "minAvailable": 1}})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        # simulate the legacy state: label written, stamp missing
+        c.patch("v1", "Node", "tpu-0",
+                {"metadata": {"labels": {L.UPGRADE_STATE: STATE_DRAIN}},
+                 "spec": {"unschedulable": True}})
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DRAIN  # stamped, waiting
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
 
 
 class TestPerNodeUpgradeOptOut:

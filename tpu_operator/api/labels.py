@@ -39,6 +39,12 @@ FEATURE_LABELS = (TPU_TOPOLOGY, TPU_ACCELERATOR, TPU_MEMORY_GB,
                   TPU_ICI_GBPS, TPU_MULTIHOST, LIBTPU_VERSION)
 UPGRADE_STATE = f"{DOMAIN}/upgrade.state"         # upgrade controller FSM label
 UPGRADE_SKIP_DRAIN = f"{DOMAIN}/upgrade.skip-drain"
+# epoch timestamp annotation stamped when a node enters a deadline-bearing
+# FSM stage (drain-required, validation-required); the controller times
+# the stage out into `failed` against it
+UPGRADE_STAGE_STARTED = f"{DOMAIN}/upgrade.stage-started"
+UPGRADE_FAILED_AT = f"{DOMAIN}/upgrade.failed-at"       # epoch of failure
+UPGRADE_FAILED_REASON = f"{DOMAIN}/upgrade.failed-reason"
 
 # --- annotations ----------------------------------------------------------
 LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog

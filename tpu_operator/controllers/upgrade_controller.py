@@ -7,16 +7,35 @@ controller walks each node through a safety FSM persisted in the
 ``tpu.graft.dev/upgrade.state`` node label:
 
     upgrade-required -> cordon-required -> drain-required ->
-    pod-restart-required -> validation-required -> uncordon-required -> done
+    pod-restart-required -> validation-required -> uncordon-required ->
+    done   (drain/validation deadlines branch to `failed`, retried with
+    backoff)
 
-Concurrency is bounded by upgradePolicy.maxParallelUpgrades; TPU-consuming
-pods are evicted during drain unless they carry the skip-drain label
-(upgrade_controller.go:127-187 semantics).
+Two behaviors the reference's per-node walk never needed (SURVEY.md
+section 7 "genuinely new design"):
+
+- **Slice-grouped upgrades.** Multi-host slices (one v5p-64 = 16 hosts
+  wired by ICI) must never run mixed libtpu versions: the FSM's unit of
+  progress is an *upgrade unit* — all hosts of a multi-host slice (keyed
+  by accelerator x topology x gke-nodepool, matching
+  topology/manager.py's grouped agreement), or a single host elsewhere.
+  Every node of a unit transitions together, and
+  upgradePolicy.maxParallelUpgrades counts units, not nodes.
+- **Eviction-based drain with a failure path.** Drain goes through the
+  Eviction API (client.evict), which PodDisruptionBudgets can block; the
+  drain deadline (drainTimeoutSeconds) then either falls back to pod
+  deletion (drainForce) or fails the unit. Validation likewise times out
+  (validationTimeoutSeconds) into `failed` — reachable, alertable via
+  tpu_operator_upgrade_state_nodes{state="failed"}, and retried after
+  failedRetryBackoffSeconds (upgrade_controller.go:157-187 drain-spec
+  semantics).
 """
 
 from __future__ import annotations
 
 import logging
+import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..api import labels as L
@@ -24,6 +43,7 @@ from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, TPUClusterPolicySpec
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime import (
     Controller,
+    EvictionBlockedError,
     Manager,
     Reconciler,
     Request,
@@ -34,6 +54,7 @@ from ..runtime import (
 )
 from ..runtime.client import ListOptions, NotFoundError
 from ..runtime.objects import get_nested, labels_of, name_of, namespace_of
+from ..state.nodepool import get_node_pools
 from ..utils.hash import object_hash
 
 log = logging.getLogger("tpu_operator.upgrade")
@@ -53,6 +74,13 @@ STATE_FAILED = "failed"
 # states that count against the parallel-upgrade budget
 IN_PROGRESS_STATES = {STATE_CORDON, STATE_DRAIN, STATE_POD_RESTART,
                       STATE_VALIDATION, STATE_UNCORDON}
+
+# stage ordering used to heal a unit whose members diverged (a wiped
+# label, an operator restart mid-transition): the unit resumes from the
+# EARLIEST stage any member is in
+_STAGE_ORDER = [STATE_UPGRADE_REQUIRED, STATE_CORDON, STATE_DRAIN,
+                STATE_POD_RESTART, STATE_VALIDATION, STATE_UNCORDON,
+                STATE_DONE]
 
 
 def desired_revision(client, ds: dict) -> str:
@@ -75,12 +103,41 @@ def desired_revision(client, ds: dict) -> str:
     return object_hash(get_nested(ds, "spec", "template", default={}))
 
 
+@dataclass
+class _Member:
+    """One node's view within an upgrade unit."""
+
+    node: dict
+    pod: Optional[dict]          # its driver pod (None = nothing to upgrade)
+    want: Optional[str]          # desired driver revision
+    have: Optional[str]          # running driver revision
+    pod_ready: bool
+
+    @property
+    def name(self) -> str:
+        return name_of(self.node)
+
+    @property
+    def state(self) -> Optional[str]:
+        return labels_of(self.node).get(L.UPGRADE_STATE)
+
+    @property
+    def upgraded(self) -> bool:
+        return self.pod is None or (self.have == self.want and self.pod_ready)
+
+    @property
+    def at_new_revision(self) -> bool:
+        return self.pod is None or self.have == self.want
+
+
 class UpgradeReconciler(Reconciler):
     name = "tpu-upgrade"
 
-    def __init__(self, client, namespace: str = "tpu-operator"):
+    def __init__(self, client, namespace: str = "tpu-operator",
+                 now=time.time):
         self.client = client
         self.namespace = namespace
+        self.now = now  # injectable clock for deadline tests
 
     def setup_controller(self, controller: Controller, manager: Manager):
         controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed,
@@ -101,15 +158,17 @@ class UpgradeReconciler(Reconciler):
                         label_selector={"tpu.graft.dev/component":
                                         "libtpu-driver"}))
 
-    def _driver_pod_on(self, node_name: str) -> Optional[dict]:
+    def _driver_pods_by_node(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
         for pod in self.client.list(
                 "v1", "Pod",
                 ListOptions(namespace=self.namespace,
                             label_selector={"tpu.graft.dev/component":
                                             "libtpu-driver"})):
-            if get_nested(pod, "spec", "nodeName") == node_name:
-                return pod
-        return None
+            node = get_nested(pod, "spec", "nodeName")
+            if node:
+                out[node] = pod
+        return out
 
     VALIDATOR_APPS = ("tpu-operator-validator", "tpu-isolated-validator")
 
@@ -157,6 +216,8 @@ class UpgradeReconciler(Reconciler):
         for pod in self.client.list("v1", "Pod"):
             if get_nested(pod, "spec", "nodeName") != node_name:
                 continue
+            if get_nested(pod, "metadata", "deletionTimestamp"):
+                continue
             if labels_of(pod).get(L.UPGRADE_SKIP_DRAIN) == "true":
                 continue
             if labels_of(pod).get("tpu.graft.dev/component") == "libtpu-driver":
@@ -174,22 +235,34 @@ class UpgradeReconciler(Reconciler):
                 out.append(pod)
         return out
 
+    # -- node label/annotation writes --------------------------------------
+
     def _set_node_state(self, node: dict, state: Optional[str]) -> None:
         self.client.patch("v1", "Node", name_of(node),
                           {"metadata": {"labels": {L.UPGRADE_STATE: state}}})
+
+    def _annotate(self, node: dict, **kv) -> None:
+        self.client.patch("v1", "Node", name_of(node),
+                          {"metadata": {"annotations": dict(kv)}})
 
     def _cordon(self, node: dict, on: bool) -> None:
         self.client.patch("v1", "Node", name_of(node),
                           {"spec": {"unschedulable": True if on else None}})
 
     def _release_node(self, node: dict) -> None:
-        """Strip a node's FSM label and undo any cordon the FSM applied —
-        a node paused mid-rollout (after STATE_CORDON, before
-        STATE_UNCORDON) must not be left unschedulable forever."""
+        """Strip a node's FSM label/annotations and undo any cordon the
+        FSM applied — a node paused mid-rollout (after STATE_CORDON,
+        before STATE_UNCORDON) must not be left unschedulable forever."""
         state = labels_of(node).get(L.UPGRADE_STATE)
-        if state in IN_PROGRESS_STATES and get_nested(
+        # any FSM-owned state may hold a cordon (failed units stay
+        # cordoned; a retrying unit can sit in upgrade-required cordoned
+        # while the budget is full) — DONE already uncordoned
+        if state not in (None, STATE_DONE) and get_nested(
                 node, "spec", "unschedulable", default=False):
             self._cordon(node, False)
+        self._annotate(node, **{L.UPGRADE_STAGE_STARTED: None,
+                                L.UPGRADE_FAILED_AT: None,
+                                L.UPGRADE_FAILED_REASON: None})
         self._set_node_state(node, None)
 
     def remove_upgrade_state_labels(self) -> None:
@@ -198,6 +271,80 @@ class UpgradeReconciler(Reconciler):
         for node in self.client.list("v1", "Node"):
             if L.UPGRADE_STATE in labels_of(node):
                 self._release_node(node)
+
+    # -- unit machinery ----------------------------------------------------
+
+    def _upgrade_units(self, nodes: Dict[str, dict]) -> List[List[str]]:
+        """Partition eligible nodes into upgrade units: every host of a
+        multi-host slice moves as one unit (slice identity = accelerator x
+        topology x gke-nodepool, the same grouping topology/manager.py
+        uses for grouped slice-config agreement); single-host nodes are
+        their own unit."""
+        units: List[List[str]] = []
+        grouped = set()
+        for pool in get_node_pools(list(nodes.values())):
+            if pool.multi_host:
+                by_slice: Dict[str, List[str]] = {}
+                for node_name in pool.nodes:
+                    slice_id = labels_of(nodes[node_name]).get(
+                        L.GKE_NODEPOOL, pool.name)
+                    by_slice.setdefault(slice_id, []).append(node_name)
+                for _, members in sorted(by_slice.items()):
+                    units.append(sorted(members))
+            else:
+                for node_name in pool.nodes:
+                    units.append([node_name])
+            grouped.update(pool.nodes)
+        # nodes outside any TPU pool (no accelerator label) can still run
+        # a driver pod in odd setups; treat them as singleton units
+        for name in sorted(set(nodes) - grouped):
+            units.append([name])
+        units.sort(key=lambda u: u[0])
+        return units
+
+    def _unit_state(self, members: List[_Member]) -> Optional[str]:
+        """Aggregate FSM state of a unit: failed dominates; otherwise the
+        earliest stage any member is in (heals divergence after partial
+        writes/restarts)."""
+        states = [m.state for m in members]
+        if any(s == STATE_FAILED for s in states):
+            return STATE_FAILED
+        present = [s for s in states if s in _STAGE_ORDER]
+        if not present:
+            return None
+        return min(present, key=_STAGE_ORDER.index)
+
+    def _set_unit_state(self, members: List[_Member], state: str) -> None:
+        for m in members:
+            if m.state != state:
+                self._set_node_state(m.node, state)
+
+    def _stage_started(self, members: List[_Member]) -> Optional[float]:
+        stamps = []
+        for m in members:
+            v = (get_nested(m.node, "metadata", "annotations",
+                            default={}) or {}).get(L.UPGRADE_STAGE_STARTED)
+            try:
+                stamps.append(float(v))
+            except (TypeError, ValueError):
+                pass
+        return min(stamps) if stamps else None
+
+    def _stamp_stage(self, members: List[_Member]) -> None:
+        stamp = str(self.now())
+        for m in members:
+            self._annotate(m.node, **{L.UPGRADE_STAGE_STARTED: stamp})
+
+    def _fail_unit(self, members: List[_Member], reason: str) -> None:
+        stamp = str(self.now())
+        log.error("upgrade unit [%s] failed: %s",
+                  ",".join(m.name for m in members), reason)
+        for m in members:
+            self._annotate(m.node, **{L.UPGRADE_FAILED_AT: stamp,
+                                      L.UPGRADE_FAILED_REASON: reason,
+                                      L.UPGRADE_STAGE_STARTED: None})
+        self._set_unit_state(members, STATE_FAILED)
+        OPERATOR_METRICS.driver_upgrades_failed.inc()
 
     # -- reconcile ---------------------------------------------------------
 
@@ -224,27 +371,30 @@ class UpgradeReconciler(Reconciler):
         if not daemonsets:
             return Result(requeue_after=REQUEUE_PERIODIC_S)
 
-        # classify every node that runs (or should run) a driver pod
-        node_states: Dict[str, str] = {}
         nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
         revisions = {name_of(ds): desired_revision(self.client, ds)
                      for ds in daemonsets}
-        in_progress = sum(
-            1 for n in nodes.values()
-            if labels_of(n).get(L.UPGRADE_STATE) in IN_PROGRESS_STATES)
-        budget = max(1, policy.max_parallel_upgrades or 1)
-        # cluster-invariant lookups hoisted out of the node loop
+        driver_pods = self._driver_pods_by_node()
         validator_pods = self._validator_pods_by_node()
         validator_gate_deployed = self._validator_ds_exists()
 
-        for node_name, node in sorted(nodes.items()):
-            # per-node pause: the policy reconciler stamps this annotation
-            # "true" on TPU nodes while autoUpgrade is on; an operator
-            # setting it to anything else on a node excludes that node
-            # from the rollout without touching the CR
-            # (driverAutoUpgradeAnnotationKey contract,
-            # state_manager.go:423-477). Absent = eligible, so the
-            # controller also works driven standalone.
+        drain_timeout = (policy.drain_timeout_seconds
+                         if policy.drain_timeout_seconds is not None else 300)
+        validation_timeout = (policy.validation_timeout_seconds
+                              if policy.validation_timeout_seconds is not None
+                              else 300)
+        retry_backoff = (policy.failed_retry_backoff_seconds
+                         if policy.failed_retry_backoff_seconds is not None
+                         else 60)
+
+        # eligible = opted-in nodes (per-node pause: the policy reconciler
+        # stamps this annotation "true" on TPU nodes while autoUpgrade is
+        # on; any other explicit value excludes the node without touching
+        # the CR — driverAutoUpgradeAnnotationKey contract,
+        # state_manager.go:423-477. Absent = eligible, so the controller
+        # also works driven standalone.)
+        eligible: Dict[str, dict] = {}
+        for node_name, node in nodes.items():
             anns = get_nested(node, "metadata", "annotations",
                               default={}) or {}
             optin = anns.get(L.DRIVER_UPGRADE_ENABLED)
@@ -252,92 +402,216 @@ class UpgradeReconciler(Reconciler):
                 if labels_of(node).get(L.UPGRADE_STATE):
                     self._release_node(node)
                 continue
-            pod = self._driver_pod_on(node_name)
-            if pod is None:
-                continue
-            ds_name = next((o.get("name") for o in
-                            get_nested(pod, "metadata", "ownerReferences",
-                                       default=[]) or []
-                            if o.get("kind") == "DaemonSet"), None)
-            want = revisions.get(ds_name)
-            have = labels_of(pod).get("controller-revision-hash")
-            state = labels_of(node).get(L.UPGRADE_STATE)
-            pod_ready = self._pod_ready(pod)
+            eligible[node_name] = node
 
-            if want is None:
-                continue
-            if have == want and state in (None, STATE_DONE):
-                if state != STATE_DONE and state is not None:
-                    self._set_node_state(node, STATE_DONE)
-                node_states[node_name] = STATE_DONE
-                continue
+        def member_of(node_name: str) -> _Member:
+            node = eligible[node_name]
+            pod = driver_pods.get(node_name)
+            want = have = None
+            pod_ready = False
+            if pod is not None:
+                ds_name = next((o.get("name") for o in
+                                get_nested(pod, "metadata", "ownerReferences",
+                                           default=[]) or []
+                                if o.get("kind") == "DaemonSet"), None)
+                want = revisions.get(ds_name)
+                have = labels_of(pod).get("controller-revision-hash")
+                pod_ready = self._pod_ready(pod)
+                if want is None:
+                    pod = None  # not one of ours; nothing to upgrade
+            return _Member(node=node, pod=pod, want=want, have=have,
+                           pod_ready=pod_ready)
 
-            # FSM advance (multiple safe steps per pass)
-            if state in (None, STATE_DONE) and have != want:
-                state = STATE_UPGRADE_REQUIRED
-                self._set_node_state(node, state)
-            if state == STATE_UPGRADE_REQUIRED:
-                if in_progress >= budget:
-                    node_states[node_name] = state
-                    continue
-                in_progress += 1
-                state = STATE_CORDON
-                self._set_node_state(node, state)
-            if state == STATE_CORDON:
-                self._cordon(node, True)
-                state = STATE_DRAIN
-                self._set_node_state(node, state)
-            if state == STATE_DRAIN:
-                victims = (self._tpu_workload_pods_on(node_name)
-                           if policy.drain_enable in (None, True) else [])
-                for v in victims:
+        units = [[member_of(n) for n in unit]
+                 for unit in self._upgrade_units(eligible)]
+        # drop units with nothing to upgrade-manage at all
+        units = [u for u in units
+                 if any(m.pod is not None for m in u)
+                 or any(m.state for m in u)]
+
+        budget = max(1, policy.max_parallel_upgrades or 1)
+        in_progress_units = sum(
+            1 for u in units if self._unit_state(u) in IN_PROGRESS_STATES)
+
+        node_states: Dict[str, str] = {}
+
+        def record(members: List[_Member], state: str) -> None:
+            for m in members:
+                node_states[m.name] = state
+
+        for members in units:
+            state = self._unit_state(members)
+            needs = any(not m.at_new_revision for m in members)
+
+            if state == STATE_FAILED:
+                # retry with backoff: failed -> upgrade-required
+                failed_ats = []
+                for m in members:
+                    v = (get_nested(m.node, "metadata", "annotations",
+                                    default={}) or {}).get(L.UPGRADE_FAILED_AT)
                     try:
-                        self.client.delete("v1", "Pod", name_of(v),
-                                           namespace_of(v) or None)
-                        log.info("drained pod %s/%s from %s",
-                                 namespace_of(v), name_of(v), node_name)
-                    except NotFoundError:
+                        failed_ats.append(float(v))
+                    except (TypeError, ValueError):
                         pass
-                state = STATE_POD_RESTART
-                self._set_node_state(node, state)
+                failed_at = max(failed_ats) if failed_ats else 0.0
+                if self.now() - failed_at >= retry_backoff:
+                    log.info("retrying failed upgrade unit [%s]",
+                             ",".join(m.name for m in members))
+                    for m in members:
+                        self._annotate(m.node,
+                                       **{L.UPGRADE_FAILED_AT: None,
+                                          L.UPGRADE_FAILED_REASON: None})
+                    state = STATE_UPGRADE_REQUIRED
+                    self._set_unit_state(members, state)
+                else:
+                    record(members, STATE_FAILED)
+                    continue
+
+            if not needs and state in (None, STATE_DONE):
+                for m in members:
+                    if m.state is not None and m.state != STATE_DONE:
+                        self._set_node_state(m.node, STATE_DONE)
+                record(members, STATE_DONE)
+                continue
+
+            # FSM advance (multiple safe steps per pass), unit-atomic
+            if state in (None, STATE_DONE) and needs:
+                state = STATE_UPGRADE_REQUIRED
+                self._set_unit_state(members, state)
+            if state == STATE_UPGRADE_REQUIRED:
+                if in_progress_units >= budget:
+                    record(members, state)
+                    continue
+                in_progress_units += 1
+                state = STATE_CORDON
+                self._set_unit_state(members, state)
+            if state == STATE_CORDON:
+                for m in members:
+                    self._cordon(m.node, True)
+                self._stamp_stage(members)
+                state = STATE_DRAIN
+                self._set_unit_state(members, state)
+            if state == STATE_DRAIN:
+                remaining = 0
+                blocked: List[str] = []
+                if policy.drain_enable in (None, True):
+                    for m in members:
+                        for victim in self._tpu_workload_pods_on(m.name):
+                            try:
+                                self.client.evict(name_of(victim),
+                                                  namespace_of(victim) or None)
+                                log.info("evicted pod %s/%s from %s",
+                                         namespace_of(victim),
+                                         name_of(victim), m.name)
+                            except EvictionBlockedError as e:
+                                remaining += 1
+                                blocked.append(str(e))
+                            except NotFoundError:
+                                pass
+                if remaining == 0:
+                    state = STATE_POD_RESTART
+                    self._set_unit_state(members, state)
+                else:
+                    started = self._stage_started(members)
+                    if started is None:
+                        # no stamp (pre-existing label from an older
+                        # operator, or a recreated Node object): persist
+                        # one so the deadline actually elapses
+                        self._stamp_stage(members)
+                        started = self.now()
+                    if self.now() - started > drain_timeout:
+                        if policy.drain_force:
+                            # deadline passed and the policy says go:
+                            # bypass the budget via direct deletion
+                            for m in members:
+                                for victim in self._tpu_workload_pods_on(
+                                        m.name):
+                                    try:
+                                        self.client.delete(
+                                            "v1", "Pod", name_of(victim),
+                                            namespace_of(victim) or None)
+                                    except NotFoundError:
+                                        pass
+                            log.warning(
+                                "drain deadline passed on unit [%s]; "
+                                "force-deleted remaining TPU pods",
+                                ",".join(m.name for m in members))
+                            state = STATE_POD_RESTART
+                            self._set_unit_state(members, state)
+                        else:
+                            self._fail_unit(
+                                members,
+                                f"drain timed out after {drain_timeout}s: "
+                                + "; ".join(blocked[:3]))
+                            record(members, STATE_FAILED)
+                            continue
+                    else:
+                        record(members, state)
+                        continue
             if state == STATE_POD_RESTART:
                 # the validator pods restart WITH the driver: their
                 # initContainers re-prove the node against the new libtpu
                 # (the driver-manager preflight closed every gate), which
                 # is what STATE_VALIDATION then waits on
-                victims = [pod] + validator_pods.get(node_name, [])
-                for v in victims:
-                    try:
-                        self.client.delete("v1", "Pod", name_of(v),
-                                           namespace_of(v) or None)
-                    except NotFoundError:
-                        pass
-                log.info("restarting driver + validator pods on %s",
-                         node_name)
+                for m in members:
+                    victims = ([m.pod] if m.pod is not None else []) \
+                        + validator_pods.get(m.name, [])
+                    for v in victims:
+                        try:
+                            self.client.delete("v1", "Pod", name_of(v),
+                                               namespace_of(v) or None)
+                        except NotFoundError:
+                            pass
+                log.info("restarting driver + validator pods on unit [%s]",
+                         ",".join(m.name for m in members))
+                self._stamp_stage(members)
                 state = STATE_VALIDATION
-                self._set_node_state(node, state)
-                node_states[node_name] = state
+                self._set_unit_state(members, state)
+                record(members, state)
                 continue  # must wait for kubelet to recreate
             if state == STATE_VALIDATION:
-                validators = validator_pods.get(node_name, [])
-                validators_ok = all(self._pod_ready(p) for p in validators) \
-                    and (bool(validators) or not validator_gate_deployed)
-                if have == want and pod_ready and validators_ok:
+                def validated(m: _Member) -> bool:
+                    validators = validator_pods.get(m.name, [])
+                    validators_ok = all(self._pod_ready(p)
+                                        for p in validators) \
+                        and (bool(validators) or not validator_gate_deployed)
+                    return m.upgraded and validators_ok
+
+                if all(validated(m) for m in members):
                     state = STATE_UNCORDON
-                    self._set_node_state(node, state)
+                    self._set_unit_state(members, state)
                 else:
-                    node_states[node_name] = state
+                    started = self._stage_started(members)
+                    if started is None:
+                        self._stamp_stage(members)
+                        started = self.now()
+                    if self.now() - started > validation_timeout:
+                        unproven = [m.name for m in members
+                                    if not validated(m)]
+                        self._fail_unit(
+                            members,
+                            f"validation timed out after "
+                            f"{validation_timeout}s on: "
+                            + ",".join(unproven))
+                        record(members, STATE_FAILED)
+                    else:
+                        record(members, state)
                     continue
             if state == STATE_UNCORDON:
-                self._cordon(node, False)
-                self._set_node_state(node, STATE_DONE)
-                OPERATOR_METRICS.driver_upgrades_done.inc()
-                log.info("node %s upgrade complete", node_name)
-                node_states[node_name] = STATE_DONE
+                for m in members:
+                    self._cordon(m.node, False)
+                    self._annotate(m.node,
+                                   **{L.UPGRADE_STAGE_STARTED: None})
+                    self._set_node_state(m.node, STATE_DONE)
+                    OPERATOR_METRICS.driver_upgrades_done.inc()
+                log.info("upgrade unit [%s] complete",
+                         ",".join(m.name for m in members))
+                record(members, STATE_DONE)
                 continue
-            node_states[node_name] = state or STATE_DONE
+            record(members, state or STATE_DONE)
 
-        pending = [n for n, s in node_states.items() if s != STATE_DONE]
+        pending = [n for n, s in node_states.items()
+                   if s not in (STATE_DONE,)]
         OPERATOR_METRICS.driver_upgrades_in_progress.set(
             sum(1 for s in node_states.values() if s in IN_PROGRESS_STATES))
         OPERATOR_METRICS.driver_upgrades_pending.set(

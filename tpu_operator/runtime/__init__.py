@@ -3,6 +3,7 @@ from .client import (  # noqa: F401
     ApiError,
     Client,
     ConflictError,
+    EvictionBlockedError,
     InvalidError,
     ListOptions,
     NotFoundError,

@@ -25,6 +25,7 @@ from .client import (
     ApiError,
     Client,
     ConflictError,
+    EvictionBlockedError,
     InvalidError,
     ListOptions,
     NotFoundError,
@@ -268,6 +269,20 @@ class HTTPClient(Client):
     def delete(self, api_version, kind, name, namespace=None):
         resp = self.session.delete(self._url(api_version, kind, name, namespace))
         self._raise_for(resp, f"delete {kind}/{name}")
+
+    def evict(self, name, namespace=None):
+        """POST to the pods/eviction subresource — the apiserver enforces
+        PodDisruptionBudgets server-side and answers 429 while the budget
+        has no disruptions left."""
+        ns = namespace or self.config.namespace
+        body = {"apiVersion": "policy/v1", "kind": "Eviction",
+                "metadata": {"name": name, "namespace": ns}}
+        resp = self.session.post(
+            self._url("v1", "Pod", name, ns, "eviction"), json=body)
+        if resp.status_code == 429:
+            raise EvictionBlockedError(
+                f"evict {ns}/{name}: {resp.text[:300]}")
+        self._raise_for(resp, f"evict pod/{name}")
 
     # -- watch -------------------------------------------------------------
 
