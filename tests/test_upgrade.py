@@ -458,6 +458,79 @@ class TestUpgradeFailureSemantics:
         assert node_state(c, "slice-h1") == STATE_DONE
 
 
+class TestReviewRegressions:
+    def test_validation_waits_for_driver_pod_recreation(self):
+        """With no validator gate deployed, a unit must still not pass
+        validation while its driver pod is absent mid-restart."""
+        c, prec = build_converged_cluster(n_nodes=1)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["validator"] = {"enabled": False}
+        c.update(cr)
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        c.simulate_kubelet(ready=True)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # driver pod deleted by POD_RESTART; no kubelet recreation yet:
+        # another pass must hold in validation, cordon intact
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_VALIDATION
+        assert get_nested(c.get("v1", "Node", "tpu-0"), "spec",
+                          "unschedulable") is True
+        # kubelet recreates on the new revision -> completes
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DONE
+
+    def test_opted_out_host_excludes_whole_slice(self):
+        """Pausing one host of a multi-host slice must pause the slice —
+        upgrading the rest alone would run mixed libtpu versions over one
+        ICI fabric."""
+        c, prec = build_mixed_cluster()
+        c.patch("v1", "Node", "slice-h1",
+                {"metadata": {"annotations":
+                              {L.DRIVER_UPGRADE_ENABLED: "false"}}})
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        for _ in range(6):
+            rec.reconcile(Request(name="tpu-cluster-policy"))
+            c.simulate_kubelet(ready=True)
+        # neither slice host entered the FSM; the single host converged
+        assert node_state(c, "slice-h0") is None
+        assert node_state(c, "slice-h1") is None
+        assert node_state(c, "z-single-0") == STATE_DONE
+        # both slice driver pods still on the OLD revision (no mixed state)
+        hashes = {labels_of(p)["controller-revision-hash"]
+                  for p in driver_pods(c)
+                  if get_nested(p, "spec", "nodeName") != "z-single-0"}
+        assert len(hashes) == 1
+
+    def test_pdb_match_expressions_blocks_eviction(self):
+        from tpu_operator.runtime.client import EvictionBlockedError
+
+        c = FakeClient()
+        add_tpu_pod(c, "guarded", "n0", labels={"app": "guarded"})
+        c.create({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                  "metadata": {"name": "guard", "namespace": "default"},
+                  "spec": {"selector": {"matchExpressions": [
+                      {"key": "app", "operator": "In",
+                       "values": ["guarded"]}]},
+                      "minAvailable": 1}})
+        import pytest as _pytest
+        with _pytest.raises(EvictionBlockedError):
+            c.evict("guarded", "default")
+
+    def test_terminating_driver_pod_does_not_shadow_replacement(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        [pod] = driver_pods(c)
+        # mark the live pod Terminating: the map must not include it
+        c.patch("v1", "Pod", pod["metadata"]["name"],
+                {"metadata": {"deletionTimestamp": "2026-01-01T00:00:00Z"}},
+                pod["metadata"]["namespace"])
+        assert rec._driver_pods_by_node() == {}
+
+
 class TestFailureReleaseAndHealing:
     def test_disabling_upgrade_uncordons_failed_node(self):
         """A failed node stays cordoned while the FSM owns it, but turning

@@ -165,6 +165,10 @@ class UpgradeReconciler(Reconciler):
                 ListOptions(namespace=self.namespace,
                             label_selector={"tpu.graft.dev/component":
                                             "libtpu-driver"})):
+            if get_nested(pod, "metadata", "deletionTimestamp"):
+                # a Terminating old-revision pod must not shadow its
+                # replacement in the one-pod-per-node map
+                continue
             node = get_nested(pod, "spec", "nodeName")
             if node:
                 out[node] = pod
@@ -209,12 +213,14 @@ class UpgradeReconciler(Reconciler):
                    for c in get_nested(pod, "status", "conditions",
                                        default=[]) or [])
 
-    def _tpu_workload_pods_on(self, node_name: str) -> List[dict]:
-        """Pods consuming google.com/tpu on the node — the drain set
-        (the reference drains with a GPU-pod selector, main.go:105-117)."""
-        out = []
+    def _tpu_workload_pods_by_node(self) -> Dict[str, List[dict]]:
+        """node -> pods consuming google.com/tpu — the drain set (the
+        reference drains with a GPU-pod selector, main.go:105-117). One
+        cluster-wide LIST per reconcile, not one per draining node."""
+        out: Dict[str, List[dict]] = {}
         for pod in self.client.list("v1", "Pod"):
-            if get_nested(pod, "spec", "nodeName") != node_name:
+            node_name = get_nested(pod, "spec", "nodeName")
+            if not node_name:
                 continue
             if get_nested(pod, "metadata", "deletionTimestamp"):
                 continue
@@ -232,7 +238,7 @@ class UpgradeReconciler(Reconciler):
                 requests.update(get_nested(ctr, "resources", "requests",
                                            default={}) or {})
             if L.TPU_RESOURCE in requests:
-                out.append(pod)
+                out.setdefault(node_name, []).append(pod)
         return out
 
     # -- node label/annotation writes --------------------------------------
@@ -393,19 +399,18 @@ class UpgradeReconciler(Reconciler):
         # the CR — driverAutoUpgradeAnnotationKey contract,
         # state_manager.go:423-477. Absent = eligible, so the controller
         # also works driven standalone.)
-        eligible: Dict[str, dict] = {}
+        opted_out = set()
         for node_name, node in nodes.items():
             anns = get_nested(node, "metadata", "annotations",
                               default={}) or {}
             optin = anns.get(L.DRIVER_UPGRADE_ENABLED)
             if optin is not None and optin != "true":
+                opted_out.add(node_name)
                 if labels_of(node).get(L.UPGRADE_STATE):
                     self._release_node(node)
-                continue
-            eligible[node_name] = node
 
         def member_of(node_name: str) -> _Member:
-            node = eligible[node_name]
+            node = nodes[node_name]
             pod = driver_pods.get(node_name)
             want = have = None
             pod_ready = False
@@ -422,12 +427,33 @@ class UpgradeReconciler(Reconciler):
             return _Member(node=node, pod=pod, want=want, have=have,
                            pod_ready=pod_ready)
 
-        units = [[member_of(n) for n in unit]
-                 for unit in self._upgrade_units(eligible)]
+        # units are partitioned over ALL nodes first: an opted-out host
+        # must take its whole multi-host slice out of the rollout, not
+        # shrink the unit — half a slice upgrading alone is exactly the
+        # mixed-libtpu-versions state the unit mechanism prevents
+        units = []
+        for unit_names in self._upgrade_units(nodes):
+            if any(n in opted_out for n in unit_names):
+                for n in unit_names:
+                    if n not in opted_out and labels_of(
+                            nodes[n]).get(L.UPGRADE_STATE):
+                        self._release_node(nodes[n])
+                continue
+            units.append([member_of(n) for n in unit_names])
         # drop units with nothing to upgrade-manage at all
         units = [u for u in units
                  if any(m.pod is not None for m in u)
                  or any(m.state for m in u)]
+
+        # one cluster-wide pod LIST per reconcile at most, and only when
+        # something is actually draining
+        workload_pods: Optional[Dict[str, List[dict]]] = None
+
+        def drain_pods_on(node_name: str) -> List[dict]:
+            nonlocal workload_pods
+            if workload_pods is None:
+                workload_pods = self._tpu_workload_pods_by_node()
+            return workload_pods.get(node_name, [])
 
         budget = max(1, policy.max_parallel_upgrades or 1)
         in_progress_units = sum(
@@ -496,7 +522,7 @@ class UpgradeReconciler(Reconciler):
                 blocked: List[str] = []
                 if policy.drain_enable in (None, True):
                     for m in members:
-                        for victim in self._tpu_workload_pods_on(m.name):
+                        for victim in drain_pods_on(m.name):
                             try:
                                 self.client.evict(name_of(victim),
                                                   namespace_of(victim) or None)
@@ -524,8 +550,7 @@ class UpgradeReconciler(Reconciler):
                             # deadline passed and the policy says go:
                             # bypass the budget via direct deletion
                             for m in members:
-                                for victim in self._tpu_workload_pods_on(
-                                        m.name):
+                                for victim in drain_pods_on(m.name):
                                     try:
                                         self.client.delete(
                                             "v1", "Pod", name_of(victim),
@@ -571,11 +596,17 @@ class UpgradeReconciler(Reconciler):
                 continue  # must wait for kubelet to recreate
             if state == STATE_VALIDATION:
                 def validated(m: _Member) -> bool:
+                    # mid-restart a member has NO driver pod; that is not
+                    # "nothing to upgrade", it is "new revision unproven"
+                    # — without this the unit could uncordon before the
+                    # kubelet ever recreates the driver
+                    if m.pod is None:
+                        return False
                     validators = validator_pods.get(m.name, [])
                     validators_ok = all(self._pod_ready(p)
                                         for p in validators) \
                         and (bool(validators) or not validator_gate_deployed)
-                    return m.upgraded and validators_ok
+                    return m.have == m.want and m.pod_ready and validators_ok
 
                 if all(validated(m) for m in members):
                     state = STATE_UNCORDON

@@ -191,8 +191,9 @@ def _blocking_pdb(client: "Client", pod: dict) -> Optional[str]:
                                        default=[]) or [])
 
     for pdb in pdbs:
-        sel = get_nested(pdb, "spec", "selector", "matchLabels",
-                         default=None)
+        # full LabelSelector (matchLabels AND matchExpressions), like the
+        # real disruption controller
+        sel = get_nested(pdb, "spec", "selector", default=None)
         if not sel or not match_labels(pod_labels, sel):
             continue
         allowed = get_nested(pdb, "status", "disruptionsAllowed")

@@ -245,106 +245,10 @@ class FakeClient(Client):
         return self.create(node)
 
     def _ds_scheduled_nodes(self, ds: Mapping) -> list:
-        """Nodes a DaemonSet's pods land on, honoring nodeSelector + required
-        node affinity (the scheduling surface the operator actually uses)."""
-        tmpl_spec = get_nested(ds, "spec", "template", "spec", default={}) or {}
-        node_selector = tmpl_spec.get("nodeSelector") or {}
-        terms = get_nested(
-            tmpl_spec, "affinity", "nodeAffinity",
-            "requiredDuringSchedulingIgnoredDuringExecution", "nodeSelectorTerms",
-            default=[]) or []
-        out = []
-        for node in self.list("v1", "Node"):
-            nl = labels_of(node)
-            if not match_labels(nl, node_selector):
-                continue
-            if terms and not match_node_selector_terms(nl, terms):
-                continue
-            out.append(node)
-        return out
+        return ds_scheduled_nodes(self, ds)
 
     def simulate_kubelet(self, ready: bool = True, stale_hash: bool = False) -> None:
-        """Advance every DaemonSet's status as a scheduler+kubelet would.
-
-        Update-strategy-faithful: under ``OnDelete`` an existing pod keeps
-        its controller-revision-hash label until something deletes it (only
-        then does the recreated pod pick up the current template revision);
-        under ``RollingUpdate`` pods move to the current revision on the
-        next tick. ``updatedNumberScheduled`` is computed from actual pod
-        hashes — this is what the OnDelete readiness check and the upgrade
-        controller's per-node FSM key off (object_controls.go:3526-3602
-        semantics).
-
-        ``ready=True`` marks scheduled pods available; ``stale_hash=True``
-        forces pods onto a fake outdated revision.
-        """
-        for ds in self.list("apps/v1", "DaemonSet"):
-            # NB: DaemonSet pods tolerate the unschedulable taint, so cordoned
-            # nodes still receive daemon pods — required for driver-pod
-            # restarts during cordon+drain upgrades.
-            nodes = self._ds_scheduled_nodes(ds)
-            desired = len(nodes)
-            revision = object_hash(get_nested(ds, "spec", "template", default={}))
-            on_delete = get_nested(ds, "spec", "updateStrategy", "type",
-                                   default="RollingUpdate") == "OnDelete"
-            ns = namespace_of(ds) or "default"
-            tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
-                                     default={}) or {}
-            updated = 0
-            n_ready = 0
-            base_hash = "stale" if stale_hash else revision
-            phase = "Running" if ready else "Pending"
-            ready_conds = [{"type": "Ready",
-                            "status": "True" if ready else "False"}]
-            for node in nodes:
-                pod_name = f"{name_of(ds)}-{name_of(node)}"
-                existing = self.get_or_none("v1", "Pod", pod_name, ns)
-                if existing is not None:
-                    # OnDelete: the pod keeps its revision until deleted
-                    pod_hash = (get_nested(existing, "metadata", "labels",
-                                           "controller-revision-hash")
-                                if on_delete and not stale_hash else base_hash)
-                    existing["metadata"]["labels"] = {
-                        **tmpl_labels, "controller-revision-hash": pod_hash}
-                    set_nested(existing, phase, "status", "phase")
-                    set_nested(existing, ready_conds, "status", "conditions")
-                    self.update(existing)
-                else:
-                    pod_hash = base_hash
-                    self.create({
-                        "apiVersion": "v1",
-                        "kind": "Pod",
-                        "metadata": {
-                            "name": pod_name,
-                            "namespace": ns,
-                            "labels": {**tmpl_labels,
-                                       "controller-revision-hash": pod_hash},
-                            "ownerReferences": [{
-                                "apiVersion": "apps/v1", "kind": "DaemonSet",
-                                "name": name_of(ds),
-                                "uid": get_nested(ds, "metadata", "uid"),
-                                "controller": True,
-                            }],
-                        },
-                        "spec": {"nodeName": name_of(node)},
-                        "status": {"phase": phase,
-                                   "conditions": list(ready_conds)},
-                    })
-                if pod_hash == revision:
-                    updated += 1
-                if ready:
-                    n_ready += 1
-            status = {
-                "desiredNumberScheduled": desired,
-                "currentNumberScheduled": desired,
-                "numberMisscheduled": 0,
-                "numberReady": n_ready,
-                "numberAvailable": n_ready,
-                "updatedNumberScheduled": updated,
-                "observedGeneration": get_nested(ds, "metadata", "generation", default=1),
-            }
-            ds["status"] = status
-            self.update_status(ds)
+        simulate_kubelet(self, ready=ready, stale_hash=stale_hash)
 
     def simulate_pod_phase(self, name: str, namespace: str, phase: str) -> None:
         """Flip a standalone pod's phase (used to drive validator workload
@@ -352,3 +256,118 @@ class FakeClient(Client):
         pod = self.get("v1", "Pod", name, namespace)
         set_nested(pod, phase, "status", "phase")
         self.update_status(pod)
+
+
+# ---------------------------------------------------------------------------
+# client-generic scheduler/kubelet simulation
+# ---------------------------------------------------------------------------
+# These operate through the abstract Client interface only, so the same
+# simulation drives FakeClient in unit tests AND a real HTTPClient against
+# the mock HTTP apiserver in the e2e tier (the reference's live-cluster
+# kubelet slot, tests/e2e/gpu_operator_test.go:36-100).
+
+
+def ds_scheduled_nodes(client: Client, ds: Mapping) -> list:
+    """Nodes a DaemonSet's pods land on, honoring nodeSelector + required
+    node affinity (the scheduling surface the operator actually uses)."""
+    tmpl_spec = get_nested(ds, "spec", "template", "spec", default={}) or {}
+    node_selector = tmpl_spec.get("nodeSelector") or {}
+    terms = get_nested(
+        tmpl_spec, "affinity", "nodeAffinity",
+        "requiredDuringSchedulingIgnoredDuringExecution", "nodeSelectorTerms",
+        default=[]) or []
+    out = []
+    for node in client.list("v1", "Node"):
+        nl = labels_of(node)
+        if not match_labels(nl, node_selector):
+            continue
+        if terms and not match_node_selector_terms(nl, terms):
+            continue
+        out.append(node)
+    return out
+
+
+def simulate_kubelet(client: Client, ready: bool = True,
+                     stale_hash: bool = False) -> None:
+    """Advance every DaemonSet's status as a scheduler+kubelet would.
+
+    Update-strategy-faithful: under ``OnDelete`` an existing pod keeps
+    its controller-revision-hash label until something deletes it (only
+    then does the recreated pod pick up the current template revision);
+    under ``RollingUpdate`` pods move to the current revision on the
+    next tick. ``updatedNumberScheduled`` is computed from actual pod
+    hashes — this is what the OnDelete readiness check and the upgrade
+    controller's per-node FSM key off (object_controls.go:3526-3602
+    semantics).
+
+    ``ready=True`` marks scheduled pods available; ``stale_hash=True``
+    forces pods onto a fake outdated revision.
+    """
+    for ds in client.list("apps/v1", "DaemonSet"):
+        # NB: DaemonSet pods tolerate the unschedulable taint, so cordoned
+        # nodes still receive daemon pods — required for driver-pod
+        # restarts during cordon+drain upgrades.
+        nodes = ds_scheduled_nodes(client, ds)
+        desired = len(nodes)
+        revision = object_hash(get_nested(ds, "spec", "template", default={}))
+        on_delete = get_nested(ds, "spec", "updateStrategy", "type",
+                               default="RollingUpdate") == "OnDelete"
+        ns = namespace_of(ds) or "default"
+        tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
+                                 default={}) or {}
+        updated = 0
+        n_ready = 0
+        base_hash = "stale" if stale_hash else revision
+        phase = "Running" if ready else "Pending"
+        ready_conds = [{"type": "Ready",
+                        "status": "True" if ready else "False"}]
+        for node in nodes:
+            pod_name = f"{name_of(ds)}-{name_of(node)}"
+            existing = client.get_or_none("v1", "Pod", pod_name, ns)
+            if existing is not None:
+                # OnDelete: the pod keeps its revision until deleted
+                pod_hash = (get_nested(existing, "metadata", "labels",
+                                       "controller-revision-hash")
+                            if on_delete and not stale_hash else base_hash)
+                existing["metadata"]["labels"] = {
+                    **tmpl_labels, "controller-revision-hash": pod_hash}
+                set_nested(existing, phase, "status", "phase")
+                set_nested(existing, ready_conds, "status", "conditions")
+                client.update(existing)
+            else:
+                pod_hash = base_hash
+                client.create({
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": pod_name,
+                        "namespace": ns,
+                        "labels": {**tmpl_labels,
+                                   "controller-revision-hash": pod_hash},
+                        "ownerReferences": [{
+                            "apiVersion": "apps/v1", "kind": "DaemonSet",
+                            "name": name_of(ds),
+                            "uid": get_nested(ds, "metadata", "uid"),
+                            "controller": True,
+                        }],
+                    },
+                    "spec": {"nodeName": name_of(node)},
+                    "status": {"phase": phase,
+                               "conditions": list(ready_conds)},
+                })
+            if pod_hash == revision:
+                updated += 1
+            if ready:
+                n_ready += 1
+        status = {
+            "desiredNumberScheduled": desired,
+            "currentNumberScheduled": desired,
+            "numberMisscheduled": 0,
+            "numberReady": n_ready,
+            "numberAvailable": n_ready,
+            "updatedNumberScheduled": updated,
+            "observedGeneration": get_nested(ds, "metadata", "generation",
+                                             default=1),
+        }
+        ds["status"] = status
+        client.update_status(ds)
