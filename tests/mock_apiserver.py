@@ -24,7 +24,6 @@ import copy
 import json
 import queue
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -213,7 +212,6 @@ class _Handler(BaseHTTPRequestHandler):
     def _collect(self, coll_path: str, q):
         selector = (q.get("labelSelector") or [""])[0]
         prefix = coll_path.rstrip("/") + "/"
-        segs = _segments(coll_path)
         items = []
         with self.st.lock:
             entries = sorted(self.st.objects.items())
@@ -231,9 +229,6 @@ class _Handler(BaseHTTPRequestHandler):
             item.pop("apiVersion", None)
             item.pop("kind", None)
             items.append(item)
-        # dedup (a namespaced path can match direct+fan_in only when the
-        # collection IS the all-ns one, never both) — keep order
-        del segs
         return items
 
     def _serve_watch(self, coll_path: str):
@@ -305,20 +300,22 @@ class _Handler(BaseHTTPRequestHandler):
 
         with self.st.lock:
             entries = list(self.st.objects.items())
+        from tpu_operator.runtime.objects import match_labels
+
         for path, pdb in entries:
             if not path.startswith(pdb_prefix):
                 continue
-            sel = ((pdb.get("spec") or {}).get("selector")
-                   or {}).get("matchLabels") or {}
-            if not sel or not all(pod_labels.get(k) == v
-                                  for k, v in sel.items()):
+            # full LabelSelector (matchLabels + matchExpressions), same
+            # semantics the client-side _blocking_pdb enforces
+            sel = (pdb.get("spec") or {}).get("selector") or {}
+            if not sel or not match_labels(pod_labels, sel):
                 continue
             allowed = (pdb.get("status") or {}).get("disruptionsAllowed")
             if allowed is None:
                 pods = [o for p, o in entries
                         if p.startswith(f"/api/v1/namespaces/{ns}/pods/")
-                        and all(((o.get("metadata") or {}).get("labels")
-                                 or {}).get(k) == v for k, v in sel.items())]
+                        and match_labels((o.get("metadata") or {}).get(
+                            "labels") or {}, sel)]
                 healthy = sum(1 for p in pods if ready(p))
                 allowed = healthy - int(
                     (pdb.get("spec") or {}).get("minAvailable", 0))
@@ -422,12 +419,3 @@ class _Handler(BaseHTTPRequestHandler):
         if obj is None:
             return self._not_found()
         self._send(200, {"kind": "Status", "status": "Success"})
-
-
-def wait_until(pred, timeout=30.0, interval=0.1, desc="condition"):
-    end = time.time() + timeout
-    while time.time() < end:
-        if pred():
-            return
-        time.sleep(interval)
-    raise AssertionError(f"timed out waiting for {desc}")

@@ -59,6 +59,27 @@ PERMUTATIONS = {
         "vtpuDeviceManager": {"defaultProfile": "vtpu-4"},
         "isolatedDevicePlugin": {"resourceName": "example.com/tpu-dedicated"},
     },
+    "vtpu-profiles": {
+        "sandboxWorkloads": {"enabled": True, "defaultWorkload": "virtual"},
+        "vtpuDeviceManager": {"configMap": "team-vtpu-profiles",
+                              "defaultProfile": "vtpu-8"},
+        "isolatedDevicePlugin": {"vtpuResourceName": "example.com/vtpu-frac"},
+    },
+    "fencing-explicit-list": {
+        "sandboxWorkloads": {"enabled": True, "defaultWorkload": "isolated"},
+        "chipFencing": {"config": "accel0,accel2"},
+        "vtpuDeviceManager": {"enabled": False},
+    },
+    "custom-runtimeclass": {
+        "operator": {"runtimeClass": "tpu-sandboxed"},
+    },
+    "operands-disabled": {
+        "tpuRuntime": {"enabled": False},
+        "metricsExporter": {"enabled": False},
+        "featureDiscovery": {"enabled": False},
+        "nodeStatusExporter": {"enabled": False},
+        "topologyManager": {"enabled": False},
+    },
     # every shared knob set at once (the spec permutation that would have
     # caught the round-2 dead-knob bug): daemonsets defaults + a fully
     # overridden operand + distinct overrides on several others
@@ -137,13 +158,63 @@ def render_all(spec_dict) -> str:
     return yaml.safe_dump_all(docs, sort_keys=True)
 
 
+def render_tpudriver_pools() -> str:
+    """Golden of the per-pool TPUDriver path (internal/state/driver.go:211
+    analog): one driver DaemonSet per (generation x topology) pool,
+    rendered by the real reconciler against a fake two-pool cluster."""
+    from tpu_operator.api import labels as L
+    from tpu_operator.api.tpudriver import new_tpu_driver
+    from tpu_operator.controllers.tpudriver_controller import (
+        TPUDriverReconciler,
+    )
+    from tpu_operator.runtime import FakeClient, Request
+
+    c = FakeClient()
+    for name, accel, topo in (
+            ("v5e-a", "tpu-v5-lite-podslice", "2x4"),
+            ("v5e-b", "tpu-v5-lite-podslice", "2x4"),
+            ("v5p-a", "tpu-v5p-slice", "2x2x1")):
+        c.add_node(name, labels={L.GKE_TPU_ACCELERATOR: accel,
+                                 L.GKE_TPU_TOPOLOGY: topo})
+    c.create(new_cluster_policy(spec={}))
+    c.create(new_tpu_driver("pools-driver", spec={
+        "channel": "nightly", "installDir": "/opt/pool-libtpu",
+        "repository": "gcr.io/pools", "image": "libtpu",
+        "version": "v7.7.7"}))
+    TPUDriverReconciler(client=c).reconcile(Request(name="pools-driver"))
+    docs = [d for d in c.list("apps/v1", "DaemonSet")]
+    for d in docs:  # strip server-assigned noise for a stable golden
+        for k in ("resourceVersion", "uid", "creationTimestamp",
+                  "generation"):
+            d["metadata"].pop(k, None)
+        d.pop("status", None)
+        # the apply hash covers the (random) owner uid — not golden-stable
+        d["metadata"].get("annotations", {}).pop(
+            "tpu.graft.dev/last-applied-hash", None)
+        for ref in d["metadata"].get("ownerReferences", []):
+            ref.pop("uid", None)
+    return yaml.safe_dump_all(sorted(docs, key=lambda d:
+                                     d["metadata"]["name"]),
+                              sort_keys=True)
+
+
+SPECIAL_GOLDENS = {"tpudriver-pools": render_tpudriver_pools}
+
+
 def golden_path(name: str) -> pathlib.Path:
     return GOLDEN_DIR / f"{name}.yaml"
 
 
-@pytest.mark.parametrize("name", sorted(PERMUTATIONS))
+def _render(name: str) -> str:
+    if name in SPECIAL_GOLDENS:
+        return SPECIAL_GOLDENS[name]()
+    return render_all(PERMUTATIONS[name])
+
+
+@pytest.mark.parametrize("name",
+                         sorted(PERMUTATIONS) + sorted(SPECIAL_GOLDENS))
 def test_golden(name):
-    rendered = render_all(PERMUTATIONS[name])
+    rendered = _render(name)
     path = golden_path(name)
     assert path.exists(), (
         f"golden file {path} missing — run "
@@ -156,8 +227,8 @@ def test_golden(name):
 
 def update_goldens():
     GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
-    for name, spec in PERMUTATIONS.items():
-        golden_path(name).write_text(render_all(spec))
+    for name in list(PERMUTATIONS) + list(SPECIAL_GOLDENS):
+        golden_path(name).write_text(_render(name))
         print(f"wrote {golden_path(name)}")
 
 

@@ -1,0 +1,217 @@
+"""Image resolvability against a registry (VERDICT r2 item 7).
+
+A stdlib fake OCI registry (v2 distribution API with token auth) drives
+the REAL RegistryResolver — no network beyond 127.0.0.1 — and the
+`tpuop-cfg validate --verify-images` CLI path end-to-end: a policy whose
+tag exists passes, an unresolvable tag fails validation offline
+(cmd/gpuop-cfg/validate/clusterpolicy/images.go:172 analog).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from tpu_operator.api.registry import (
+    ImageResolveError,
+    RegistryResolver,
+    collect_cr_images,
+    parse_image_ref,
+    resolve_cr_images,
+)
+from tpu_operator.cli.tpuop_cfg import main
+
+
+class _FakeRegistry:
+    """OCI distribution v2 endpoints: /v2/, token auth, manifests."""
+
+    def __init__(self, repos, require_auth=False):
+        self.repos = repos          # {"repo/name": {"tags"/"digests": [...]}}
+        self.require_auth = require_auth
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body=b"{}", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                outer.requests.append(self.path)
+                if self.path.startswith("/token"):
+                    return self._send(200, json.dumps(
+                        {"token": "fake-tok"}).encode())
+                if not self.path.startswith("/v2/"):
+                    return self._send(404)
+                if outer.require_auth and \
+                        "Bearer fake-tok" not in (
+                            self.headers.get("Authorization") or ""):
+                    host = self.headers.get("Host")
+                    return self._send(401, b"{}", [(
+                        "WWW-Authenticate",
+                        f'Bearer realm="http://{host}/token",'
+                        f'service="fake"')])
+                # /v2/<repo...>/manifests/<ref>
+                parts = self.path[len("/v2/"):].split("/manifests/")
+                if len(parts) != 2:
+                    return self._send(404)
+                repo, ref = parts
+                entry = outer.repos.get(repo)
+                if entry and (ref in entry.get("tags", ())
+                              or ref in entry.get("digests", ())):
+                    return self._send(200, b'{"schemaVersion": 2}')
+                return self._send(404)
+
+            do_HEAD = do_GET
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.host = f"127.0.0.1:{self.server.server_address[1]}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def registry():
+    reg = _FakeRegistry({
+        "tpu-operator/libtpu": {
+            "tags": ["v2.0.0"],
+            "digests": ["sha256:" + "ab" * 32]},
+        "tpu-operator/device-plugin": {"tags": ["stable"]},
+    })
+    yield reg
+    reg.stop()
+
+
+class TestParseImageRef:
+    def test_full_reference(self):
+        r = parse_image_ref("gcr.io/proj/img:v1.2.3")
+        assert (r.registry, r.repository, r.tag) == \
+            ("gcr.io", "proj/img", "v1.2.3")
+
+    def test_port_is_not_a_tag(self):
+        r = parse_image_ref("localhost:5000/img")
+        assert (r.registry, r.repository, r.tag) == \
+            ("localhost:5000", "img", None)
+        assert r.reference == "latest"
+
+    def test_digest_reference(self):
+        d = "sha256:" + "cd" * 32
+        r = parse_image_ref(f"gcr.io/proj/img@{d}")
+        assert r.digest == d and r.reference == d
+
+    def test_dockerhub_normalization(self):
+        r = parse_image_ref("ubuntu:22.04")
+        assert (r.registry, r.repository) == \
+            ("registry-1.docker.io", "library/ubuntu")
+
+    def test_malformed_tag_rejected(self):
+        with pytest.raises(ImageResolveError):
+            parse_image_ref("gcr.io/img:bad tag")
+        with pytest.raises(ImageResolveError):
+            parse_image_ref("gcr.io/img@sha256:short")
+
+
+class TestRegistryResolver:
+    def test_existing_tag_resolves(self, registry):
+        RegistryResolver(plain_http=True).resolve(
+            f"{registry.host}/tpu-operator/libtpu:v2.0.0")
+
+    def test_existing_digest_resolves(self, registry):
+        RegistryResolver(plain_http=True).resolve(
+            f"{registry.host}/tpu-operator/libtpu@sha256:{'ab' * 32}")
+
+    def test_missing_tag_fails(self, registry):
+        with pytest.raises(ImageResolveError, match="not found"):
+            RegistryResolver(plain_http=True).resolve(
+                f"{registry.host}/tpu-operator/libtpu:v9.9.9-nope")
+
+    def test_missing_repository_fails(self, registry):
+        with pytest.raises(ImageResolveError, match="not found"):
+            RegistryResolver(plain_http=True).resolve(
+                f"{registry.host}/no/such-repo:v1")
+
+    def test_unreachable_registry_fails(self):
+        with pytest.raises(ImageResolveError, match="unreachable"):
+            RegistryResolver(plain_http=True, timeout=1.0).resolve(
+                "127.0.0.1:1/img:v1")
+
+    def test_token_auth_dance(self):
+        reg = _FakeRegistry(
+            {"private/img": {"tags": ["v1"]}}, require_auth=True)
+        try:
+            RegistryResolver(plain_http=True).resolve(
+                f"{reg.host}/private/img:v1")
+            assert any(p.startswith("/token") for p in reg.requests)
+        finally:
+            reg.stop()
+
+
+class TestCRImageCollection:
+    def test_collects_only_explicitly_configured(self):
+        cr = {"kind": "TPUClusterPolicy", "spec": {
+            "libtpu": {"repository": "r.io/a", "image": "libtpu",
+                       "version": "v1"},
+            "devicePlugin": {"enabled": True},  # defaults: not collected
+            "validator": {"matmulSize": 64},
+        }}
+        refs = collect_cr_images(cr)
+        assert refs == [("/spec/libtpu", "r.io/a/libtpu:v1")]
+
+    def test_resolve_cr_images_reports_per_component(self, registry):
+        cr = {"kind": "TPUClusterPolicy", "spec": {
+            "libtpu": {"repository": f"{registry.host}/tpu-operator",
+                       "image": "libtpu", "version": "v2.0.0"},
+            "devicePlugin": {"repository": f"{registry.host}/tpu-operator",
+                             "image": "device-plugin",
+                             "version": "v-broken"},
+        }}
+        errs = resolve_cr_images(cr, RegistryResolver(plain_http=True))
+        assert len(errs) == 1 and errs[0].startswith("/spec/devicePlugin")
+
+
+class TestCLIVerifyImages:
+    def policy(self, tmp_path, host, version):
+        f = tmp_path / "policy.yaml"
+        f.write_text(yaml.safe_dump({
+            "apiVersion": "tpu.graft.dev/v1",
+            "kind": "TPUClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"libtpu": {"repository": f"{host}/tpu-operator",
+                                "image": "libtpu", "version": version}},
+        }))
+        return str(f)
+
+    def test_resolvable_policy_passes(self, registry, tmp_path, capsys):
+        rc = main(["validate", "clusterpolicy",
+                   "-f", self.policy(tmp_path, registry.host, "v2.0.0"),
+                   "--verify-images", "--plain-http"])
+        assert rc == 0
+        assert "is valid" in capsys.readouterr().out
+
+    def test_unresolvable_tag_fails_offline(self, registry, tmp_path,
+                                            capsys):
+        rc = main(["validate", "clusterpolicy",
+                   "-f", self.policy(tmp_path, registry.host, "v-typo"),
+                   "--verify-images", "--plain-http"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "INVALID /spec/libtpu" in err and "not found" in err
+
+    def test_without_flag_no_network_touched(self, registry, tmp_path):
+        rc = main(["validate", "clusterpolicy",
+                   "-f", self.policy(tmp_path, registry.host, "v-typo")])
+        assert rc == 0  # schema-valid; registry never contacted
+        assert registry.requests == []

@@ -314,3 +314,129 @@ class TestDeviceNodeProof:
             assert err is None
         else:
             assert err and "open" in err
+
+
+class TestPerfProofThresholdBranches:
+    """Both branches of every perf proof (VERDICT r2 item 5): inject fake
+    workload results above/below threshold and assert pass writes the
+    barrier file while fail raises ValidationFailed and leaves NO barrier
+    file — a node must never be certified off a failing proof. (Ref slot:
+    the cuda component's failure handling, validator/main.go:1350-1425.)"""
+
+    @staticmethod
+    def _ici_result(fraction, correct=True):
+        from tpu_operator.workloads.collectives import AllReduceResult
+
+        return AllReduceResult(
+            devices=4, bytes_per_device=1 << 20, seconds=0.01,
+            algo_bw_gbps=100.0, bus_bw_gbps=150.0, peak_ici_gbps=200.0,
+            fraction_of_peak=fraction, device_kind="TPU v5e",
+            correct=correct)
+
+    @staticmethod
+    def _hbm_result(fraction, correct=True):
+        from tpu_operator.workloads.pallas_probe import TriadResult
+
+        return TriadResult(
+            bytes_moved=1 << 30, seconds=0.01, bandwidth_gbps=600.0,
+            peak_hbm_gbps=819.0, fraction_of_peak=fraction,
+            device_kind="TPU v5e", correct=correct)
+
+    @staticmethod
+    def _matmul_result(checksum_ok):
+        from tpu_operator.workloads.matmul import MatmulResult
+
+        return MatmulResult(
+            size=64, iters=8, calls=2, seconds=0.01, tflops=50.0,
+            peak_tflops=197.0, utilization=0.25, device_kind="TPU v5e",
+            checksum_ok=checksum_ok)
+
+    def test_ici_below_threshold_fails_and_writes_no_barrier(
+            self, valdir, monkeypatch):
+        from tpu_operator.workloads import collectives
+
+        monkeypatch.setattr(collectives, "run",
+                            lambda **kw: self._ici_result(0.42))
+        with pytest.raises(ValidationFailed, match="below the 80%"):
+            validate_ici(allow_cpu=True, threshold=0.8)
+        assert not barrier.is_ready("ici-ready")
+
+    def test_ici_above_threshold_passes(self, valdir, monkeypatch):
+        from tpu_operator.workloads import collectives
+
+        monkeypatch.setattr(collectives, "run",
+                            lambda **kw: self._ici_result(0.91))
+        info = validate_ici(allow_cpu=True, threshold=0.8)
+        assert info["FRACTION_OF_PEAK"] == "0.910"
+        assert barrier.is_ready("ici-ready")
+
+    def test_ici_incorrect_allreduce_fails(self, valdir, monkeypatch):
+        from tpu_operator.workloads import collectives
+
+        monkeypatch.setattr(
+            collectives, "run",
+            lambda **kw: self._ici_result(0.95, correct=False))
+        with pytest.raises(ValidationFailed, match="wrong values"):
+            validate_ici(allow_cpu=True, threshold=0.8)
+        assert not barrier.is_ready("ici-ready")
+
+    def test_ici_threshold_from_spec_env(self, valdir, monkeypatch):
+        # the CR-level iciBandwidthThreshold reaches the proof via env
+        from tpu_operator.workloads import collectives
+
+        monkeypatch.setenv("ICI_THRESHOLD", "0.95")
+        monkeypatch.setattr(collectives, "run",
+                            lambda **kw: self._ici_result(0.91))
+        with pytest.raises(ValidationFailed, match="below the 95%"):
+            validate_ici(allow_cpu=True)
+
+    def test_hbm_below_threshold_fails_and_writes_no_barrier(
+            self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_hbm
+        from tpu_operator.workloads import pallas_probe
+
+        monkeypatch.setattr(pallas_probe, "run",
+                            lambda **kw: self._hbm_result(0.3))
+        with pytest.raises(ValidationFailed, match="below the 50%"):
+            validate_hbm(allow_cpu=True, threshold=0.5)
+        assert not barrier.is_ready("hbm-ready")
+
+    def test_hbm_above_threshold_passes(self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_hbm
+        from tpu_operator.workloads import pallas_probe
+
+        monkeypatch.setattr(pallas_probe, "run",
+                            lambda **kw: self._hbm_result(0.73))
+        info = validate_hbm(allow_cpu=True, threshold=0.5)
+        assert info["FRACTION_OF_PEAK"] == "0.730"
+        assert barrier.is_ready("hbm-ready")
+
+    def test_hbm_incorrect_triad_fails(self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_hbm
+        from tpu_operator.workloads import pallas_probe
+
+        monkeypatch.setattr(
+            pallas_probe, "run",
+            lambda **kw: self._hbm_result(0.9, correct=False))
+        with pytest.raises(ValidationFailed, match="wrong values"):
+            validate_hbm(allow_cpu=True, threshold=0.5)
+        assert not barrier.is_ready("hbm-ready")
+
+    def test_jax_checksum_failure_fails_and_writes_no_barrier(
+            self, valdir, monkeypatch):
+        from tpu_operator.workloads import matmul
+
+        monkeypatch.setattr(matmul, "run",
+                            lambda **kw: self._matmul_result(False))
+        with pytest.raises(ValidationFailed, match="non-finite"):
+            validate_jax(matmul_size=64, allow_cpu=True)
+        assert not barrier.is_ready("jax-ready")
+
+    def test_jax_checksum_ok_passes(self, valdir, monkeypatch):
+        from tpu_operator.workloads import matmul
+
+        monkeypatch.setattr(matmul, "run",
+                            lambda **kw: self._matmul_result(True))
+        info = validate_jax(matmul_size=64, allow_cpu=True)
+        assert info["MXU_UTILIZATION"] == "0.250"
+        assert barrier.is_ready("jax-ready")

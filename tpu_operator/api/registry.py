@@ -1,0 +1,182 @@
+"""Image-reference resolvability against a container registry.
+
+The reference's gpuop-cfg verifies every image tag in a ClusterPolicy
+actually resolves — a manifest fetch via regclient
+(cmd/gpuop-cfg/validate/clusterpolicy/images.go:172) — so a typo'd tag
+fails validation before anything reaches the cluster. Same here, with the
+resolver pluggable so tests run against a local fake registry and other
+tooling can inject an allowlist resolver:
+
+- ``parse_image_ref`` splits ``[registry/]repository[:tag|@digest]``
+  with docker.io/library normalization;
+- ``RegistryResolver`` performs the real OCI distribution-spec check:
+  HEAD/GET ``/v2/<repo>/manifests/<ref>`` with the token-auth dance;
+- ``resolve_cr_images`` walks a TPUClusterPolicy/TPUDriver CR and
+  resolves every operand image that is explicitly configured.
+
+CLI: ``tpuop-cfg validate clusterpolicy -f p.yaml --verify-images``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, NamedTuple, Optional, Protocol
+
+MANIFEST_ACCEPT = ", ".join([
+    "application/vnd.oci.image.index.v1+json",
+    "application/vnd.oci.image.manifest.v1+json",
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+    "application/vnd.docker.distribution.manifest.v2+json",
+])
+
+_TAG_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9._-]{0,127}$")
+_DIGEST_RE = re.compile(r"^sha256:[0-9a-f]{64}$")
+
+
+class ImageResolveError(Exception):
+    pass
+
+
+class ImageRef(NamedTuple):
+    registry: str
+    repository: str
+    tag: Optional[str]
+    digest: Optional[str]
+
+    @property
+    def reference(self) -> str:
+        """What goes in the manifest URL: digest wins over tag."""
+        return self.digest or self.tag or "latest"
+
+    def __str__(self) -> str:
+        base = f"{self.registry}/{self.repository}"
+        if self.digest:
+            return f"{base}@{self.digest}"
+        return f"{base}:{self.tag or 'latest'}"
+
+
+def parse_image_ref(ref: str) -> ImageRef:
+    """Split ``[registry/]repository[:tag|@digest]``; normalizes bare
+    Docker Hub references the way the docker CLI does."""
+    if not ref or ref != ref.strip():
+        raise ImageResolveError(f"malformed image reference {ref!r}")
+    digest = None
+    if "@" in ref:
+        ref, digest = ref.rsplit("@", 1)
+        if not _DIGEST_RE.match(digest):
+            raise ImageResolveError(f"malformed digest {digest!r}")
+    tag = None
+    # a colon after the last slash is a tag; earlier ones are port numbers
+    last = ref.rsplit("/", 1)[-1]
+    if ":" in last:
+        ref, tag = ref.rsplit(":", 1)
+        if not _TAG_RE.match(tag):
+            raise ImageResolveError(f"malformed tag {tag!r}")
+    parts = ref.split("/")
+    if len(parts) == 1:
+        registry, repository = "registry-1.docker.io", f"library/{parts[0]}"
+    elif "." in parts[0] or ":" in parts[0] or parts[0] == "localhost":
+        registry, repository = parts[0], "/".join(parts[1:])
+    else:
+        registry, repository = "registry-1.docker.io", "/".join(parts)
+    if not repository:
+        raise ImageResolveError(f"malformed image reference {ref!r}")
+    return ImageRef(registry, repository, tag, digest)
+
+
+class Resolver(Protocol):
+    def resolve(self, ref: str) -> None:
+        """Raise ImageResolveError when ``ref`` does not resolve."""
+
+
+class RegistryResolver:
+    """OCI distribution-spec manifest check with token auth (the regclient
+    slot). ``plain_http=True`` targets http:// registries (local fakes)."""
+
+    def __init__(self, plain_http: bool = False, timeout: float = 10.0):
+        self.plain_http = plain_http
+        self.timeout = timeout
+        import requests
+
+        self.session = requests.Session()
+
+    def _token(self, challenge: str, repository: str) -> Optional[str]:
+        """Follow a Bearer WWW-Authenticate challenge (Docker Hub et al)."""
+        m = dict(re.findall(r'(\w+)="([^"]*)"', challenge))
+        realm = m.get("realm")
+        if not challenge.lower().startswith("bearer") or not realm:
+            return None
+        params: Dict[str, str] = {}
+        if m.get("service"):
+            params["service"] = m["service"]
+        params["scope"] = m.get("scope") or f"repository:{repository}:pull"
+        resp = self.session.get(realm, params=params, timeout=self.timeout)
+        if resp.status_code != 200:
+            return None
+        return resp.json().get("token") or resp.json().get("access_token")
+
+    def resolve(self, ref: str) -> None:
+        parsed = parse_image_ref(ref)
+        scheme = "http" if self.plain_http else "https"
+        url = (f"{scheme}://{parsed.registry}/v2/{parsed.repository}"
+               f"/manifests/{parsed.reference}")
+        headers = {"Accept": MANIFEST_ACCEPT}
+        try:
+            resp = self.session.get(url, headers=headers,
+                                    timeout=self.timeout)
+            if resp.status_code == 401:
+                token = self._token(
+                    resp.headers.get("WWW-Authenticate", ""),
+                    parsed.repository)
+                if token:
+                    headers["Authorization"] = f"Bearer {token}"
+                    resp = self.session.get(url, headers=headers,
+                                            timeout=self.timeout)
+        except Exception as e:
+            raise ImageResolveError(
+                f"{parsed}: registry unreachable ({type(e).__name__}: {e})")
+        if resp.status_code == 404:
+            raise ImageResolveError(
+                f"{parsed}: manifest not found (tag or repository "
+                f"does not exist)")
+        if resp.status_code != 200:
+            raise ImageResolveError(
+                f"{parsed}: registry answered {resp.status_code}")
+
+
+def collect_cr_images(cr: dict) -> List[tuple]:
+    """(spec path, resolved ref) for every operand that explicitly
+    configures an image (built-in defaults are release-baked and not the
+    CR author's to verify)."""
+    from .image import image_path
+
+    out = []
+    spec = cr.get("spec") or {}
+    if cr.get("kind") == "TPUDriver":
+        # the TPUDriver spec IS a component spec at top level
+        spec = {"libtpu": spec} if any(
+            spec.get(k) for k in ("repository", "image", "version")) else {}
+    for component, body in sorted(spec.items()):
+        if not isinstance(body, dict):
+            continue
+        if not any(body.get(k) for k in ("repository", "image", "version")):
+            continue
+        try:
+            ref = image_path(component, body.get("repository"),
+                             body.get("image"), body.get("version"))
+        except ValueError:
+            continue  # static resolvability already reported by validate_cr
+        out.append((f"/spec/{component}", ref))
+    return out
+
+
+def resolve_cr_images(cr: dict, resolver: Resolver) -> List[str]:
+    """Errors for every explicitly-configured operand image that does not
+    resolve against its registry."""
+    errs = []
+    for path, ref in collect_cr_images(cr):
+        try:
+            resolver.resolve(ref)
+        except ImageResolveError as e:
+            errs.append(f"{path}: {e}")
+    return errs

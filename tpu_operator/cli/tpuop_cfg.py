@@ -58,6 +58,14 @@ def main(argv=None) -> int:
     v = sub.add_parser("validate", help="offline CR validation")
     v.add_argument("what", choices=["clusterpolicy", "tpudriver"])
     v.add_argument("-f", "--file", required=True)
+    v.add_argument("--verify-images", action="store_true",
+                   help="also check every explicitly-configured operand "
+                        "image resolves in its registry (needs network; "
+                        "the gpuop-cfg regclient check, images.go:172)")
+    v.add_argument("--plain-http", action="store_true",
+                   help="with --verify-images: talk http:// to the "
+                        "registry (local/test registries)")
+    v.add_argument("--registry-timeout", type=float, default=10.0)
 
     g = sub.add_parser("generate", help="emit deployment manifests")
     g.add_argument("what", choices=["crds", "operator", "all", "bundle"])
@@ -106,6 +114,12 @@ def main(argv=None) -> int:
               f"{want_kind}, file has {cr.get('kind')!r}", file=sys.stderr)
         return 1
     errs, kind = validate_cr(cr)
+    if not errs and args.verify_images:
+        from ..api.registry import RegistryResolver, resolve_cr_images
+
+        resolver = RegistryResolver(
+            plain_http=args.plain_http, timeout=args.registry_timeout)
+        errs = resolve_cr_images(cr, resolver)
     if errs:
         for e in errs:
             print(f"INVALID {e}", file=sys.stderr)

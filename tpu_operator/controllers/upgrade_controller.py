@@ -122,10 +122,6 @@ class _Member:
         return labels_of(self.node).get(L.UPGRADE_STATE)
 
     @property
-    def upgraded(self) -> bool:
-        return self.pod is None or (self.have == self.want and self.pod_ready)
-
-    @property
     def at_new_revision(self) -> bool:
         return self.pod is None or self.have == self.want
 
