@@ -110,3 +110,44 @@ def test_diagnose_holders_runs_and_excludes_self():
 
 def test_describe_environment_mentions_device_nodes():
     assert "device_nodes=" in backend.describe_environment()
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_scrape_telemetry_full_pipeline(monkeypatch):
+    """The bench's telemetry block runs the REAL exporter + HTTP scrape +
+    health engine over whatever the production collectors return; here
+    the sysfs collector is stubbed so the pipeline (serve -> scrape ->
+    judge) is exercised hermetically."""
+    bench = _load_bench()
+
+    from tpu_operator.metrics import libtpu_exporter
+    from tpu_operator.metrics.libtpu_exporter import ChipSample
+
+    monkeypatch.setattr(
+        libtpu_exporter, "collect_sysfs",
+        lambda: [ChipSample("accel0", duty_cycle_pct=60.0,
+                            hbm_used=2 << 30, hbm_total=16 << 30,
+                            temperature_c=50.0)])
+    # collect_local (used by the served exporter) consults sysfs first
+    monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
+    block = bench._scrape_telemetry("tpu")
+    assert block["source"] == "sysfs"
+    assert block["chips"] == 1
+    assert block["hbm_total_bytes"] == 16 << 30
+    assert block["exporter_scrape_has_hbm_total"] is True
+    assert block["exporter_scrape_series"] > 0
+    assert block["health"][0]["status"] == "ok"
+
+
+def test_scrape_telemetry_skipped_off_tpu():
+    assert _load_bench()._scrape_telemetry("cpu") is None

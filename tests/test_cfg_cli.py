@@ -213,6 +213,43 @@ class TestValues:
         images = [i["image"] for i in csv["spec"]["relatedImages"]]
         assert "gcr.io/acme/op:v9" in images
 
+    def test_values_document_every_crd_knob(self):
+        """Reverse-coverage gate: every spec property the CRD schema
+        exposes must be documented in deploy/values.yaml, per operand —
+        the reference keeps values and CRD consistent with
+        validate-helm-values (Makefile:233-239); this is that gate with
+        full-surface coverage, so a new API field cannot ship
+        undocumented."""
+        from tpu_operator.api.crd import cluster_policy_crd
+        from tpu_operator.deploy.values import default_values
+
+        schema = cluster_policy_crd()["spec"]["versions"][0][
+            "schema"]["openAPIV3Schema"]
+        spec_props = schema["properties"]["spec"]["properties"]
+        vals = default_values()["clusterPolicy"]["spec"]
+        assert set(spec_props) - set(vals) == set(), \
+            "CRD spec sections missing from values.yaml"
+        # both directions: a renamed/removed CRD knob must not linger as
+        # dead documentation either (the schema gate catches stale keys
+        # at render time, but only for sections the schema still types)
+        assert set(vals) - set(spec_props) == set(), \
+            "values.yaml documents sections the CRD no longer has"
+        undocumented, stale = {}, {}
+        for section, body in vals.items():
+            props = spec_props.get(section, {}).get("properties")
+            if props is None or not isinstance(body, dict):
+                continue
+            missing = set(props) - set(body)
+            extra = set(body) - set(props)
+            if missing:
+                undocumented[section] = sorted(missing)
+            if extra:
+                stale[section] = sorted(extra)
+        assert undocumented == {}, (
+            f"CRD knobs missing from values.yaml: {undocumented}")
+        assert stale == {}, (
+            f"values.yaml documents knobs the CRD lacks: {stale}")
+
     def test_operator_labels_cannot_break_selector(self):
         from tpu_operator.deploy.packaging import operator_deployment
 

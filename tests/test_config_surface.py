@@ -197,6 +197,109 @@ def test_per_operand_overrides_beat_daemonset_defaults():
     assert "ds-taint" in keys and "operand-taint" in keys
 
 
+@pytest.mark.parametrize("state_name", sorted(STATE_SPEC_KEY))
+def test_operator_wide_labels_annotations(state_name):
+    """operator.labels/annotations reach every operand's objects (lowest
+    precedence: daemonsets.* and per-operand values win)."""
+    spec_dict = merged(BASE_SPEC, "operator", {
+        "labels": {"org/team": "probe-op-label"},
+        "annotations": {"org/contact": "probe-op-ann"}})
+    out = render_state(state_name, spec_dict)
+    assert "probe-op-label" in out and "probe-op-ann" in out
+
+
+def test_operator_labels_lowest_precedence():
+    spec_dict = merged(BASE_SPEC, "operator", {"labels": {"k": "op"}})
+    spec_dict = merged(spec_dict, "daemonsets", {"labels": {"k": "ds"}})
+    out = render_state("tpu-device-plugin", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    assert ds["metadata"]["labels"]["k"] == "ds"
+
+
+def test_operator_init_container_image_override():
+    """operator.initContainer overrides the driver-manager preflight
+    image while the main installer keeps the operand image."""
+    spec_dict = merged(BASE_SPEC, "operator", {"initContainer": {
+        "repository": "gcr.io/util", "image": "preflight",
+        "version": "v3"}})
+    out = render_state("libtpu-driver", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    pod = ds["spec"]["template"]["spec"]
+    init = next(c for c in pod["initContainers"]
+                if c["name"] == "tpu-driver-manager")
+    assert init["image"] == "gcr.io/util/preflight:v3"
+    assert pod["containers"][0]["image"] != "gcr.io/util/preflight:v3"
+
+
+@pytest.mark.parametrize("proof,ctr_name", [
+    ("driver", "driver-validation"), ("plugin", "plugin-validation"),
+    ("jax", "jax-validation"), ("ici", "ici-validation")])
+def test_validator_per_proof_overrides(proof, ctr_name):
+    """validator.{driver,plugin,jax,ici} ComponentSpecs override the
+    matching validation initContainer (env replace-or-append, image,
+    resources) without touching the other proofs — the reference's
+    validator.plugin.env WITH_WORKLOAD slot."""
+    spec_dict = merged(BASE_SPEC, "validator", {proof: {
+        "env": [{"name": "PROOF_PROBE", "value": f"probe-{proof}"}],
+        "repository": "gcr.io/proofs", "image": "validator",
+        "version": "v8",
+        "resources": {"limits": {"cpu": "123m"}}}})
+    out = render_state("operator-validation", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    inits = {c["name"]: c
+             for c in ds["spec"]["template"]["spec"]["initContainers"]}
+    target = inits[ctr_name]
+    assert any(e.get("name") == "PROOF_PROBE" and
+               e.get("value") == f"probe-{proof}"
+               for e in target.get("env", []))
+    assert target["image"] == "gcr.io/proofs/validator:v8"
+    assert target["resources"] == {"limits": {"cpu": "123m"}}
+    for name, ctr in inits.items():
+        if name != ctr_name:
+            assert not any(e.get("name") == "PROOF_PROBE"
+                           for e in ctr.get("env", []))
+
+
+def test_partial_proof_override_inherits_validator_coordinates():
+    """A bare validator.driver.version must keep the validator's custom
+    registry/image — never silently flip to the stock image."""
+    spec_dict = merged(BASE_SPEC, "validator", {
+        "repository": "gcr.io/acme", "image": "val", "version": "v2",
+        "driver": {"version": "v3"}})
+    out = render_state("operator-validation", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    inits = {c["name"]: c
+             for c in ds["spec"]["template"]["spec"]["initContainers"]}
+    assert inits["driver-validation"]["image"] == "gcr.io/acme/val:v3"
+    # the untouched proofs keep the validator's own image
+    assert inits["jax-validation"]["image"] == "gcr.io/acme/val:v2"
+
+
+def test_partial_init_container_override_keeps_user_version():
+    spec_dict = merged(BASE_SPEC, "operator",
+                       {"initContainer": {"version": "v3-init"}})
+    out = render_state("libtpu-driver", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    init = next(c for c in ds["spec"]["template"]["spec"]["initContainers"]
+                if c["name"] == "tpu-driver-manager")
+    assert init["image"].endswith(":v3-init")
+
+
+def test_driver_proof_override_reaches_isolated_validation():
+    """The driver proof runs on isolated nodes too; its override must
+    land on BOTH validation states."""
+    spec_dict = merged(BASE_SPEC, "validator", {"driver": {
+        "env": [{"name": "ISOLATED_PROBE", "value": "on"}]}})
+    for state in ("operator-validation", "isolated-validation"):
+        out = render_state(state, spec_dict)
+        ds = next(d for d in yaml.safe_load_all(out)
+                  if d["kind"] == "DaemonSet")
+        drv = next(c for c in ds["spec"]["template"]["spec"]["initContainers"]
+                   if c["name"] == "driver-validation")
+        assert any(e.get("name") == "ISOLATED_PROBE"
+                   for e in drv.get("env", [])), state
+
+
 def test_validator_pull_secrets_ride_along_on_every_operand():
     """Every operand pod pulls ValidatorImage for its barrier
     initContainer; a private validator registry must not ImagePullBackOff

@@ -40,6 +40,7 @@ from ..state.operands import (
     MANIFESTS_ROOT,
     apply_common_config,
     common_data,
+    operator_init_image,
     resolve_image,
 )
 from ..state.skel import apply_objects, objects_ready
@@ -107,6 +108,8 @@ class TPUDriverReconciler(Reconciler):
                                "libtpu-installer")
             data["Image"] = resolve_image("libtpu-driver", spec,
                                           "libtpu-installer")
+            data["InitContainerImage"] = (
+                operator_init_image(ctx) or data["Image"])
             data["UpdateStrategy"] = "OnDelete"
             data["InstallDir"] = spec.install_dir or "/home/kubernetes/bin"
             data["Channel"] = spec.channel or "stable"

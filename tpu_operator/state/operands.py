@@ -87,16 +87,48 @@ def resolve_image(component: str, comp: Optional[ComponentSpec],
         return f"{DEFAULT_REPOSITORY}/{default_image}:{DEFAULT_VERSION}"
 
 
+def _merged_image(sub: ComponentSpec, parent: Optional[ComponentSpec],
+                  default_image: str) -> str:
+    """Per-field image coordinates: the sub-spec's fields win, absent
+    fields inherit from the parent spec, then the built-in defaults — a
+    partial override (just `version:`) must never silently flip to the
+    stock image (the reference resolves per-field the same way,
+    internal/image/image.go:25)."""
+    return image_path(
+        "merged",
+        sub.repository or (parent.repository if parent else None)
+        or DEFAULT_REPOSITORY,
+        sub.image or (parent.image if parent else None) or default_image,
+        sub.version or (parent.version if parent else None)
+        or DEFAULT_VERSION)
+
+
+def operator_init_image(ctx: SyncContext) -> Optional[str]:
+    """Image of operator.initContainer when explicitly configured — it
+    overrides the image of utility preflight initContainers (the
+    reference's operator.initContainer cuda-base slot); None = use the
+    operand's own image."""
+    init_ctr = ctx.spec.operator.init_container
+    if init_ctr is not None and any((init_ctr.repository, init_ctr.image,
+                                     init_ctr.version)):
+        return _merged_image(init_ctr, None, "tpu-operator")
+    return None
+
+
 def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
                 state: str, default_image: str) -> dict:
     ds = ctx.spec.daemonsets
     hp = ctx.spec.host_paths
     validator = ctx.spec.validator
+    op = ctx.spec.operator
+    init_image = operator_init_image(ctx)
+    operand_image = resolve_image(state, comp, default_image)
     return {
         "Namespace": ctx.namespace,
         "StateName": state,
         "DeployLabel": deploy_label(state),
-        "Image": resolve_image(state, comp, default_image),
+        "Image": operand_image,
+        "InitContainerImage": init_image or operand_image,
         "ImagePullPolicy": (comp.image_pull_policy if comp else None)
         or "IfNotPresent",
         # every operand pod also pulls ValidatorImage for its barrier
@@ -112,9 +144,11 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
         + DEFAULT_TOLERATIONS,
         "UpdateStrategy": ds.update_strategy or "RollingUpdate",
         "MaxUnavailable": ds.rolling_update_max_unavailable or "1",
-        "CommonLabels": {**(ds.labels or {}),
+        # precedence: operator-wide < daemonsets defaults < per-operand
+        "CommonLabels": {**(op.labels or {}), **(ds.labels or {}),
                          **((comp.labels if comp else None) or {})},
-        "CommonAnnotations": {**(ds.annotations or {}),
+        "CommonAnnotations": {**(op.annotations or {}),
+                              **(ds.annotations or {}),
                               **((comp.annotations if comp else None) or {})},
         "NodeSelector": (comp.node_selector if comp else None) or {},
         "Affinity": comp.affinity if comp else None,
@@ -195,6 +229,18 @@ def apply_common_config(objects: List[dict], data: dict) -> List[dict]:
                 _set_container_env(ctr, var)
             if i == 0 and data.get("Args"):
                 ctr["args"] = list(data["Args"])
+        # per-proof overrides target validation initContainers by name
+        # (transformValidatorComponent slot, object_controls.go:2129)
+        overrides = data.get("ProofOverrides") or {}
+        for ctr in pod.get("initContainers") or []:
+            sub = overrides.get(ctr.get("name"))
+            if not sub:
+                continue
+            for key in ("image", "imagePullPolicy", "resources"):
+                if key in sub:
+                    ctr[key] = sub[key]
+            for var in sub.get("env") or []:
+                _set_container_env(ctr, var)
     return objects
 
 
@@ -296,7 +342,38 @@ def _validation_data(ctx: SyncContext) -> dict:
     data["IciThreshold"] = spec.ici_bandwidth_threshold or 0.8
     data["RuntimeEnabled"] = ctx.spec.tpu_runtime.is_enabled()
     data["PluginEnabled"] = ctx.spec.device_plugin.is_enabled()
+    # per-proof ComponentSpec overrides (validator.plugin.env slot of the
+    # reference: transformValidatorComponent, object_controls.go:2129) —
+    # applied to the matching validation initContainer post-render
+    data["ProofOverrides"] = _proof_overrides(spec, {
+        "driver-validation": spec.driver,
+        "plugin-validation": spec.plugin,
+        "jax-validation": spec.jax,
+        "ici-validation": spec.ici,
+    })
     return data
+
+
+def _proof_overrides(validator, mapping: dict) -> dict:
+    """Resolve per-proof ComponentSpec overrides into concrete container
+    patches. Image coordinates merge per-field against the validator's
+    own spec (a bare `version:` override keeps the custom registry)."""
+    out = {}
+    for name, sub in mapping.items():
+        if sub is None:
+            continue
+        patch: dict = {}
+        if any((sub.repository, sub.image, sub.version)):
+            patch["image"] = _merged_image(sub, validator, "tpu-validator")
+        if sub.image_pull_policy:
+            patch["imagePullPolicy"] = sub.image_pull_policy
+        if sub.resources is not None:
+            patch["resources"] = sub.resources
+        if sub.env:
+            patch["env"] = sub.env
+        if patch:
+            out[name] = patch
+    return out
 
 
 def _device_plugin_data(ctx: SyncContext) -> dict:
@@ -379,13 +456,18 @@ def _vtpu_device_manager_data(ctx: SyncContext) -> dict:
 
 
 def _isolated_validation_data(ctx: SyncContext) -> dict:
-    data = common_data(ctx, ctx.spec.validator, "isolated-validation",
-                       "tpu-validator")
+    spec = ctx.spec.validator
+    data = common_data(ctx, spec, "isolated-validation", "tpu-validator")
     # vtpu proof only gates nodes that actually carve vTPUs (the virtual
     # workload config); the manifest keys the initContainer off this flag
     data["VTPUEnabled"] = ctx.spec.vtpu_device_manager.is_enabled()
     data["DefaultWorkload"] = \
         ctx.spec.sandbox_workloads.default_workload or "container"
+    # the driver proof runs on isolated nodes too — its override must
+    # apply to both validation states, not just the container plane
+    data["ProofOverrides"] = _proof_overrides(spec, {
+        "driver-validation": spec.driver,
+    })
     return data
 
 
