@@ -276,13 +276,20 @@ def test_partial_proof_override_inherits_validator_coordinates():
 
 
 def test_partial_init_container_override_keeps_user_version():
+    """A bare initContainer.version must keep the OPERAND's registry and
+    image name (air-gapped clusters mirror everything; flipping to the
+    stock ghcr.io coordinates would ImagePullBackOff the driver DS)."""
     spec_dict = merged(BASE_SPEC, "operator",
                        {"initContainer": {"version": "v3-init"}})
+    spec_dict = merged(spec_dict, "libtpu", {
+        "repository": "gcr.io/private", "image": "inst", "version": "v1"})
     out = render_state("libtpu-driver", spec_dict)
     ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
-    init = next(c for c in ds["spec"]["template"]["spec"]["initContainers"]
+    pod = ds["spec"]["template"]["spec"]
+    init = next(c for c in pod["initContainers"]
                 if c["name"] == "tpu-driver-manager")
-    assert init["image"].endswith(":v3-init")
+    assert init["image"] == "gcr.io/private/inst:v3-init"
+    assert pod["containers"][0]["image"] == "gcr.io/private/inst:v1"
 
 
 def test_driver_proof_override_reaches_isolated_validation():

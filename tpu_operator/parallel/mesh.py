@@ -17,6 +17,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# single home for the shard_map relocation fallback — every workload
+# imports it from here
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def make_varying(v, axis_name: str):
+    """Mark an array device-varying over ``axis_name`` inside shard_map —
+    plain zeros are 'replicated' and trip the varying-manual-axes check
+    once a loop body mixes in ppermuted data. Handles the
+    pvary -> pcast(to='varying') API rename."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(v, (axis_name,), to="varying")
+    return lax.pvary(v, (axis_name,))  # pragma: no cover - pre-pcast jax
+
 
 def parse_topology(topology: str) -> Tuple[int, ...]:
     """'2x2x1' -> (2, 2, 1)."""

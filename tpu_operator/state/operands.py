@@ -103,15 +103,18 @@ def _merged_image(sub: ComponentSpec, parent: Optional[ComponentSpec],
         or DEFAULT_VERSION)
 
 
-def operator_init_image(ctx: SyncContext) -> Optional[str]:
+def operator_init_image(ctx: SyncContext, parent: Optional[ComponentSpec],
+                        default_image: str) -> Optional[str]:
     """Image of operator.initContainer when explicitly configured — it
     overrides the image of utility preflight initContainers (the
     reference's operator.initContainer cuda-base slot); None = use the
-    operand's own image."""
+    operand's own image. A partial override inherits the missing
+    coordinates from the operand that carries the initContainer, so a
+    bare `version:` keeps a private registry."""
     init_ctr = ctx.spec.operator.init_container
     if init_ctr is not None and any((init_ctr.repository, init_ctr.image,
                                      init_ctr.version)):
-        return _merged_image(init_ctr, None, "tpu-operator")
+        return _merged_image(init_ctr, parent, default_image)
     return None
 
 
@@ -121,7 +124,7 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
     hp = ctx.spec.host_paths
     validator = ctx.spec.validator
     op = ctx.spec.operator
-    init_image = operator_init_image(ctx)
+    init_image = operator_init_image(ctx, comp, default_image)
     operand_image = resolve_image(state, comp, default_image)
     return {
         "Namespace": ctx.namespace,

@@ -25,12 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
-from ..parallel.mesh import ring_mesh
+from ..parallel.mesh import ring_mesh, shard_map
 from .hardware import chip_spec_for
 
 

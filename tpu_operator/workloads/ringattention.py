@@ -35,10 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+
+from ..parallel.mesh import shard_map
 
 NEG_INF = -1e30
 
@@ -219,7 +217,7 @@ def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
 
     devices = jax.devices()
     if mesh is None:
-        from ..parallel.mesh import ring_mesh
+        from ..parallel.mesh import ring_mesh, shard_map
 
         mesh = ring_mesh(devices, axis_name="sp")
     n = mesh.shape["sp"]
