@@ -292,6 +292,20 @@ def test_partial_init_container_override_keeps_user_version():
     assert pod["containers"][0]["image"] == "gcr.io/private/inst:v1"
 
 
+def test_partial_init_override_inherits_env_resolved_image(monkeypatch):
+    """The operand image may come from the *_IMAGE env fallback instead
+    of spec fields; a bare initContainer.version must inherit THAT
+    registry too."""
+    monkeypatch.setenv("LIBTPU_DRIVER_IMAGE", "gcr.io/airgap/inst:v1")
+    spec_dict = merged(BASE_SPEC, "operator",
+                       {"initContainer": {"version": "v9-env"}})
+    out = render_state("libtpu-driver", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    init = next(c for c in ds["spec"]["template"]["spec"]["initContainers"]
+                if c["name"] == "tpu-driver-manager")
+    assert init["image"] == "gcr.io/airgap/inst:v9-env"
+
+
 def test_driver_proof_override_reaches_isolated_validation():
     """The driver proof runs on isolated nodes too; its override must
     land on BOTH validation states."""

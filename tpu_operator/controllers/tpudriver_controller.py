@@ -109,8 +109,7 @@ class TPUDriverReconciler(Reconciler):
             data["Image"] = resolve_image("libtpu-driver", spec,
                                           "libtpu-installer")
             data["InitContainerImage"] = (
-                operator_init_image(ctx, spec, "libtpu-installer")
-                or data["Image"])
+                operator_init_image(ctx, data["Image"]) or data["Image"])
             data["UpdateStrategy"] = "OnDelete"
             data["InstallDir"] = spec.install_dir or "/home/kubernetes/bin"
             data["Channel"] = spec.channel or "stable"

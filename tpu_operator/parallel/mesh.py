@@ -25,6 +25,18 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
 
+def shard_map_unchecked(f, **kwargs):
+    """shard_map with the varying/replication check disabled, across the
+    check_vma (new) / check_rep (old) API rename — needed when the body
+    contains pallas_call, whose out_shape structs carry no varying-axes
+    annotation."""
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    kwargs["check_vma" if "check_vma" in params else "check_rep"] = False
+    return shard_map(f, **kwargs)
+
+
 def make_varying(v, axis_name: str):
     """Mark an array device-varying over ``axis_name`` inside shard_map —
     plain zeros are 'replicated' and trip the varying-manual-axes check

@@ -87,34 +87,48 @@ def resolve_image(component: str, comp: Optional[ComponentSpec],
         return f"{DEFAULT_REPOSITORY}/{default_image}:{DEFAULT_VERSION}"
 
 
-def _merged_image(sub: ComponentSpec, parent: Optional[ComponentSpec],
-                  default_image: str) -> str:
+def _split_ref(ref: str):
+    """'repo/prefix/name:tag' -> (repo/prefix, name, tag); handles
+    @sha256 digests, registry ports, and bare 'name:tag' refs."""
+    if "@" in ref:
+        base, version = ref.rsplit("@", 1)
+    elif ":" in ref.rsplit("/", 1)[-1]:
+        base, version = ref.rsplit(":", 1)
+    else:
+        base, version = ref, None
+    if "/" in base:
+        repo, image = base.rsplit("/", 1)
+    else:
+        repo, image = None, base
+    return repo, image, version
+
+
+def _override_image(sub: ComponentSpec, base_ref: str) -> str:
     """Per-field image coordinates: the sub-spec's fields win, absent
-    fields inherit from the parent spec, then the built-in defaults — a
-    partial override (just `version:`) must never silently flip to the
-    stock image (the reference resolves per-field the same way,
-    internal/image/image.go:25)."""
-    return image_path(
-        "merged",
-        sub.repository or (parent.repository if parent else None)
-        or DEFAULT_REPOSITORY,
-        sub.image or (parent.image if parent else None) or default_image,
-        sub.version or (parent.version if parent else None)
-        or DEFAULT_VERSION)
+    fields inherit from the RESOLVED base reference (spec fields or the
+    env fallback — whatever resolve_image produced), so a partial
+    override (just `version:`) never silently flips registries (the
+    reference resolves per-field the same way, internal/image/image.go:25)."""
+    repo, image, version = _split_ref(base_ref)
+    repo = sub.repository or repo or DEFAULT_REPOSITORY
+    image = sub.image or image
+    version = sub.version or version or DEFAULT_VERSION
+    sep = "@" if version.startswith("sha256:") else ":"
+    return f"{repo}/{image}{sep}{version}"
 
 
-def operator_init_image(ctx: SyncContext, parent: Optional[ComponentSpec],
-                        default_image: str) -> Optional[str]:
+def operator_init_image(ctx: SyncContext, operand_image: str) -> Optional[str]:
     """Image of operator.initContainer when explicitly configured — it
     overrides the image of utility preflight initContainers (the
     reference's operator.initContainer cuda-base slot); None = use the
     operand's own image. A partial override inherits the missing
-    coordinates from the operand that carries the initContainer, so a
-    bare `version:` keeps a private registry."""
+    coordinates from the operand's RESOLVED image, so a bare `version:`
+    keeps a private registry whether it came from spec fields or the
+    *_IMAGE env fallback."""
     init_ctr = ctx.spec.operator.init_container
     if init_ctr is not None and any((init_ctr.repository, init_ctr.image,
                                      init_ctr.version)):
-        return _merged_image(init_ctr, parent, default_image)
+        return _override_image(init_ctr, operand_image)
     return None
 
 
@@ -124,8 +138,8 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
     hp = ctx.spec.host_paths
     validator = ctx.spec.validator
     op = ctx.spec.operator
-    init_image = operator_init_image(ctx, comp, default_image)
     operand_image = resolve_image(state, comp, default_image)
+    init_image = operator_init_image(ctx, operand_image)
     return {
         "Namespace": ctx.namespace,
         "StateName": state,
@@ -348,7 +362,7 @@ def _validation_data(ctx: SyncContext) -> dict:
     # per-proof ComponentSpec overrides (validator.plugin.env slot of the
     # reference: transformValidatorComponent, object_controls.go:2129) —
     # applied to the matching validation initContainer post-render
-    data["ProofOverrides"] = _proof_overrides(spec, {
+    data["ProofOverrides"] = _proof_overrides(data["Image"], {
         "driver-validation": spec.driver,
         "plugin-validation": spec.plugin,
         "jax-validation": spec.jax,
@@ -357,17 +371,18 @@ def _validation_data(ctx: SyncContext) -> dict:
     return data
 
 
-def _proof_overrides(validator, mapping: dict) -> dict:
+def _proof_overrides(validator_image: str, mapping: dict) -> dict:
     """Resolve per-proof ComponentSpec overrides into concrete container
     patches. Image coordinates merge per-field against the validator's
-    own spec (a bare `version:` override keeps the custom registry)."""
+    RESOLVED image (a bare `version:` override keeps the custom
+    registry, whether it came from spec fields or the env fallback)."""
     out = {}
     for name, sub in mapping.items():
         if sub is None:
             continue
         patch: dict = {}
         if any((sub.repository, sub.image, sub.version)):
-            patch["image"] = _merged_image(sub, validator, "tpu-validator")
+            patch["image"] = _override_image(sub, validator_image)
         if sub.image_pull_policy:
             patch["imagePullPolicy"] = sub.image_pull_policy
         if sub.resources is not None:
@@ -468,7 +483,7 @@ def _isolated_validation_data(ctx: SyncContext) -> dict:
         ctx.spec.sandbox_workloads.default_workload or "container"
     # the driver proof runs on isolated nodes too — its override must
     # apply to both validation states, not just the container plane
-    data["ProofOverrides"] = _proof_overrides(spec, {
+    data["ProofOverrides"] = _proof_overrides(data["Image"], {
         "driver-validation": spec.driver,
     })
     return data

@@ -90,12 +90,13 @@ def _moe_local(params, x, axis_name: str, capacity: int):
 
 def moe_forward(params: dict, x: jax.Array, mesh: Mesh,
                 axis_name: str = "expert",
-                capacity: int = None) -> jax.Array:
+                capacity: "int | None" = None) -> jax.Array:
     """x: [B, D], batch sharded across the expert axis (each device owns
-    B / n_devices resident tokens). One expert per device."""
+    B / n_devices resident tokens). One expert per device. An explicit
+    capacity=0 means drop everything (it is not a falsy default)."""
     n_dev = int(np.prod(list(mesh.shape.values())))
     b_local = x.shape[0] // n_dev
-    cap = capacity or b_local
+    cap = b_local if capacity is None else capacity
     fn = shard_map(
         partial(_moe_local, axis_name=axis_name, capacity=cap),
         mesh=mesh,

@@ -36,7 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import shard_map
+from ..parallel.mesh import shard_map, shard_map_unchecked
 
 NEG_INF = -1e30
 
@@ -158,7 +158,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     (forward/inference path); the default einsum tile is differentiable
     and is what the training step uses."""
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    # pallas_call's out_shape structs carry no varying-mesh-axes
+    # annotation, which trips shard_map's vma check — the fused path
+    # disables it (correctness is oracle-proven in tests)
+    smap = shard_map_unchecked if use_flash else shard_map
+    fn = smap(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -217,7 +221,7 @@ def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
 
     devices = jax.devices()
     if mesh is None:
-        from ..parallel.mesh import ring_mesh, shard_map
+        from ..parallel.mesh import ring_mesh
 
         mesh = ring_mesh(devices, axis_name="sp")
     n = mesh.shape["sp"]
