@@ -306,6 +306,27 @@ def test_partial_init_override_inherits_env_resolved_image(monkeypatch):
     assert init["image"] == "gcr.io/airgap/inst:v9-env"
 
 
+def test_fully_qualified_override_image_passes_through():
+    """A fully-qualified image: in an initContainer/proof override must
+    pass through verbatim (image_path's first-branch semantics), never
+    be re-prefixed."""
+    spec_dict = merged(BASE_SPEC, "operator", {
+        "initContainer": {"image": "gcr.io/x/inst:v9"}})
+    out = render_state("libtpu-driver", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    init = next(c for c in ds["spec"]["template"]["spec"]["initContainers"]
+                if c["name"] == "tpu-driver-manager")
+    assert init["image"] == "gcr.io/x/inst:v9"
+
+    spec_dict = merged(BASE_SPEC, "validator", {
+        "jax": {"image": "gcr.io/x/val@sha256:" + "ab" * 32}})
+    out = render_state("operator-validation", spec_dict)
+    ds = next(d for d in yaml.safe_load_all(out) if d["kind"] == "DaemonSet")
+    jax_init = next(c for c in ds["spec"]["template"]["spec"]
+                    ["initContainers"] if c["name"] == "jax-validation")
+    assert jax_init["image"] == "gcr.io/x/val@sha256:" + "ab" * 32
+
+
 def test_driver_proof_override_reaches_isolated_validation():
     """The driver proof runs on isolated nodes too; its override must
     land on BOTH validation states."""

@@ -182,6 +182,28 @@ class TestCRImageCollection:
         assert len(errs) == 1 and errs[0].startswith("/spec/devicePlugin")
 
 
+class TestTPUDriverImages:
+    def test_tpudriver_cr_image_collected_and_resolved(self, registry):
+        cr = {"kind": "TPUDriver", "spec": {
+            "repository": f"{registry.host}/tpu-operator",
+            "image": "libtpu", "version": "v2.0.0"}}
+        refs = collect_cr_images(cr)
+        assert refs and refs[0][1].endswith("/tpu-operator/libtpu:v2.0.0")
+        assert resolve_cr_images(cr, RegistryResolver(plain_http=True)) == []
+
+    def test_tpudriver_cli_verify_images(self, registry, tmp_path, capsys):
+        f = tmp_path / "driver.yaml"
+        f.write_text(yaml.safe_dump({
+            "apiVersion": "tpu.graft.dev/v1alpha1", "kind": "TPUDriver",
+            "metadata": {"name": "d"},
+            "spec": {"repository": f"{registry.host}/tpu-operator",
+                     "image": "libtpu", "version": "v-missing"}}))
+        rc = main(["validate", "tpudriver", "-f", str(f),
+                   "--verify-images", "--plain-http"])
+        assert rc == 1
+        assert "not found" in capsys.readouterr().err
+
+
 class TestCLIVerifyImages:
     def policy(self, tmp_path, host, version):
         f = tmp_path / "policy.yaml"

@@ -424,6 +424,17 @@ class TestUpgradeFailureSemantics:
             "annotations") or {}
         assert L.UPGRADE_FAILED_REASON not in anns
 
+    def test_upgrade_units_metric_counts_slices_once(self):
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # the 2-host slice is in flight = ONE unit (nodes gauge says 2)
+        assert OPERATOR_METRICS.upgrade_units_in_progress._value.get() == 1
+        assert OPERATOR_METRICS.driver_upgrades_in_progress._value.get() == 2
+
     def test_failed_state_surfaced_in_metrics(self):
         from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
 

@@ -77,6 +77,16 @@ class ClusterPolicyReconciler(Reconciler):
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self, request: Request) -> Result:
+        import time as _time
+
+        started = _time.perf_counter()
+        try:
+            return self._reconcile(request)
+        finally:
+            OPERATOR_METRICS.reconcile_duration.set(
+                _time.perf_counter() - started)
+
+    def _reconcile(self, request: Request) -> Result:
         cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
         if cr is None:
             return Result()

@@ -639,6 +639,12 @@ class UpgradeReconciler(Reconciler):
 
         pending = [n for n, s in node_states.items()
                    if s not in (STATE_DONE,)]
+        # unit state after this pass = the recorded state of any member
+        # (the unit loop keeps them in lockstep); the member dicts
+        # themselves are pre-pass snapshots
+        OPERATOR_METRICS.upgrade_units_in_progress.set(
+            sum(1 for u in units
+                if node_states.get(u[0].name) in IN_PROGRESS_STATES))
         OPERATOR_METRICS.driver_upgrades_in_progress.set(
             sum(1 for s in node_states.values() if s in IN_PROGRESS_STATES))
         OPERATOR_METRICS.driver_upgrades_pending.set(

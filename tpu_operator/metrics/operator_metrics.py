@@ -1,8 +1,9 @@
 """Operator-level Prometheus metrics.
 
 The reference exposes 17 series (controllers/operator_metrics.go:29-201);
-this is the TPU rename of the set that applies (driver-toolkit/OpenShift
-series have no analog and are dropped per SURVEY.md section 7).
+this is the TPU set at the same count: the carried-over series renamed,
+the driver-toolkit/OpenShift ones (no analog, SURVEY.md section 7)
+replaced by TPU-specific ones (chips/pools/upgrade-unit gauges).
 """
 
 from __future__ import annotations
@@ -64,6 +65,13 @@ class OperatorMetrics:
         self.upgrade_state_nodes = g(
             "tpu_operator_upgrade_state_nodes",
             "Nodes per upgrade FSM state", labelnames=("state",))
+        self.upgrade_units_in_progress = g(
+            "tpu_operator_upgrade_units_in_progress",
+            "Upgrade units (multi-host slices count once) currently "
+            "moving through the FSM")
+        self.reconcile_duration = g(
+            "tpu_operator_reconciliation_duration_seconds",
+            "Wall time of the last full TPUClusterPolicy reconciliation")
 
 
 OPERATOR_METRICS = OperatorMetrics()

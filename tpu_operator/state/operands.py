@@ -109,6 +109,11 @@ def _override_image(sub: ComponentSpec, base_ref: str) -> str:
     env fallback — whatever resolve_image produced), so a partial
     override (just `version:`) never silently flips registries (the
     reference resolves per-field the same way, internal/image/image.go:25)."""
+    # a fully-qualified image: passes through verbatim, like image_path's
+    # first branch does for every other image field
+    if sub.image and "/" in sub.image and (
+            ":" in sub.image.split("/")[-1] or "@" in sub.image):
+        return sub.image
     repo, image, version = _split_ref(base_ref)
     repo = sub.repository or repo or DEFAULT_REPOSITORY
     image = sub.image or image
