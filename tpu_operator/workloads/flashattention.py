@@ -135,9 +135,76 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Plain single-device flash attention, [B, S, H, D] layout (the
-    drop-in for reference_attention)."""
+    drop-in for reference_attention). Differentiable: the backward pass
+    is the memory-efficient chunked recomputation (see _flash_bwd)."""
     B, S, H, D = q.shape
     fold = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    out, _, _ = flash_attention_blocks(
-        fold(q), fold(k), fold(v), 0, 0, causal=causal, interpret=interpret)
+    out = _flash_fwd_core(fold(q), fold(k), fold(v), causal, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# backward pass: O(S * chunk) memory via chunked recomputation
+# ---------------------------------------------------------------------------
+# The forward saves only (out, m, l) — the flash residuals — and the
+# backward re-materializes the probability tiles one K-chunk at a time
+# (the standard flash-attention backward recurrence: D = rowsum(dO * O),
+# dS = P * (dP - D)), so HBM stays O(S*D) end to end instead of the
+# O(S^2) a naive autodiff of attention would spill.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_fwd_core(q, k, v, causal: bool, interpret):
+    out, _, _ = flash_attention_blocks(q, k, v, 0, 0, causal=causal,
+                                       interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, interpret):
+    out, m, l = flash_attention_blocks(q, k, v, 0, 0, causal=causal,
+                                       interpret=interpret)
+    return out, (q, k, v, out, m, l)
+
+
+def _flash_bwd_rule(causal, interpret, res, dout, chunk: int = 512):
+    q, k, v, out, m, l = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    chunk = min(chunk, sk)
+    scale = 1.0 / np.sqrt(d)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    # D_i = sum_j dO_ij * O_ij (the softmax-normalizer gradient term)
+    delta = jnp.sum(dout * out, axis=-1)                     # [BH, Sq]
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, chunk), 0)
+
+    def per_chunk(dq_acc, j):
+        ks = jax.lax.dynamic_slice_in_dim(k, j * chunk, chunk, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v, j * chunk, chunk, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale        # [BH,Sq,C]
+        if causal:
+            k_pos = j * chunk + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, chunk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l_safe[..., None]
+        p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
+        dv_c = jnp.einsum("bqk,bqd->bkd", p, dout)
+        dp = jnp.einsum("bqd,bkd->bqk", dout, vs)
+        ds = p * (dp - delta[..., None])                     # [BH,Sq,C]
+        # dq accumulates in the carry (stacking per-chunk dq would be
+        # O(Sq*Sk*D/chunk) — the spill this backward exists to avoid);
+        # dk/dv chunks stack to O(Sk*D) total, which is fine
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, ks) * scale
+        dk_c = jnp.einsum("bqk,bqd->bkd", ds, q) * scale
+        return dq_acc, (dk_c, dv_c)
+
+    n_chunks = sk // chunk
+    dq, (dk_cs, dv_cs) = jax.lax.scan(
+        per_chunk, jnp.zeros((bh, sq, d), jnp.float32),
+        jnp.arange(n_chunks))
+    dk = jnp.moveaxis(dk_cs, 0, 1).reshape(bh, sk, d)
+    dv = jnp.moveaxis(dv_cs, 0, 1).reshape(bh, sk, d)
+    # cotangents must match the primal input dtypes (bf16 on TPU)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_fwd_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
