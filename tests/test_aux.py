@@ -137,3 +137,35 @@ class TestMustGather:
         assert any("tpuclusterpolicy" in f.name for f in crs)
         nodes = list((out / "nodes").glob("*.yaml"))
         assert len(nodes) == 1
+
+    def test_upgrade_report_digest(self, tmp_path):
+        """A stuck/failed rollout must be readable from the bundle: per-
+        node FSM state, deadline stamps, failure reason, cordon."""
+        import yaml as _yaml
+
+        from tpu_operator.api import labels as L
+        from tpu_operator.cli.must_gather import gather
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        c.add_node("h0", labels={L.UPGRADE_STATE: "failed"})
+        c.patch("v1", "Node", "h0", {
+            "metadata": {"annotations": {
+                L.UPGRADE_FAILED_AT: "123.0",
+                L.UPGRADE_FAILED_REASON: "drain timed out after 300s"}},
+            "spec": {"unschedulable": True}})
+        c.add_node("h1", labels={})  # quiet node: not in the report
+        c.create({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                  "metadata": {"name": "guard", "namespace": "default"},
+                  "spec": {"minAvailable": 1}})
+        out = tmp_path / "bundle"
+        summary = gather(c, out)
+        report = _yaml.safe_load(
+            (out / "upgrade" / "upgrade-report.yaml").read_text())
+        assert report == {"h0": {"state": "failed", "failedAt": "123.0",
+                                 "failedReason": "drain timed out after "
+                                                 "300s",
+                                 "cordoned": True}}
+        assert summary["upgrade_nodes"] == 1
+        assert summary["kinds"]["PodDisruptionBudget"] == 1
+        assert list((out / "upgrade").glob("poddisruptionbudget_*.yaml"))

@@ -29,8 +29,38 @@ DUMP_KINDS = [
     ("v1", "Pod", "pods"),
     ("v1", "ConfigMap", "config"),
     ("v1", "Service", "operands"),
+    # drains block on these; a stuck upgrade is unreadable without them
+    ("policy/v1", "PodDisruptionBudget", "upgrade"),
     ("coordination.k8s.io/v1", "Lease", "leader"),
 ]
+
+
+def _upgrade_report(nodes_list) -> dict:
+    """Per-node upgrade FSM digest: state label, stage deadline stamps,
+    failure reason, cordon — the first thing support needs for a stuck
+    or failed rollout. Derived from an already-listed Node snapshot so
+    the report and the nodes/ dump cannot diverge."""
+    from ..api import labels as L
+
+    nodes = {}
+    for node in nodes_list:
+        meta = node.get("metadata", {})
+        labels = meta.get("labels") or {}
+        anns = meta.get("annotations") or {}
+        entry = {}
+        if L.UPGRADE_STATE in labels:
+            entry["state"] = labels[L.UPGRADE_STATE]
+        for key, name in ((L.UPGRADE_STAGE_STARTED, "stageStarted"),
+                          (L.UPGRADE_FAILED_AT, "failedAt"),
+                          (L.UPGRADE_FAILED_REASON, "failedReason"),
+                          (L.DRIVER_UPGRADE_ENABLED, "upgradeEnabled")):
+            if key in anns:
+                entry[name] = anns[key]
+        if (node.get("spec") or {}).get("unschedulable"):
+            entry["cordoned"] = True
+        if entry:
+            nodes[meta.get("name", "unnamed")] = entry
+    return nodes
 
 
 def gather(client, out_dir: pathlib.Path) -> dict:
@@ -41,6 +71,19 @@ def gather(client, out_dir: pathlib.Path) -> dict:
         except Exception as e:
             summary["errors"].append(f"list {kind}: {e}")
             continue
+        if kind == "Node":
+            # the upgrade report derives from the SAME snapshot the
+            # nodes/ dump writes (one LIST, no divergence)
+            try:
+                report = _upgrade_report(objs)
+                if report:
+                    d = out_dir / "upgrade"
+                    d.mkdir(parents=True, exist_ok=True)
+                    (d / "upgrade-report.yaml").write_text(
+                        yaml.safe_dump(report, sort_keys=True))
+                    summary["upgrade_nodes"] = len(report)
+            except Exception as e:
+                summary["errors"].append(f"upgrade report: {e}")
         d = out_dir / subdir
         d.mkdir(parents=True, exist_ok=True)
         for obj in objs:

@@ -173,13 +173,21 @@ def _flash_bwd_rule(causal, interpret, res, dout, chunk: int = 512):
     chunk = min(chunk, sk)
     scale = 1.0 / np.sqrt(d)
     l_safe = jnp.where(l == 0.0, 1.0, l)
+    in_dtypes = (q.dtype, k.dtype, v.dtype)
+    # compute in f32 like the forward kernel does (lines 44,53-54):
+    # recomputed P must match the forward's P, not a bf16 quantization
+    q = q.astype(jnp.float32)
+    dout = dout.astype(jnp.float32)
+    out = out.astype(jnp.float32)
     # D_i = sum_j dO_ij * O_ij (the softmax-normalizer gradient term)
     delta = jnp.sum(dout * out, axis=-1)                     # [BH, Sq]
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, chunk), 0)
 
     def per_chunk(dq_acc, j):
-        ks = jax.lax.dynamic_slice_in_dim(k, j * chunk, chunk, axis=1)
-        vs = jax.lax.dynamic_slice_in_dim(v, j * chunk, chunk, axis=1)
+        ks = jax.lax.dynamic_slice_in_dim(
+            k, j * chunk, chunk, axis=1).astype(jnp.float32)
+        vs = jax.lax.dynamic_slice_in_dim(
+            v, j * chunk, chunk, axis=1).astype(jnp.float32)
         s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale        # [BH,Sq,C]
         if causal:
             k_pos = j * chunk + jax.lax.broadcasted_iota(
@@ -204,7 +212,7 @@ def _flash_bwd_rule(causal, interpret, res, dout, chunk: int = 512):
     dk = jnp.moveaxis(dk_cs, 0, 1).reshape(bh, sk, d)
     dv = jnp.moveaxis(dv_cs, 0, 1).reshape(bh, sk, d)
     # cotangents must match the primal input dtypes (bf16 on TPU)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return tuple(t.astype(dt) for t, dt in zip((dq, dk, dv), in_dtypes))
 
 
 _flash_fwd_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
