@@ -239,6 +239,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
+            # chunked framing like a real apiserver: without it the
+            # client's buffered reads sit on small events until more
+            # bytes arrive (watch then only "works" on a busy cluster)
+            self.send_header("Transfer-Encoding", "chunked")
             self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.flush()
@@ -250,10 +254,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if evt is None:
                     break
                 try:
-                    self.wfile.write((json.dumps(evt) + "\n").encode())
+                    payload = (json.dumps(evt) + "\n").encode()
+                    self.wfile.write(f"{len(payload):x}\r\n".encode())
+                    self.wfile.write(payload + b"\r\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     break
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
         finally:
             with self.st.lock:
                 try:

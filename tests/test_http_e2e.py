@@ -215,6 +215,45 @@ class TestHTTPLifecycle:
         wait_for(ops, env_present,
                  "reconcile resumed after watch streams dropped")
 
+    def test_leader_election_failover_over_http(self, cluster):
+        """Two elector instances against the HTTP apiserver's Lease: one
+        wins, reconciles; when it stops (releasing the lease), the
+        standby takes over and the operator keeps converging — the
+        leader-elect HA mode end to end (cmd/gpu-operator/main.go
+        --leader-elect slot)."""
+        from tpu_operator.runtime.leaderelection import LeaderElector
+
+        srv, ops = cluster
+        events = []
+        electors = []
+        for ident in ("op-a", "op-b"):
+            el = LeaderElector(
+                HTTPClient(config=KubeConfig(server=srv.url, token="t",
+                                             namespace=NS)),
+                identity=ident, lease_duration_s=2.0,
+                renew_interval_s=0.2,
+                on_started_leading=lambda i=ident: events.append(i))
+            electors.append(el)
+        electors[0].start()
+        deadline = time.time() + 20
+        while time.time() < deadline and not electors[0].is_leader:
+            time.sleep(0.1)
+        assert electors[0].is_leader
+        electors[1].start()
+        time.sleep(1.0)
+        assert not electors[1].is_leader  # lease held by op-a
+        # leader steps down (releases) -> standby must take over
+        electors[0].stop()
+        deadline = time.time() + 20
+        while time.time() < deadline and not electors[1].is_leader:
+            time.sleep(0.1)
+        electors[1].stop()
+        assert events == ["op-a", "op-b"]
+        # the operator itself kept working throughout the handoff
+        install(ops)
+        wait_for(ops, lambda: cr_state(ops) == "ready",
+                 "converged across leadership handoff")
+
     def test_mid_reconcile_conflict_is_retried(self, cluster):
         srv, ops = cluster
         install(ops)
