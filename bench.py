@@ -62,13 +62,17 @@ def _scrape_telemetry(platform: str) -> dict | None:
         # guarantee non-synthetic inputs for this scrape
         os.environ.pop("TPU_FAKE_CHIPS", None)
         os.environ.pop("TPU_HEALTH_ENGINE_INFO", None)
-        samples = libtpu_exporter.collect_sysfs()
-        source = "sysfs"
+        samples = libtpu_exporter.collect_native()
+        source = "native"
+        if not samples:
+            samples = libtpu_exporter.collect_sysfs()
+            source = "sysfs"
         if not samples:
             samples = libtpu_exporter.collect_jax()
             source = "jax"
         if not samples:
-            return {"error": "no sysfs counters and no jax chips visible"}
+            return {"error": "no native/sysfs counters and no jax chips "
+                             "visible"}
         if source == "jax":
             os.environ["LIBTPU_EXPORTER_USE_JAX"] = "true"
         srv = libtpu_exporter.serve(0, node_name="bench", interval=3600.0)

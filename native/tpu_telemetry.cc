@@ -1,0 +1,93 @@
+// tpu-telemetry: native per-chip telemetry scraper — the native half of
+// the metrics-exporter stack (the slot DCGM's C++ host engine fills in
+// the reference; the exporter DaemonSet runs this binary instead of
+// linking a Python sysfs walker into the hot path).
+//
+// Reads the TPU VM kernel's accel sysfs counters and emits one JSON
+// array on stdout, one object per chip:
+//   [{"chip_id": "accel0", "duty_cycle_pct": N, "hbm_used_bytes": N,
+//     "hbm_total_bytes": N, "tensorcore_util_pct": N,
+//     "temperature_c": N|null}, ...]
+//
+// The sysfs root defaults to /sys/class/accel and is overridable with
+// --root DIR or $TPU_SYSFS_ROOT (tests point it at a fake tree).
+// Exit code: 0 when at least one chip directory exists, 1 otherwise
+// (the Python exporter falls back to its own collectors on nonzero).
+//
+// Build: make -C native   (g++ -O2; no dependencies)
+
+#include <sys/stat.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::vector<std::string> ListChipDirs(const std::string& root) {
+  std::vector<std::string> out;
+  DIR* d = opendir(root.c_str());
+  if (d == nullptr) return out;
+  while (dirent* e = readdir(d)) {
+    std::string name(e->d_name);
+    if (name.rfind("accel", 0) != 0) continue;
+    out.push_back(name);
+  }
+  closedir(d);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+// -1 = counter file absent/unreadable (callers decide the default)
+long long ReadCounter(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return -1;
+  char buf[64] = {0};
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  if (n == 0) return -1;
+  char* end = nullptr;
+  long long v = strtoll(buf, &end, 10);
+  if (end == buf) return -1;
+  return v;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string root = "/sys/class/accel";
+  if (const char* env = getenv("TPU_SYSFS_ROOT")) root = env;
+  for (int i = 1; i < argc; ++i) {
+    if (strcmp(argv[i], "--root") == 0 && i + 1 < argc) root = argv[++i];
+  }
+
+  std::vector<std::string> chips = ListChipDirs(root);
+  printf("[");
+  bool first = true;
+  for (const std::string& chip : chips) {
+    const std::string base = root + "/" + chip + "/";
+    long long duty = ReadCounter(base + "duty_cycle_pct");
+    long long used = ReadCounter(base + "hbm_used_bytes");
+    long long total = ReadCounter(base + "hbm_total_bytes");
+    long long tc = ReadCounter(base + "tensorcore_util_pct");
+    long long millic = ReadCounter(base + "temp_millic");
+    if (!first) printf(", ");
+    first = false;
+    printf("{\"chip_id\": \"%s\", \"duty_cycle_pct\": %lld, "
+           "\"hbm_used_bytes\": %lld, \"hbm_total_bytes\": %lld, "
+           "\"tensorcore_util_pct\": %lld, ",
+           chip.c_str(), duty < 0 ? 0 : duty, used < 0 ? 0 : used,
+           total < 0 ? 0 : total, tc < 0 ? 0 : tc);
+    if (millic > 0) {
+      printf("\"temperature_c\": %.3f}", static_cast<double>(millic) / 1000.0);
+    } else {
+      printf("\"temperature_c\": null}");
+    }
+  }
+  printf("]\n");
+  return chips.empty() ? 1 : 0;
+}

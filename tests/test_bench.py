@@ -138,7 +138,10 @@ def test_scrape_telemetry_full_pipeline(monkeypatch):
         lambda: [ChipSample("accel0", duty_cycle_pct=60.0,
                             hbm_used=2 << 30, hbm_total=16 << 30,
                             temperature_c=50.0)])
-    # collect_local (used by the served exporter) consults sysfs first
+    # hermeticity: the native scraper precedes sysfs in collect_local —
+    # pin it to a nonexistent binary so the stub is what gets served
+    # even on a host with real /sys/class/accel chips
+    monkeypatch.setenv("TPU_TELEMETRY_BIN", "/nonexistent/tpu-telemetry")
     monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
     block = bench._scrape_telemetry("tpu")
     assert block["source"] == "sysfs"

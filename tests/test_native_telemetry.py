@@ -1,0 +1,100 @@
+"""The C++ tpu-telemetry scraper (native/tpu_telemetry.cc) against a
+fake sysfs tree, and its integration as the exporter's preferred on-node
+backend (the native slot DCGM's host engine fills in the reference)."""
+
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+NATIVE_DIR = pathlib.Path(__file__).resolve().parents[1] / "native"
+
+
+@pytest.fixture(scope="module")
+def telemetry_bin():
+    subprocess.run(["make", "-C", str(NATIVE_DIR), "tpu-telemetry"],
+                   check=True, capture_output=True)
+    return str(NATIVE_DIR / "tpu-telemetry")
+
+
+def fake_sysfs(root: pathlib.Path, chips: int = 2) -> pathlib.Path:
+    for i in range(chips):
+        d = root / f"accel{i}"
+        d.mkdir(parents=True)
+        (d / "duty_cycle_pct").write_text(f"{40 + i}\n")
+        (d / "hbm_used_bytes").write_text(str((i + 1) * (1 << 30)))
+        (d / "hbm_total_bytes").write_text(str(16 << 30))
+        (d / "tensorcore_util_pct").write_text(f"{55 + i}")
+        (d / "temp_millic").write_text(f"{45000 + i * 1000}")
+    return root
+
+
+class TestBinary:
+    def test_json_contract(self, telemetry_bin, tmp_path):
+        fake_sysfs(tmp_path)
+        out = subprocess.run([telemetry_bin, "--root", str(tmp_path)],
+                             capture_output=True, text=True)
+        assert out.returncode == 0
+        rows = json.loads(out.stdout)
+        assert [r["chip_id"] for r in rows] == ["accel0", "accel1"]
+        assert rows[0]["duty_cycle_pct"] == 40
+        assert rows[1]["hbm_used_bytes"] == 2 << 30
+        assert rows[0]["hbm_total_bytes"] == 16 << 30
+        assert rows[0]["temperature_c"] == 45.0
+
+    def test_env_root(self, telemetry_bin, tmp_path):
+        fake_sysfs(tmp_path, chips=1)
+        out = subprocess.run([telemetry_bin], capture_output=True,
+                             text=True,
+                             env={"TPU_SYSFS_ROOT": str(tmp_path),
+                                  "PATH": "/usr/bin:/bin"})
+        assert out.returncode == 0
+        assert len(json.loads(out.stdout)) == 1
+
+    def test_no_chips_exits_nonzero(self, telemetry_bin, tmp_path):
+        out = subprocess.run([telemetry_bin, "--root", str(tmp_path)],
+                             capture_output=True, text=True)
+        assert out.returncode == 1
+        assert json.loads(out.stdout) == []
+
+    def test_missing_counters_default_zero(self, telemetry_bin, tmp_path):
+        d = tmp_path / "accel0"
+        d.mkdir()
+        (d / "hbm_total_bytes").write_text("1024")
+        out = subprocess.run([telemetry_bin, "--root", str(tmp_path)],
+                             capture_output=True, text=True)
+        rows = json.loads(out.stdout)
+        assert rows[0]["duty_cycle_pct"] == 0
+        assert rows[0]["hbm_total_bytes"] == 1024
+        assert rows[0]["temperature_c"] is None
+
+
+class TestExporterIntegration:
+    def test_native_backend_preferred(self, telemetry_bin, tmp_path,
+                                      monkeypatch):
+        """collect_local must source chips through the native scraper when
+        it works, and the full exporter pipeline serves those values."""
+        from tpu_operator.metrics import libtpu_exporter
+
+        fake_sysfs(tmp_path)
+        monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
+        monkeypatch.setenv("TPU_TELEMETRY_BIN", telemetry_bin)
+        monkeypatch.setenv("TPU_SYSFS_ROOT", str(tmp_path))
+        samples = libtpu_exporter.collect_local()
+        assert [s.chip_id for s in samples] == ["accel0", "accel1"]
+        assert samples[0].temperature_c == 45.0
+
+        exporter = libtpu_exporter.LibtpuExporter(node_name="n0")
+        assert exporter.collect_once() == 2
+        text = exporter.render().decode()
+        assert 'tpu_hbm_total_bytes{chip="accel0",node="n0"}' in text
+
+    def test_broken_binary_falls_through(self, tmp_path, monkeypatch):
+        from tpu_operator.metrics import libtpu_exporter
+
+        monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
+        monkeypatch.setenv("TPU_TELEMETRY_BIN", "/nonexistent/bin")
+        monkeypatch.setenv("LIBTPU_EXPORTER_USE_JAX", "")
+        # native fails -> python sysfs walk (also empty here) -> []
+        assert libtpu_exporter.collect_native() == []

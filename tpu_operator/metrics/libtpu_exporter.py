@@ -52,6 +52,39 @@ def collect_fake() -> List[ChipSample]:
             for i in range(n)]
 
 
+def collect_native() -> List[ChipSample]:
+    """Preferred on-node backend: the C++ tpu-telemetry scraper
+    (native/tpu_telemetry.cc — the native slot DCGM's host engine fills
+    in the reference). Empty list when the binary is absent or finds no
+    chips; callers fall through to the Python collectors."""
+    import json
+    import subprocess
+
+    binary = os.environ.get("TPU_TELEMETRY_BIN", "tpu-telemetry")
+    try:
+        out = subprocess.run([binary], capture_output=True, timeout=10,
+                             text=True)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0 or not out.stdout.strip():
+        return []
+    try:
+        rows = json.loads(out.stdout)
+        return [ChipSample(
+            r.get("chip_id", f"accel{i}"),
+            duty_cycle_pct=float(r.get("duty_cycle_pct") or 0),
+            hbm_used=int(r.get("hbm_used_bytes") or 0),
+            hbm_total=int(r.get("hbm_total_bytes") or 0),
+            tensorcore_util_pct=float(r.get("tensorcore_util_pct") or 0),
+            temperature_c=r.get("temperature_c"))
+            for i, r in enumerate(rows)]
+    except (json.JSONDecodeError, TypeError, ValueError, AttributeError):
+        # any unexpected shape (binary version skew, PATH shadowing) must
+        # fall through to the Python collectors, not crash the engine
+        log.warning("tpu-telemetry produced unusable output; ignoring")
+        return []
+
+
 def collect_sysfs() -> List[ChipSample]:
     out = []
     for path in sorted(glob.glob("/sys/class/accel/accel*")):
@@ -112,9 +145,13 @@ def collect_remote(info: str) -> List[ChipSample]:
 
 
 def collect_local() -> List[ChipSample]:
-    """On-node sampling chain (what the health engine itself runs)."""
+    """On-node sampling chain (what the health engine itself runs):
+    fake (tests) -> native scraper -> Python sysfs walk -> JAX."""
     if os.environ.get("TPU_FAKE_CHIPS"):
         return collect_fake()
+    samples = collect_native()
+    if samples:
+        return samples
     samples = collect_sysfs()
     if samples:
         return samples
