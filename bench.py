@@ -59,9 +59,11 @@ def _scrape_telemetry(platform: str) -> dict | None:
 
         from tpu_operator.metrics import health_engine, libtpu_exporter
 
-        # guarantee non-synthetic inputs for this scrape
-        os.environ.pop("TPU_FAKE_CHIPS", None)
-        os.environ.pop("TPU_HEALTH_ENGINE_INFO", None)
+        # guarantee non-synthetic inputs for this scrape (incl. the
+        # native scraper's binary/root overrides the tests use)
+        for var in ("TPU_FAKE_CHIPS", "TPU_HEALTH_ENGINE_INFO",
+                    "TPU_TELEMETRY_BIN", "TPU_SYSFS_ROOT"):
+            os.environ.pop(var, None)
         samples = libtpu_exporter.collect_native()
         source = "native"
         if not samples:

@@ -90,11 +90,31 @@ class TestExporterIntegration:
         text = exporter.render().decode()
         assert 'tpu_hbm_total_bytes{chip="accel0",node="n0"}' in text
 
-    def test_broken_binary_falls_through(self, tmp_path, monkeypatch):
+    def test_broken_binary_falls_through_to_same_tree(self, tmp_path,
+                                                      monkeypatch):
+        """A native-binary failure must fall through to the Python sysfs
+        walk reading the SAME root, producing the same chips."""
         from tpu_operator.metrics import libtpu_exporter
 
+        fake_sysfs(tmp_path)
         monkeypatch.delenv("TPU_FAKE_CHIPS", raising=False)
         monkeypatch.setenv("TPU_TELEMETRY_BIN", "/nonexistent/bin")
-        monkeypatch.setenv("LIBTPU_EXPORTER_USE_JAX", "")
-        # native fails -> python sysfs walk (also empty here) -> []
+        monkeypatch.setenv("TPU_SYSFS_ROOT", str(tmp_path))
+        assert libtpu_exporter.collect_native() == []
+        samples = libtpu_exporter.collect_local()
+        assert [s.chip_id for s in samples] == ["accel0", "accel1"]
+        assert samples[0].hbm_total == 16 << 30
+
+    def test_malformed_native_temperature_falls_through(self, tmp_path,
+                                                        monkeypatch):
+        """Version-skewed output with a non-numeric temperature must be
+        rejected by the guard, not crash the engine later."""
+        from tpu_operator.metrics import libtpu_exporter
+
+        bad = tmp_path / "bad-telemetry"
+        bad.write_text("#!/bin/sh\n"
+                       "echo '[{\"chip_id\": \"accel0\", "
+                       "\"temperature_c\": \"hot\"}]'\n")
+        bad.chmod(0o755)
+        monkeypatch.setenv("TPU_TELEMETRY_BIN", str(bad))
         assert libtpu_exporter.collect_native() == []

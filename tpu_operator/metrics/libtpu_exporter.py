@@ -76,7 +76,8 @@ def collect_native() -> List[ChipSample]:
             hbm_used=int(r.get("hbm_used_bytes") or 0),
             hbm_total=int(r.get("hbm_total_bytes") or 0),
             tensorcore_util_pct=float(r.get("tensorcore_util_pct") or 0),
-            temperature_c=r.get("temperature_c"))
+            temperature_c=(float(r["temperature_c"])
+                           if r.get("temperature_c") is not None else None))
             for i, r in enumerate(rows)]
     except (json.JSONDecodeError, TypeError, ValueError, AttributeError):
         # any unexpected shape (binary version skew, PATH shadowing) must
@@ -86,8 +87,11 @@ def collect_native() -> List[ChipSample]:
 
 
 def collect_sysfs() -> List[ChipSample]:
+    # same root override the native scraper honors, so a native-binary
+    # failure falls through to the SAME tree, not a different chip set
+    root = os.environ.get("TPU_SYSFS_ROOT", "/sys/class/accel")
     out = []
-    for path in sorted(glob.glob("/sys/class/accel/accel*")):
+    for path in sorted(glob.glob(f"{root}/accel*")):
         chip_id = os.path.basename(path)
 
         def read_int(name, default=0):
