@@ -370,3 +370,24 @@ def test_template_selector_labels_survive_common_labels():
         "tpu-device-plugin-daemonset"
     sel = ds["spec"]["template"]["spec"]["nodeSelector"]
     assert sel["tpu.graft.dev/deploy.tpu-device-plugin"] == "true"
+
+
+def test_device_plugin_config_map_changes_render():
+    """devicePlugin.configMap/defaultConfig (the devicePlugin.config
+    ConfigMap slot): setting them must add the mounted-ConfigMap volume +
+    selection env to the plugin DaemonSet; unset renders neither."""
+    baseline = render_state("tpu-device-plugin", BASE_SPEC)
+    assert "plugin-config" not in baseline
+    assert "TPU_PLUGIN_CONFIG_DIR" not in baseline
+    probed = render_state("tpu-device-plugin", merged(
+        BASE_SPEC, "devicePlugin",
+        {"configMap": "probe-plugin-configs", "defaultConfig": "probe-key"}))
+    docs = list(yaml.safe_load_all(probed))
+    ds = next(d for d in docs if d["kind"] == "DaemonSet")
+    pod = ds["spec"]["template"]["spec"]
+    vol = next(v for v in pod["volumes"] if v["name"] == "plugin-config")
+    assert vol["configMap"]["name"] == "probe-plugin-configs"
+    ctr = pod["containers"][0]
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["TPU_PLUGIN_CONFIG_DEFAULT"] == "probe-key"
+    assert any(m["name"] == "plugin-config" for m in ctr["volumeMounts"])

@@ -195,3 +195,147 @@ class TestKubeletRegistration:
             assert reg.endpoint == "tpu-device-plugin.sock"
         finally:
             kubelet.stop()
+
+
+class TestPluginConfig:
+    """Per-node plugin config (devicePlugin.config ConfigMap slot,
+    object_controls.go:2442-2552): the plugin selects a named config by
+    node label and live-reloads — sharing overrides change the
+    advertised inventory without a restart."""
+
+    @pytest.fixture
+    def config_dir(self, tmp_path):
+        d = tmp_path / "configs"
+        d.mkdir()
+        (d / "standard").write_text("sharingPolicy: exclusive\n")
+        (d / "gold").write_text(
+            "sharingPolicy: time-shared\nsharingReplicas: 3\n")
+        return str(d)
+
+    def test_parse_time_shared(self):
+        from tpu_operator.deviceplugin.plugin import parse_plugin_config
+
+        cfg = parse_plugin_config(
+            "g", "sharingPolicy: time-shared\nsharingReplicas: 4\n")
+        assert cfg.effective_replicas == 4
+
+    def test_parse_exclusive_pins_one(self):
+        from tpu_operator.deviceplugin.plugin import parse_plugin_config
+
+        # replicas only take effect under time-shared (same rule the
+        # operator applies to the spec-level knobs)
+        cfg = parse_plugin_config(
+            "s", "sharingPolicy: exclusive\nsharingReplicas: 4\n")
+        assert cfg.effective_replicas == 1
+
+    def test_parse_rejects_unknown_policy(self):
+        from tpu_operator.deviceplugin.plugin import parse_plugin_config
+
+        with pytest.raises(ValueError, match="sharingPolicy"):
+            parse_plugin_config("b", "sharingPolicy: mps\n")
+
+    def test_label_flip_changes_inventory(self, monkeypatch, tmp_path,
+                                          config_dir):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.delenv("SHARING_REPLICAS", raising=False)
+        selected = {"name": None}
+        p = TPUDevicePlugin(socket_dir=str(tmp_path),
+                            health_interval_s=0.1,
+                            config_dir=config_dir,
+                            default_config="standard",
+                            config_selector=lambda: selected["name"])
+        # no label -> default config (exclusive): one device per chip
+        p.refresh_devices()
+        assert len(p._devices) == 2
+        # label the node into the time-shared config: 2 chips x 3 replicas
+        selected["name"] = "gold"
+        p.refresh_devices()
+        ids = [d.ID for d in p._devices]
+        assert len(ids) == 6 and "accel1::r2" in ids
+        # back to unlabeled -> default again
+        selected["name"] = None
+        p.refresh_devices()
+        assert len(p._devices) == 2
+
+    def test_invalid_config_keeps_last_good(self, monkeypatch, tmp_path,
+                                            config_dir):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        selected = {"name": "gold"}
+        p = TPUDevicePlugin(socket_dir=str(tmp_path),
+                            config_dir=config_dir,
+                            default_config="standard",
+                            config_selector=lambda: selected["name"])
+        p.refresh_devices()
+        assert len(p._devices) == 6
+        # selecting a missing config must not brick the running plugin
+        selected["name"] = "no-such-config"
+        p.refresh_devices()
+        assert len(p._devices) == 6
+        assert p.plugin_config.name == "gold"
+
+    def test_selector_failure_keeps_current_config(self, monkeypatch,
+                                                   tmp_path, config_dir):
+        """A transient apiserver read error must not flap the advertised
+        inventory: the active config stays, whatever it is. Guessing the
+        default while the label is unreadable could shrink kubelet
+        capacity and reject pods over a pure read error."""
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.delenv("TPU_PLUGIN_CONFIG_SELECT", raising=False)
+        calls = {"fail": False}
+
+        def flaky():
+            if calls["fail"]:
+                raise RuntimeError("apiserver down")
+            return "gold"
+
+        p = TPUDevicePlugin(socket_dir=str(tmp_path),
+                            config_dir=config_dir,
+                            default_config="standard",
+                            config_selector=flaky)
+        p.refresh_devices()
+        assert p.plugin_config.name == "gold" and len(p._devices) == 6
+        calls["fail"] = True  # apiserver outage mid-run
+        p.refresh_devices()
+        assert p.plugin_config.name == "gold" and len(p._devices) == 6
+        # startup-time failure: no last-good exists, so no config applies
+        # (spec-level sharing settings, exactly as before the feature)
+        p2 = TPUDevicePlugin(socket_dir=str(tmp_path),
+                             config_dir=config_dir,
+                             default_config="gold",
+                             config_selector=flaky)
+        p2.refresh_devices()
+        assert p2.plugin_config is None and len(p2._devices) == 2
+
+    def test_env_select_override(self, monkeypatch, tmp_path, config_dir):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "1")
+        monkeypatch.setenv("TPU_PLUGIN_CONFIG_SELECT", "gold")
+        p = TPUDevicePlugin(socket_dir=str(tmp_path),
+                            config_dir=config_dir,
+                            default_config="standard")
+        p.refresh_devices()
+        assert len(p._devices) == 3
+
+    def test_live_configmap_update_reloads(self, monkeypatch, tmp_path,
+                                           config_dir):
+        """kubelet refreshing the mounted ConfigMap is enough: the next
+        reload sees the new content with no restart or SIGHUP."""
+        import pathlib
+
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        p = TPUDevicePlugin(socket_dir=str(tmp_path),
+                            config_dir=config_dir,
+                            default_config="gold")
+        p.refresh_devices()
+        assert len(p._devices) == 6
+        pathlib.Path(config_dir, "gold").write_text(
+            "sharingPolicy: time-shared\nsharingReplicas: 2\n")
+        p.refresh_devices()
+        assert len(p._devices) == 4
+
+    def test_no_config_dir_is_inert(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.delenv("TPU_PLUGIN_CONFIG_DIR", raising=False)
+        p = TPUDevicePlugin(socket_dir=str(tmp_path))
+        assert p.reload_plugin_config() is False
+        p.refresh_devices()
+        assert len(p._devices) == 2 and p.plugin_config is None

@@ -73,6 +73,11 @@ PERMUTATIONS = {
     "custom-runtimeclass": {
         "operator": {"runtimeClass": "tpu-sandboxed"},
     },
+    "plugin-config": {
+        # per-node plugin config ConfigMap (devicePlugin.config slot)
+        "devicePlugin": {"configMap": "plugin-configs",
+                         "defaultConfig": "standard"},
+    },
     "operands-disabled": {
         "tpuRuntime": {"enabled": False},
         "metricsExporter": {"enabled": False},
@@ -118,6 +123,8 @@ PERMUTATIONS = {
                          "operator": "Exists"}]}]}}},
             "tolerations": [{"key": "dp-only", "operator": "Exists"}],
             "priorityClassName": "dp-priority",
+            "configMap": "ovr-plugin-configs",
+            "defaultConfig": "gold",
         },
         "metricsExporter": {"serviceMonitor": True, "port": 9444,
                             "resources": {"limits": {"memory": "64Mi"}}},

@@ -597,3 +597,58 @@ class TestKubeConfig:
         assert plural_of("TPUClusterPolicy") == "tpuclusterpolicies"
         assert plural_of("Pod") == "pods"
         assert plural_of("DaemonSet") == "daemonsets"
+
+
+class TestTokenRotation:
+    """Bound SA tokens expire (~1h); kubelet refreshes the projected file
+    in place. The client must serve the CURRENT file content on every
+    request, not the token read at startup."""
+
+    def test_file_token_auth_rereads_on_rotation(self, tmp_path):
+        import requests
+
+        from tpu_operator.runtime.kubeclient import _FileTokenAuth
+
+        tok = tmp_path / "token"
+        tok.write_text("token-v1\n")
+        auth = _FileTokenAuth(str(tok))
+        req = requests.Request("GET", "https://example/api").prepare()
+        auth(req)
+        assert req.headers["Authorization"] == "Bearer token-v1"
+        # kubelet rotates the projected file
+        tok.write_text("token-v2\n")
+        os.utime(tok, (1e9, 1e9))  # force a distinct mtime
+        req2 = requests.Request("GET", "https://example/api").prepare()
+        auth(req2)
+        assert req2.headers["Authorization"] == "Bearer token-v2"
+
+    def test_file_token_auth_keeps_last_good_on_read_error(self, tmp_path):
+        import requests
+
+        from tpu_operator.runtime.kubeclient import _FileTokenAuth
+
+        tok = tmp_path / "token"
+        tok.write_text("token-v1")
+        auth = _FileTokenAuth(str(tok))
+        req = requests.Request("GET", "https://example/api").prepare()
+        auth(req)
+        tok.unlink()  # transient projection gap must not strip auth
+        req2 = requests.Request("GET", "https://example/api").prepare()
+        auth(req2)
+        assert req2.headers["Authorization"] == "Bearer token-v1"
+
+    def test_in_cluster_config_carries_token_file(self, tmp_path,
+                                                  monkeypatch):
+        import tpu_operator.runtime.kubeclient as kc
+
+        sa = tmp_path / "sa"
+        sa.mkdir()
+        (sa / "token").write_text("tok")
+        (sa / "namespace").write_text("ns-y")
+        (sa / "ca.crt").write_text("CA")
+        monkeypatch.setattr(kc, "SA_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        cfg = kc.KubeConfig.in_cluster()
+        assert cfg.token_file == str(sa / "token")
+        client = kc.HTTPClient(cfg)
+        assert isinstance(client.session.auth, kc._FileTokenAuth)

@@ -338,3 +338,58 @@ class TestRound2Fixes:
         ns = c.get("v1", "Namespace", "tpu-operator")
         assert ns["metadata"]["labels"][
             L.PSA_LABEL_PREFIX + "enforce"] == "baseline"
+
+
+class TestStaleConditionalObjects:
+    """Flipping a knob off must delete the objects it conditionally
+    rendered — for EVERY kind a template can emit, not just the four the
+    original sweep covered (a stale ClusterRole is a live grant; a stale
+    ServiceMonitor is a live scrape)."""
+
+    def test_plugin_config_rbac_cleaned_on_disable(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={"devicePlugin": {
+            "configMap": "plugin-configs", "defaultConfig": "standard"}}))
+        rec, _ = reconcile_once(c)
+        rbac = "rbac.authorization.k8s.io/v1"
+        assert c.get(rbac, "ClusterRole", "tpu-device-plugin")
+        assert c.get(rbac, "ClusterRoleBinding", "tpu-device-plugin")
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"] = {"devicePlugin": {}}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert c.get_or_none(rbac, "ClusterRole", "tpu-device-plugin") is None
+        assert c.get_or_none(
+            rbac, "ClusterRoleBinding", "tpu-device-plugin") is None
+
+    def test_operator_servicemonitor_cleaned_on_disable(self):
+        c = make_cluster()
+        c.create(new_cluster_policy(spec={"operator": {
+            "serviceMonitor": True}}))
+        rec, _ = reconcile_once(c)
+        mon = "monitoring.coreos.com/v1"
+        monitors = c.list(mon, "ServiceMonitor")
+        assert monitors, "serviceMonitor: true rendered no ServiceMonitor"
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"] = {"operator": {"serviceMonitor": False}}
+        c.update(cr)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert not c.list(mon, "ServiceMonitor"), \
+            "stale ServiceMonitor survived knob flip"
+
+
+def test_template_kinds_scan_includes_conditional_docs():
+    """The stale-sweep bound comes from a textual scan of each state dir,
+    so kinds behind {{- if }} guards (the plugin-config ClusterRole, the
+    serviceMonitor docs) are always in the sweep set even when the
+    current render omits them."""
+    from tpu_operator.state.operands import build_states
+
+    dp = next(s for s in build_states() if s.name == "tpu-device-plugin")
+    kinds = dp.sweep_kinds()
+    assert ("rbac.authorization.k8s.io/v1", "ClusterRole") in kinds
+    assert ("apps/v1", "DaemonSet") in kinds
+    # and it is a bound: the plugin state never emits RuntimeClass
+    assert ("node.k8s.io/v1", "RuntimeClass") not in kinds
+    om = next(s for s in build_states() if s.name == "operator-metrics")
+    assert ("monitoring.coreos.com/v1", "PrometheusRule") in om.sweep_kinds()

@@ -142,6 +142,17 @@ class DevicePluginSpec(ComponentSpec):
     sharing_replicas: Optional[int] = field(
         default=1, description="Advertised replicas per chip when "
         "time-shared (MPS/time-slicing slot)")
+    config_map: Optional[str] = field(
+        default=None,
+        description="ConfigMap of named per-node plugin configs; a node "
+        "picks one via the tpu.graft.dev/device-plugin.config label "
+        "(devicePlugin.config slot, object_controls.go:2442-2552 — the "
+        "config-manager sidecar is folded into the plugin process, which "
+        "watches the label and live-reloads)")
+    default_config: Optional[str] = field(
+        default=None,
+        description="Config key applied to nodes without the selection "
+        "label (DEFAULT_CONFIG env of the reference's config-manager)")
 
 
 @dataclass

@@ -22,6 +22,7 @@ FENCING_CONFIG = f"{DOMAIN}/fencing.config"       # all | none | chip list
 FENCING_STATE = f"{DOMAIN}/fencing.state"         # success|failed
 VTPU_CONFIG = f"{DOMAIN}/vtpu.config"             # nvidia.com/vgpu.config analog
 VTPU_CONFIG_STATE = f"{DOMAIN}/vtpu.config.state"  # pending|success|failed
+DEVICE_PLUGIN_CONFIG = f"{DOMAIN}/device-plugin.config"  # per-node plugin config key
 TPU_GENERATION = f"{DOMAIN}/tpu.generation"       # v4 | v5e | v5p | v6e
 TPU_CHIP_COUNT = f"{DOMAIN}/tpu.chips"
 

@@ -180,12 +180,40 @@ def runtime_setup_main(argv=None) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _node_config_selector():
+    """Selector for the per-node plugin config: read this Node's
+    tpu.graft.dev/device-plugin.config label through the in-cluster
+    client (the config-manager sidecar's node watch, object_controls.go:
+    2442, folded into the plugin's health loop). Best-effort: off-cluster
+    (no token) or label-less nodes fall back to the default config."""
+    node_name = os.environ.get("NODE_NAME")
+    if not node_name:
+        return None
+    from ..api import labels as L
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    try:
+        client = HTTPClient(KubeConfig.load())
+    except Exception as e:
+        log.warning("no cluster client for config selection (%s); "
+                    "per-node label selection disabled", e)
+        return None
+
+    def selector():
+        node = client.get("v1", "Node", node_name)
+        return ((node.get("metadata") or {}).get("labels")
+                or {}).get(L.DEVICE_PLUGIN_CONFIG)
+
+    return selector
+
+
 def device_plugin_main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     from ..deviceplugin.plugin import TPUDevicePlugin
 
     plugin = TPUDevicePlugin(
-        resource_name=os.environ.get("RESOURCE_NAME", "google.com/tpu"))
+        resource_name=os.environ.get("RESOURCE_NAME", "google.com/tpu"),
+        config_selector=_node_config_selector())
     try:
         plugin.serve_forever(register=True)
     except KeyboardInterrupt:

@@ -121,8 +121,12 @@ class TPUDriverReconciler(Reconciler):
                 renderer.render_objects(data), data))
 
         state_label = self._state_label(request.name)
-        applied = apply_objects(self.client, cr, state_label, desired,
-                                self.namespace)
+        from ..state.operands import template_kinds
+
+        applied = apply_objects(
+            self.client, cr, state_label, desired, self.namespace,
+            sweep_kinds=template_kinds(
+                str(self.manifests_root / "state-libtpu-driver")))
         if not pools:
             conditions.set_not_ready(self.client, cr, "NoMatchingNodes",
                                      "nodeSelector matches no TPU nodes")

@@ -64,7 +64,7 @@ def cluster_role() -> dict:
              "resources": ["leases"],
              "verbs": ["get", "list", "watch", "create", "update", "patch"]},
             {"apiGroups": ["monitoring.coreos.com"],
-             "resources": ["servicemonitors"],
+             "resources": ["servicemonitors", "prometheusrules"],
              "verbs": ["get", "list", "watch", "create", "update", "patch",
                        "delete"]},
         ],

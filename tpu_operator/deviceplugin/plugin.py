@@ -70,12 +70,69 @@ def sharing_replicas() -> int:
     return max(1, n)
 
 
-def discover_devices() -> List[pb.Device]:
+# ---------------------------------------------------------------------------
+# per-node plugin config (devicePlugin.config ConfigMap slot)
+# ---------------------------------------------------------------------------
+
+
+class PluginConfig:
+    """One named config from the devicePlugin.config ConfigMap
+    (handleDevicePluginConfig, object_controls.go:2442-2552). The
+    reference ships a config-manager init+sidecar that picks a config by
+    node label and SIGHUPs the plugin through a shared PID namespace;
+    here the plugin process itself selects and live-reloads — one
+    process, no shareProcessNamespace.
+
+    Config keys (YAML): ``sharingPolicy`` (exclusive|time-shared) and
+    ``sharingReplicas`` — per-node overrides of the cluster-wide spec."""
+
+    def __init__(self, name: str, sharing_policy: str = "exclusive",
+                 sharing_replicas: int = 1):
+        self.name = name
+        self.sharing_policy = sharing_policy
+        self.sharing_replicas = max(1, int(sharing_replicas))
+
+    @property
+    def effective_replicas(self) -> int:
+        return self.sharing_replicas \
+            if self.sharing_policy == "time-shared" else 1
+
+    def __eq__(self, other):
+        return isinstance(other, PluginConfig) and vars(self) == vars(other)
+
+    def __repr__(self):
+        return (f"PluginConfig({self.name!r}, {self.sharing_policy!r}, "
+                f"replicas={self.sharing_replicas})")
+
+
+def parse_plugin_config(name: str, text: str) -> PluginConfig:
+    import yaml
+
+    raw = yaml.safe_load(text) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"config {name!r} must be a mapping")
+    policy = raw.get("sharingPolicy", "exclusive")
+    if policy not in ("exclusive", "time-shared"):
+        raise ValueError(f"config {name!r}: unknown sharingPolicy "
+                         f"{policy!r} (exclusive|time-shared)")
+    return PluginConfig(name, sharing_policy=policy,
+                        sharing_replicas=int(raw.get("sharingReplicas", 1)))
+
+
+def read_plugin_config(config_dir: str, name: str) -> PluginConfig:
+    """Load one named config from the mounted ConfigMap dir (kubelet keeps
+    the mount in sync with the ConfigMap, so re-reading sees updates)."""
+    with open(os.path.join(config_dir, name)) as f:
+        return parse_plugin_config(name, f.read())
+
+
+def discover_devices(replicas: Optional[int] = None) -> List[pb.Device]:
     """Advertised allocation units. Without a slice config each chip is one
     device; with one (written by the topology manager,
     topology/manager.py), each sub-slice group is one device — allocating
     a unit grants all its chips, preserving ICI locality. With sharing
-    enabled every unit is advertised ``sharing_replicas()`` times.
+    enabled every unit is advertised ``sharing_replicas()`` times (or
+    ``replicas`` when the caller resolved a per-node plugin config).
 
     Fenced chips (isolation/fencing.py) never appear here: they belong to
     the isolated plugin's pool — the advertisement-level equivalent of a
@@ -89,7 +146,7 @@ def discover_devices() -> List[pb.Device]:
                  if not fenced.intersection(members)]
     else:
         units = [c for c in discover_chips() if c not in fenced]
-    n = sharing_replicas()
+    n = sharing_replicas() if replicas is None else max(1, replicas)
     if n > 1:
         return [pb.Device(ID=f"{u}{REPLICA_SEP}r{j}", health="Healthy")
                 for u in units for j in range(n)]
@@ -191,13 +248,29 @@ class TPUDevicePlugin:
     def __init__(self, resource_name: str = DEFAULT_RESOURCE,
                  socket_dir: str = KUBELET_SOCKET_DIR,
                  plugin_socket: str = PLUGIN_SOCKET,
-                 discover: Callable[[], List[pb.Device]] = discover_devices,
-                 health_interval_s: float = 30.0):
+                 discover: Optional[Callable[[], List[pb.Device]]] = None,
+                 health_interval_s: float = 30.0,
+                 config_dir: Optional[str] = None,
+                 default_config: Optional[str] = None,
+                 config_selector: Optional[
+                     Callable[[], Optional[str]]] = None):
         self.resource_name = resource_name
         self.socket_dir = socket_dir
         self.plugin_socket = plugin_socket
-        self.discover = discover
+        self.discover = discover or self._default_discover
         self.health_interval_s = health_interval_s
+        # per-node config ConfigMap (devicePlugin.config slot): dir where
+        # the ConfigMap is mounted, default key, and the selector that
+        # names this node's config (usually the node-label watcher built
+        # by the CLI entrypoint; None -> env/default fallbacks)
+        self.config_dir = config_dir \
+            if config_dir is not None \
+            else os.environ.get("TPU_PLUGIN_CONFIG_DIR")
+        self.default_config = default_config \
+            if default_config is not None \
+            else os.environ.get("TPU_PLUGIN_CONFIG_DEFAULT")
+        self.config_selector = config_selector
+        self.plugin_config: Optional[PluginConfig] = None
         self._devices: List[pb.Device] = []
         self._cond = threading.Condition()
         self._stopped = threading.Event()
@@ -291,7 +364,52 @@ class TPUDevicePlugin:
     def socket_path(self) -> str:
         return os.path.join(self.socket_dir, self.plugin_socket)
 
+    def _default_discover(self) -> List[pb.Device]:
+        cfg = self.plugin_config
+        return discover_devices(
+            replicas=cfg.effective_replicas if cfg else None)
+
+    def reload_plugin_config(self) -> bool:
+        """Re-resolve this node's named config; True if it changed. Any
+        failure — selector (apiserver read error) or config file
+        (missing/invalid) — keeps the last good config rather than
+        flapping the advertised inventory: shrinking kubelet capacity
+        because of a transient read error would reject pods for nothing
+        (the reference's FALLBACK_STRATEGIES=empty never bricks a
+        running plugin either)."""
+        if not self.config_dir:
+            return False
+        if self.config_selector is not None:
+            try:
+                selected = self.config_selector()
+            except Exception as e:
+                log.warning("config selector failed (%s); keeping %r",
+                            e, self.plugin_config)
+                return False
+        else:
+            selected = None
+        # selector None = genuinely unlabeled -> static env, then default
+        name = selected or os.environ.get("TPU_PLUGIN_CONFIG_SELECT") \
+            or self.default_config or None
+        if not name:
+            changed = self.plugin_config is not None
+            self.plugin_config = None
+            return changed
+        try:
+            cfg = read_plugin_config(self.config_dir, name)
+        except Exception as e:  # OSError, YAMLError, ValueError, TypeError
+            log.warning("plugin config %r unusable (%s); keeping %r",
+                        name, e, self.plugin_config)
+            return False
+        if cfg != self.plugin_config:
+            log.info("plugin config now %r (was %r)", cfg,
+                     self.plugin_config)
+            self.plugin_config = cfg
+            return True
+        return False
+
     def refresh_devices(self) -> None:
+        self.reload_plugin_config()
         devices = self.discover()
         with self._cond:
             if [(d.ID, d.health) for d in devices] != \

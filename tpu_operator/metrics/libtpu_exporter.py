@@ -122,10 +122,20 @@ def collect_jax() -> List[ChipSample]:
             stats = d.memory_stats() or {}
         except Exception:
             pass
+        hbm_total = stats.get("bytes_limit", 0)
+        if not hbm_total:
+            # remote-PJRT backends (the tunneled-chip harness) expose no
+            # memory_stats; the chip's datasheet capacity is still a true
+            # fact about the hardware and beats reporting 0 HBM
+            from ..workloads.hardware import chip_spec_for
+
+            spec = chip_spec_for(getattr(d, "device_kind", ""))
+            if spec is not None:
+                hbm_total = int(spec.hbm_gb * (1 << 30))
         out.append(ChipSample(
             f"chip{d.id}",
             hbm_used=stats.get("bytes_in_use", 0),
-            hbm_total=stats.get("bytes_limit", 0)))
+            hbm_total=hbm_total))
     return out
 
 

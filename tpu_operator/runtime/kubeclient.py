@@ -63,18 +63,24 @@ class KubeConfig:
     def __init__(self, server: str, token: Optional[str] = None,
                  ca_file: Optional[str] = None,
                  client_cert: Optional[tuple] = None,
-                 namespace: str = "default"):
+                 namespace: str = "default",
+                 token_file: Optional[str] = None):
         self.server = server.rstrip("/")
         self.token = token
         self.ca_file = ca_file
         self.client_cert = client_cert
         self.namespace = namespace
+        # projected bound SA tokens expire (~1h) and kubelet refreshes
+        # only the FILE — long-lived clients must re-read it, not pin the
+        # startup value (node agents run for the node's lifetime)
+        self.token_file = token_file
 
     @classmethod
     def in_cluster(cls) -> "KubeConfig":
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        with open(os.path.join(SA_DIR, "token")) as f:
+        token_file = os.path.join(SA_DIR, "token")
+        with open(token_file) as f:
             token = f.read().strip()
         ns_file = os.path.join(SA_DIR, "namespace")
         ns = "default"
@@ -82,7 +88,8 @@ class KubeConfig:
             with open(ns_file) as f:
                 ns = f.read().strip()
         return cls(server=f"https://{host}:{port}", token=token,
-                   ca_file=os.path.join(SA_DIR, "ca.crt"), namespace=ns)
+                   ca_file=os.path.join(SA_DIR, "ca.crt"), namespace=ns,
+                   token_file=token_file)
 
     @classmethod
     def from_kubeconfig(cls, path: Optional[str] = None,
@@ -126,11 +133,40 @@ class KubeConfig:
         return cls.from_kubeconfig()
 
 
+class _FileTokenAuth(requests.auth.AuthBase):
+    """Bearer auth that re-reads the token file when it rotates. Bound
+    service-account tokens expire; kubelet refreshes the projected file
+    in place, so a stat per request (cheap, local) keeps every later
+    call authenticated where a pinned startup token would 401 after the
+    TTL and silently break long-running node agents."""
+
+    def __init__(self, token_file: str, fallback_token: Optional[str] = None):
+        self.token_file = token_file
+        self.token = fallback_token
+        self._mtime: Optional[float] = None
+
+    def __call__(self, request):
+        try:
+            mtime = os.stat(self.token_file).st_mtime
+            if mtime != self._mtime:
+                with open(self.token_file) as f:
+                    self.token = f.read().strip()
+                self._mtime = mtime
+        except OSError:
+            pass  # keep the last good token
+        if self.token:
+            request.headers["Authorization"] = f"Bearer {self.token}"
+        return request
+
+
 class HTTPClient(Client):
     def __init__(self, config: Optional[KubeConfig] = None):
         self.config = config or KubeConfig.load()
         self.session = requests.Session()
-        if self.config.token:
+        if self.config.token_file:
+            self.session.auth = _FileTokenAuth(self.config.token_file,
+                                               self.config.token)
+        elif self.config.token:
             self.session.headers["Authorization"] = f"Bearer {self.config.token}"
         if self.config.ca_file:
             self.session.verify = self.config.ca_file

@@ -45,8 +45,10 @@ node-labelling side lives in controllers/state_manager.py.
 
 from __future__ import annotations
 
+import functools
 import os
 import pathlib
+import re
 from typing import Callable, List, Optional
 
 from .. import __version__
@@ -278,6 +280,22 @@ def _set_container_env(ctr: dict, var: dict) -> None:
     env.append(var)
 
 
+@functools.lru_cache(maxsize=None)
+def template_kinds(state_dir: str) -> frozenset:
+    """(apiVersion, kind) pairs a state dir's templates can emit —
+    including conditionally-rendered docs, since the scan is textual
+    (the resource_manager.go:89 regex-the-kind-out-of-assets move).
+    Bounds the stale sweep to kinds this state could ever have created."""
+    kinds = set()
+    for path in sorted(pathlib.Path(state_dir).glob("*.yaml")):
+        for doc in re.split(r"(?m)^---\s*$", path.read_text()):
+            av = re.search(r"(?m)^apiVersion:\s*([^\s{]+)", doc)
+            kd = re.search(r"(?m)^kind:\s*([^\s{]+)", doc)
+            if av and kd:
+                kinds.add((av.group(1), kd.group(1)))
+    return frozenset(kinds)
+
+
 class OperandState(State):
     """A state fully described by (manifest dir, data builder, enable flag)."""
 
@@ -305,13 +323,17 @@ class OperandState(State):
         return apply_common_config(
             self.renderer().render_objects(data), data)
 
+    def sweep_kinds(self) -> frozenset:
+        return template_kinds(str(self._root / f"state-{self.name}"))
+
     def sync(self, ctx: SyncContext) -> SyncResult:
         if not self.enabled(ctx):
             delete_state_objects(ctx.client, self.name)
             return SyncResult(SyncStatus.DISABLED, "disabled by spec")
         objects = self.render(ctx)
         applied = apply_objects(ctx.client, ctx.policy, self.name, objects,
-                                ctx.namespace)
+                                ctx.namespace,
+                                sweep_kinds=self.sweep_kinds())
         ok, msg = objects_ready(ctx.client, applied)
         return SyncResult(SyncStatus.READY if ok else SyncStatus.NOT_READY, msg)
 
@@ -407,6 +429,11 @@ def _device_plugin_data(ctx: SyncContext) -> dict:
     # replication only takes effect under time-shared; exclusive pins 1
     data["SharingReplicas"] = (spec.sharing_replicas or 1) \
         if data["SharingPolicy"] == "time-shared" else 1
+    # per-node config ConfigMap (handleDevicePluginConfig slot,
+    # object_controls.go:2442): mounted read-only; the plugin process
+    # itself selects + live-reloads, so no config-manager sidecar exists
+    data["PluginConfigMap"] = spec.config_map or ""
+    data["PluginConfigDefault"] = spec.default_config or ""
     return data
 
 

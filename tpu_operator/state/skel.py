@@ -38,10 +38,13 @@ log = logging.getLogger("tpu_operator.state")
 
 
 def apply_objects(client: Client, owner: Optional[dict], state_name: str,
-                  objects: Iterable[dict], namespace: str) -> List[dict]:
+                  objects: Iterable[dict], namespace: str,
+                  sweep_kinds: Optional[set] = None) -> List[dict]:
     """Create-or-update the desired objects for a state; returns the live
     objects. Also deletes stale objects still labeled for this state but no
-    longer desired (cleanupStale analog)."""
+    longer desired (cleanupStale analog). ``sweep_kinds`` — the
+    (apiVersion, kind) set this state's templates can possibly emit —
+    bounds the stale sweep; None sweeps every known kind."""
     applied: List[dict] = []
     desired_keys = set()
     for obj in objects:
@@ -74,18 +77,38 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
             merged["status"] = existing["status"]
         applied.append(client.update(merged))
         log.info("[%s] updated %s/%s", state_name, obj["kind"], name_of(obj))
-    _delete_stale(client, state_name, desired_keys, namespace)
+    _delete_stale(client, state_name, desired_keys, namespace, sweep_kinds)
     return applied
 
 
+# every kind any state template can emit — especially the conditionally-
+# rendered ones (ServiceMonitor/PrometheusRule behind serviceMonitor
+# knobs, the plugin-config ClusterRole behind devicePlugin.configMap):
+# those go stale by flipping a knob off, and a kind missing here survives
+# as a live grant/scrape forever
+SWEEPABLE_KINDS = (("apps/v1", "DaemonSet"),
+                   ("v1", "Service"),
+                   ("v1", "ConfigMap"),
+                   ("v1", "ServiceAccount"),
+                   ("node.k8s.io/v1", "RuntimeClass"),
+                   ("rbac.authorization.k8s.io/v1", "Role"),
+                   ("rbac.authorization.k8s.io/v1", "RoleBinding"),
+                   ("rbac.authorization.k8s.io/v1", "ClusterRole"),
+                   ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding"),
+                   ("monitoring.coreos.com/v1", "ServiceMonitor"),
+                   ("monitoring.coreos.com/v1", "PrometheusRule"))
+
+
 def _delete_stale(client: Client, state_name: str, desired_keys: set,
-                  namespace: str) -> None:
+                  namespace: str, sweep_kinds: Optional[set] = None) -> None:
     """Delete objects labeled for this state that are no longer rendered
-    (state_skel.go:313-342 handleStateObjectsDeletion analog)."""
-    for api_version, kind in (("apps/v1", "DaemonSet"),
-                              ("v1", "Service"),
-                              ("v1", "ConfigMap"),
-                              ("node.k8s.io/v1", "RuntimeClass")):
+    (state_skel.go:313-342 handleStateObjectsDeletion analog). The sweep
+    is bounded to ``sweep_kinds`` when the caller knows which kinds its
+    templates can emit — listing all nine known kinds for every state on
+    every reconcile would be steady wasted apiserver load."""
+    for api_version, kind in SWEEPABLE_KINDS:
+        if sweep_kinds is not None and (api_version, kind) not in sweep_kinds:
+            continue
         try:
             stale = client.list(api_version, kind, ListOptions(
                 label_selector={STATE_LABEL: state_name}))
