@@ -1,0 +1,171 @@
+"""Lifecycle hook commands (tpu-operator-maintenance) — the chart's
+upgrade_crd.yaml / cleanup_crd.yaml hook Jobs re-done as first-class
+API-server operations (the image ships no kubectl)."""
+
+import pytest
+
+from tpu_operator.api import (
+    KIND_CLUSTER_POLICY,
+    KIND_TPU_DRIVER,
+    V1,
+    new_cluster_policy,
+)
+from tpu_operator.api.tpudriver import V1ALPHA1
+from tpu_operator.cli.maintenance import CRD_API, apply_crds, cleanup
+from tpu_operator.runtime import FakeClient
+
+
+class TestApplyCRDs:
+    def test_creates_both_crds_fresh(self):
+        c = FakeClient()
+        assert apply_crds(c) == 2
+        names = {o["metadata"]["name"]
+                 for o in c.list(CRD_API, "CustomResourceDefinition")}
+        assert names == {"tpuclusterpolicies.tpu.graft.dev",
+                         "tpudrivers.tpu.graft.dev"}
+
+    def test_updates_existing_schema_in_place(self):
+        """The pre-upgrade scenario: an older CRD revision is live; the
+        hook must replace its schema, not fail on AlreadyExists."""
+        c = FakeClient()
+        apply_crds(c)
+        crd = c.get(CRD_API, "CustomResourceDefinition",
+                    "tpuclusterpolicies.tpu.graft.dev")
+        # simulate an old revision: strip the schema down
+        crd["spec"]["versions"][0]["schema"] = {
+            "openAPIV3Schema": {"type": "object"}}
+        c.update(crd)
+        assert apply_crds(c) == 2
+        crd = c.get(CRD_API, "CustomResourceDefinition",
+                    "tpuclusterpolicies.tpu.graft.dev")
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        assert "spec" in schema.get("properties", {}), \
+            "pre-upgrade hook did not restore the full schema"
+
+    def test_idempotent(self):
+        c = FakeClient()
+        apply_crds(c)
+        assert apply_crds(c) == 2  # re-run on hook retry: no error
+
+
+class TestCleanup:
+    def _cluster_with_crs(self):
+        c = FakeClient()
+        apply_crds(c)
+        c.create(new_cluster_policy())
+        from tpu_operator.api.tpudriver import new_tpu_driver
+
+        c.create(new_tpu_driver("pool-a"))
+        return c
+
+    def test_deletes_crs_then_crds(self):
+        c = self._cluster_with_crs()
+        assert cleanup(c, timeout_s=5.0, poll_s=0.01) is True
+        assert c.list(V1, KIND_CLUSTER_POLICY) == []
+        assert c.list(V1ALPHA1, KIND_TPU_DRIVER) == []
+        assert c.list(CRD_API, "CustomResourceDefinition") == []
+
+    def test_stuck_cr_leaves_crds_in_place(self):
+        """A CR that won't go (finalizer still tearing operands down)
+        must NOT take the CRDs with it — dropping a CRD with live CRs
+        orphans the teardown."""
+
+        class StickyClient(FakeClient):
+            def delete(self, api_version, kind, name, namespace=None):
+                if kind == KIND_TPU_DRIVER:
+                    return None  # deletion blocked by a finalizer
+                return super().delete(api_version, kind, name, namespace)
+
+        c = StickyClient()
+        apply_crds(c)
+        c.create(new_cluster_policy())
+        from tpu_operator.api.tpudriver import new_tpu_driver
+
+        c.create(new_tpu_driver("pool-a"))
+        assert cleanup(c, timeout_s=0.1, poll_s=0.02) is False
+        assert len(c.list(CRD_API, "CustomResourceDefinition")) == 2
+        assert len(c.list(V1ALPHA1, KIND_TPU_DRIVER)) == 1
+
+    def test_cleanup_idempotent_on_empty_cluster(self):
+        c = FakeClient()
+        assert cleanup(c, timeout_s=1.0, poll_s=0.01) is True
+
+
+class TestHookRendering:
+    """The values knobs render the hook Jobs + scoped RBAC
+    (operator.upgradeCRD / operator.cleanupCRD slots)."""
+
+    @staticmethod
+    def _bundle(overrides):
+        from tpu_operator.deploy.values import default_values, deep_merge, render_bundle
+
+        return render_bundle(deep_merge(default_values(), overrides),
+                             include_crds=False)
+
+    def test_defaults_render_no_hooks(self):
+        docs = self._bundle({})
+        assert not any(d["kind"] == "Job" for d in docs)
+
+    def test_upgrade_knob_renders_hook_job_with_rbac(self):
+        docs = self._bundle({"operator": {"upgradeCRD": True,
+                                          "imagePullSecrets": ["regcred"]}})
+        j = next(d for d in docs if d["kind"] == "Job")
+        assert j["metadata"]["name"] == "tpu-operator-upgrade-crd"
+        pod = j["spec"]["template"]["spec"]
+        assert pod["containers"][0]["command"] == [
+            "tpu-operator-maintenance", "apply-crds"]
+        assert pod["serviceAccountName"] == "tpu-operator-upgrade-crd"
+        assert pod["imagePullSecrets"] == [{"name": "regcred"}]
+        assert j["metadata"]["annotations"]["helm.sh/hook"] == "pre-upgrade"
+        role = next(d for d in docs if d["kind"] == "ClusterRole"
+                    and d["metadata"]["name"] == "tpu-operator-upgrade-crd")
+        groups = {g for r in role["rules"] for g in r["apiGroups"]}
+        assert "apiextensions.k8s.io" in groups
+
+    def test_cleanup_never_in_install_bundle(self):
+        """Plain `kubectl apply` of the install stream ignores the
+        helm.sh/hook annotations — a cleanup Job in it would delete the
+        freshly installed CRs/CRDs. The knob must NOT pull it in."""
+        docs = self._bundle({"operator": {"cleanupCRD": True}})
+        assert not any(d["kind"] == "Job" for d in docs)
+
+    def test_cleanup_stream_is_standalone(self):
+        from tpu_operator.deploy.values import (
+            deep_merge,
+            default_values,
+            render_cleanup,
+        )
+
+        docs = render_cleanup(deep_merge(default_values(), {}))
+        j = next(d for d in docs if d["kind"] == "Job")
+        assert j["metadata"]["name"] == "tpu-operator-cleanup-crd"
+        assert j["metadata"]["annotations"]["helm.sh/hook"] == "pre-delete"
+        pod = j["spec"]["template"]["spec"]
+        assert pod["containers"][0]["command"] == [
+            "tpu-operator-maintenance", "cleanup"]
+        role = next(d for d in docs if d["kind"] == "ClusterRole")
+        groups = {g for r in role["rules"] for g in r["apiGroups"]}
+        assert {"apiextensions.k8s.io", "tpu.graft.dev"} <= groups
+
+    def test_hook_jobs_inherit_operator_scheduling(self):
+        """On clusters where every schedulable node is tainted, a hook
+        Job without the operator's tolerations/nodeSelector would pend
+        forever and hang the release operation."""
+        sched = {"nodeSelector": {"pool": "infra"},
+                 "tolerations": [{"key": "infra", "operator": "Exists"}],
+                 "priorityClassName": "hooks-high"}
+        docs = self._bundle({"operator": {"upgradeCRD": True, **sched}})
+        pod = next(d for d in docs if d["kind"] == "Job"
+                   )["spec"]["template"]["spec"]
+        assert pod["nodeSelector"] == {"pool": "infra"}
+        assert pod["tolerations"] == sched["tolerations"]
+        assert pod["priorityClassName"] == "hooks-high"
+
+    def test_generate_cleanup_cli_target(self, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+        import yaml as _yaml
+
+        assert main(["generate", "cleanup"]) == 0
+        docs = list(_yaml.safe_load_all(capsys.readouterr().out))
+        kinds = [d["kind"] for d in docs if d]
+        assert "Job" in kinds and "ClusterRole" in kinds

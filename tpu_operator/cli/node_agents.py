@@ -200,7 +200,10 @@ def _node_config_selector():
         return None
 
     def selector():
-        node = client.get("v1", "Node", node_name)
+        # metadata-only GET: polling one label per health tick must not
+        # pull the full Node object (status.images alone can be tens of
+        # KB) from every node in the fleet
+        node = client.get("v1", "Node", node_name, metadata_only=True)
         return ((node.get("metadata") or {}).get("labels")
                 or {}).get(L.DEVICE_PLUGIN_CONFIG)
 

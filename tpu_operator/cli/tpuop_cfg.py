@@ -30,7 +30,8 @@ def _generate_docs(args):
 
     namespace = args.namespace or "tpu-operator"
     # CRD output is values-independent: never gate it on a values file
-    if (args.values or args.what == "bundle") and args.what != "crds":
+    if (args.values or args.what in ("bundle", "cleanup")) \
+            and args.what != "crds":
         try:
             vals = values_mod.load_values(args.values or None)
             if args.namespace is not None:
@@ -43,6 +44,14 @@ def _generate_docs(args):
                 from ..deploy.csv import render_bundle_stream
 
                 return render_bundle_stream(vals)
+            if args.what == "cleanup":
+                return values_mod.render_cleanup(vals)
+            if (vals.get("operator") or {}).get("cleanupCRD"):
+                print("note: cleanupCRD is set — the pre-delete cleanup "
+                      "Job is not part of the install stream (plain apply "
+                      "would run it at install time); emit it at "
+                      "uninstall with `tpuop-cfg generate cleanup`",
+                      file=sys.stderr)
             return values_mod.render_bundle(
                 vals, include_crds=(args.what == "all"))
         except (OSError, ValueError, yaml.YAMLError) as e:
@@ -68,7 +77,8 @@ def main(argv=None) -> int:
     v.add_argument("--registry-timeout", type=float, default=10.0)
 
     g = sub.add_parser("generate", help="emit deployment manifests")
-    g.add_argument("what", choices=["crds", "operator", "all", "bundle"])
+    g.add_argument("what",
+                   choices=["crds", "operator", "all", "bundle", "cleanup"])
     g.add_argument("-n", "--namespace", default=None,
                    help="default tpu-operator; with --values, an explicit "
                         "flag overrides the values file")

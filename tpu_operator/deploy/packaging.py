@@ -151,6 +151,106 @@ def operator_deployment(namespace: str, image: str,
     }
 
 
+def _hook_annotations(hook: str, weight: str) -> dict:
+    """Helm hook metadata (upgrade_crd.yaml/cleanup_crd.yaml carry the
+    same): meaningful when the stream is wrapped in a chart, inert when
+    applied plainly — the Jobs then just run once."""
+    return {"helm.sh/hook": hook,
+            "helm.sh/hook-weight": weight,
+            "helm.sh/hook-delete-policy":
+                "hook-succeeded,before-hook-creation"}
+
+
+def _hook_rbac(name: str, namespace: str, hook: str, rules: list) -> list:
+    meta = lambda: {"name": name,  # noqa: E731
+                    "annotations": _hook_annotations(hook, "0")}
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {**meta(), "namespace": namespace}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": meta(), "rules": rules},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": meta(),
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": name},
+         "subjects": [{"kind": "ServiceAccount", "name": name,
+                       "namespace": namespace}]},
+    ]
+
+
+def _hook_job(name: str, namespace: str, hook: str, image: str,
+              command: list, op: dict) -> dict:
+    pod_spec = {
+        "serviceAccountName": name,
+        "restartPolicy": "OnFailure",
+        "containers": [{
+            "name": name,
+            "image": image,
+            "imagePullPolicy": op.get("imagePullPolicy") or "IfNotPresent",
+            "command": command,
+        }],
+    }
+    if op.get("imagePullSecrets"):
+        pod_spec["imagePullSecrets"] = [
+            {"name": s} if isinstance(s, str) else s
+            for s in op["imagePullSecrets"]]
+    # hook pods must be schedulable wherever the operator is: on clusters
+    # where every schedulable node is tainted (dedicated TPU pools), a
+    # hook Job without the operator's tolerations would pend forever and
+    # hang the release operation
+    for key in ("nodeSelector", "affinity", "tolerations",
+                "priorityClassName"):
+        if op.get(key):
+            pod_spec[key] = op[key]
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": namespace,
+                     "annotations": _hook_annotations(hook, "1")},
+        "spec": {"backoffLimit": 6,
+                 "template": {"metadata": {"labels": {"app": name}},
+                              "spec": pod_spec}},
+    }
+
+
+def upgrade_crd_hook(namespace: str, image: str,
+                     op: Optional[dict] = None) -> List[dict]:
+    """Pre-upgrade CRD-apply Job (upgrade_crd.yaml slot): package
+    managers don't upgrade CRDs, so schema changes in a new version must
+    be applied by an explicit hook before the operator rolls."""
+    op = op or {}
+    name = "tpu-operator-upgrade-crd"
+    docs = _hook_rbac(name, namespace, "pre-upgrade", [
+        {"apiGroups": ["apiextensions.k8s.io"],
+         "resources": ["customresourcedefinitions"],
+         "verbs": ["create", "get", "list", "watch", "patch", "update"]},
+    ])
+    docs.append(_hook_job(name, namespace, "pre-upgrade", image,
+                          ["tpu-operator-maintenance", "apply-crds"], op))
+    return docs
+
+
+def cleanup_crd_hook(namespace: str, image: str,
+                     op: Optional[dict] = None) -> List[dict]:
+    """Pre-delete cleanup Job (cleanup_crd.yaml slot): delete the CRs
+    while the operator still runs (operands tear down via owner GC),
+    wait, then drop the CRDs."""
+    op = op or {}
+    name = "tpu-operator-cleanup-crd"
+    docs = _hook_rbac(name, namespace, "pre-delete", [
+        {"apiGroups": ["tpu.graft.dev"],
+         "resources": ["tpuclusterpolicies", "tpudrivers"],
+         "verbs": ["get", "list", "delete"]},
+        {"apiGroups": ["apiextensions.k8s.io"],
+         "resources": ["customresourcedefinitions"],
+         "verbs": ["get", "list", "delete"]},
+    ])
+    docs.append(_hook_job(name, namespace, "pre-delete", image,
+                          ["tpu-operator-maintenance", "cleanup"], op))
+    return docs
+
+
 def sample_cluster_policy() -> dict:
     from ..api import new_cluster_policy
 

@@ -27,11 +27,13 @@ import yaml
 from .. import __version__
 from ..api import new_cluster_policy
 from .packaging import (
+    cleanup_crd_hook,
     cluster_role,
     cluster_role_binding,
     namespace_manifest,
     operator_deployment,
     service_account,
+    upgrade_crd_hook,
 )
 
 # shipped as package data so pip installs carry it (see pyproject
@@ -126,10 +128,37 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
         operator_deployment(ns, operator_image(values),
                             values.get("operator") or {}),
     ])
+    # lifecycle hooks: only the idempotent pre-upgrade CRD-apply rides in
+    # the install stream (operator.upgradeCRD slot). The pre-delete
+    # cleanup Job is NEVER part of the install bundle — plain `kubectl
+    # apply` ignores helm.sh/hook annotations and would run it at install
+    # time, deleting the freshly created CRs and CRDs. It is emitted only
+    # by the explicit `tpuop-cfg generate cleanup` target (see
+    # render_cleanup).
+    op = values.get("operator") or {}
+    if op.get("upgradeCRD"):
+        docs.extend(upgrade_crd_hook(ns, operator_image(values), op))
     cr = render_cluster_policy(values)
     if cr is not None:
         docs.append(cr)
     return docs
+
+
+def render_cleanup(values: Dict[str, Any]) -> List[dict]:
+    """The pre-delete cleanup hook (cleanup_crd.yaml slot), emitted as a
+    standalone stream for the explicit uninstall step:
+
+        tpuop-cfg generate cleanup | kubectl apply -f -
+        kubectl wait --for=condition=complete job/tpu-operator-cleanup-crd
+        tpuop-cfg generate all | kubectl delete -f -
+
+    Deliberately excluded from render_bundle: applied plainly at install
+    time it would delete the CRs/CRDs it finds (helm.sh/hook annotations
+    are inert outside Helm). A Helm-wrapped chart can include this stream
+    and get true pre-delete sequencing from the annotations."""
+    ns = values.get("namespace", "tpu-operator")
+    return cleanup_crd_hook(ns, operator_image(values),
+                            values.get("operator") or {})
 
 
 # the former render_bundle_metadata (a custom BundleMetadata blob) is

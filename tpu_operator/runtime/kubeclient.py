@@ -215,8 +215,17 @@ class HTTPClient(Client):
 
     # -- CRUD --------------------------------------------------------------
 
-    def get(self, api_version, kind, name, namespace=None):
-        resp = self.session.get(self._url(api_version, kind, name, namespace))
+    # PartialObjectMetadata negotiation: the apiserver serializes only
+    # metadata (labels/annotations/ownerRefs), sparing the full object —
+    # matters for pollers reading one label off fat objects like Nodes
+    METADATA_ACCEPT = ("application/json;as=PartialObjectMetadata;"
+                       "g=meta.k8s.io;v=v1,application/json")
+
+    def get(self, api_version, kind, name, namespace=None,
+            metadata_only=False):
+        headers = {"Accept": self.METADATA_ACCEPT} if metadata_only else None
+        resp = self.session.get(
+            self._url(api_version, kind, name, namespace), headers=headers)
         self._raise_for(resp, f"get {kind}/{name}")
         return resp.json()
 
