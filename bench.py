@@ -160,17 +160,19 @@ def child_main() -> int:
             platform, res.correct)
 
     # single chip: MXU utilization headline. Bigger squares sit closer to
-    # peak (measured on v5e: 8192→0.84, 16384→0.90, 28672→0.95), so pick
-    # the largest MXU-aligned size whose working set (~4 NxN bf16 buffers)
-    # comfortably fits HBM.
+    # peak (measured on v5e: 8192→0.84, 16384→0.90, 28672→0.95; larger
+    # sizes plateau), and longer scan chains amortize the per-call
+    # dispatch bubble (v5e sweep: iters=6/calls=4→0.942,
+    # iters=20/calls=3→0.950). Pick the largest MXU-aligned size whose
+    # working set (~4 NxN bf16 buffers) comfortably fits HBM.
     if platform != "tpu":
         size, iters, calls = 1024, 2, 2  # harness proof only, not a number
     elif spec is None:
-        size, iters, calls = 8192, 6, 4
+        size, iters, calls = 8192, 20, 3
     elif spec.hbm_gb >= 16:  # every known chip today (v2..v6e)
-        size, iters, calls = 28672, 6, 4
+        size, iters, calls = 28672, 20, 3
     else:
-        size, iters, calls = 16384, 6, 4
+        size, iters, calls = 16384, 20, 3
     res = matmul.run(size=size, iters=iters, calls=calls, repeats=3)
     print(f"# matmul: {res}", file=sys.stderr)
     if res.utilization is not None:

@@ -110,7 +110,10 @@ class TestHookRendering:
         docs = self._bundle({"operator": {"upgradeCRD": True,
                                           "imagePullSecrets": ["regcred"]}})
         j = next(d for d in docs if d["kind"] == "Job")
-        assert j["metadata"]["name"] == "tpu-operator-upgrade-crd"
+        # name is image-versioned: a plain re-apply after a version bump
+        # must create a FRESH Job (Jobs are immutable + run-once)
+        assert j["metadata"]["name"].startswith("tpu-operator-upgrade-crd-")
+        assert j["spec"]["ttlSecondsAfterFinished"] == 3600
         pod = j["spec"]["template"]["spec"]
         assert pod["containers"][0]["command"] == [
             "tpu-operator-maintenance", "apply-crds"]
@@ -169,3 +172,103 @@ class TestHookRendering:
         docs = list(_yaml.safe_load_all(capsys.readouterr().out))
         kinds = [d["kind"] for d in docs if d]
         assert "Job" in kinds and "ClusterRole" in kinds
+
+
+class TestPluginConfigMapRendering:
+    """values pluginConfig.create/data ships the named-configs ConfigMap
+    (templates/plugin_config.yaml slot) with render-time validation."""
+
+    @staticmethod
+    def _values(overrides):
+        from tpu_operator.deploy.values import default_values, deep_merge
+
+        return deep_merge(default_values(), overrides)
+
+    def test_renders_configmap_with_validated_entries(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        docs = render_bundle(self._values({
+            "clusterPolicy": {"spec": {"devicePlugin": {
+                "configMap": "plugin-configs",
+                "defaultConfig": "standard"}}},
+            "pluginConfig": {"create": True, "data": {
+                "standard": "sharingPolicy: exclusive\n",
+                "shared-4x": ("sharingPolicy: time-shared\n"
+                              "sharingReplicas: 4\n")}},
+        }), include_crds=False)
+        cm = next(d for d in docs if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "plugin-configs")
+        assert set(cm["data"]) == {"standard", "shared-4x"}
+
+    def test_invalid_entry_fails_render(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        with pytest.raises(ValueError, match="sharingPolicy"):
+            render_bundle(self._values({
+                "clusterPolicy": {"spec": {"devicePlugin": {
+                    "configMap": "plugin-configs"}}},
+                "pluginConfig": {"create": True, "data": {
+                    "bad": "sharingPolicy: mps\n"}},
+            }), include_crds=False)
+
+    def test_create_without_name_fails_render(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        with pytest.raises(ValueError, match="configMap"):
+            render_bundle(self._values({
+                "pluginConfig": {"create": True,
+                                 "data": {"a": "sharingPolicy: exclusive"}},
+            }), include_crds=False)
+
+    def test_create_false_ships_nothing(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        docs = render_bundle(self._values({}), include_crds=False)
+        assert not any(d["kind"] == "ConfigMap" for d in docs)
+
+
+    def test_upgrade_job_name_changes_with_image_version(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        def job_name(version):
+            docs = render_bundle(self._values(
+                {"operator": {"upgradeCRD": True, "version": version}}),
+                include_crds=False)
+            return next(d for d in docs
+                        if d["kind"] == "Job")["metadata"]["name"]
+
+        assert job_name("v1.0.0") != job_name("v1.1.0")
+
+    def test_replicas_null_is_treated_as_unset(self):
+        """YAML `sharingReplicas: null` means unset, not a crash — the
+        TypeError int(None) used to raise escaped both the render-time
+        catch and the CLI's error handler as a raw traceback."""
+        from tpu_operator.deviceplugin.plugin import parse_plugin_config
+
+        cfg = parse_plugin_config(
+            "x", "sharingPolicy: time-shared\nsharingReplicas: null\n")
+        assert cfg.sharing_replicas == 1
+
+    def test_bad_replicas_fails_render_with_key_context(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        with pytest.raises(ValueError, match="pluginConfig.data.x"):
+            render_bundle(self._values({
+                "clusterPolicy": {"spec": {"devicePlugin": {
+                    "configMap": "c"}}},
+                "pluginConfig": {"create": True, "data": {
+                    "x": "sharingPolicy: time-shared\n"
+                         "sharingReplicas: four\n"
+                }},
+            }), include_crds=False)
+
+    def test_default_config_must_name_shipped_entry(self):
+        from tpu_operator.deploy.values import render_bundle
+
+        with pytest.raises(ValueError, match="standrd"):
+            render_bundle(self._values({
+                "clusterPolicy": {"spec": {"devicePlugin": {
+                    "configMap": "c", "defaultConfig": "standrd"}}},
+                "pluginConfig": {"create": True, "data": {
+                    "standard": "sharingPolicy: exclusive\n"}},
+            }), include_crds=False)

@@ -114,21 +114,12 @@ def operator_deployment(namespace: str, image: str,
     }
     if op.get("resources"):
         container["resources"] = op["resources"]
-    pod_spec = {
+    pod_spec = _pod_spec_passthrough(op, {
         "serviceAccountName": "tpu-operator",
         "priorityClassName": op.get("priorityClassName")
         or "system-cluster-critical",
         "containers": [container],
-    }
-    for values_key, pod_key in (("imagePullSecrets", "imagePullSecrets"),
-                                ("nodeSelector", "nodeSelector"),
-                                ("affinity", "affinity"),
-                                ("tolerations", "tolerations")):
-        if op.get(values_key):
-            val = op[values_key]
-            if values_key == "imagePullSecrets":
-                val = [{"name": s} if isinstance(s, str) else s for s in val]
-            pod_spec[pod_key] = val
+    })
     # "app" is the selector identity — user labels must not break
     # spec.selector/template agreement (same protection operand renders
     # give their selector labels)
@@ -153,12 +144,31 @@ def operator_deployment(namespace: str, image: str,
 
 def _hook_annotations(hook: str, weight: str) -> dict:
     """Helm hook metadata (upgrade_crd.yaml/cleanup_crd.yaml carry the
-    same): meaningful when the stream is wrapped in a chart, inert when
-    applied plainly — the Jobs then just run once."""
+    same): meaningful when the stream is wrapped in a chart. Applied
+    plainly, Jobs are immutable run-once objects — which is why the
+    upgrade hook Job's NAME is versioned by image (a re-apply with a new
+    version creates a fresh Job instead of failing on spec immutability)
+    and finished Jobs self-clean via ttlSecondsAfterFinished."""
     return {"helm.sh/hook": hook,
             "helm.sh/hook-weight": weight,
             "helm.sh/hook-delete-policy":
                 "hook-succeeded,before-hook-creation"}
+
+
+def _pod_spec_passthrough(op: dict, pod_spec: dict) -> dict:
+    """Shared operator-values -> pod-spec plumbing for the manager
+    Deployment and the hook Jobs: one copy, so a new knob cannot reach
+    operator pods but miss hook pods (whose unschedulability would hang
+    a release operation)."""
+    if op.get("imagePullSecrets"):
+        pod_spec["imagePullSecrets"] = [
+            {"name": s} if isinstance(s, str) else s
+            for s in op["imagePullSecrets"]]
+    for key in ("nodeSelector", "affinity", "tolerations",
+                "priorityClassName"):
+        if op.get(key):
+            pod_spec[key] = op[key]
+    return pod_spec
 
 
 def _hook_rbac(name: str, namespace: str, hook: str, rules: list) -> list:
@@ -180,8 +190,9 @@ def _hook_rbac(name: str, namespace: str, hook: str, rules: list) -> list:
 
 
 def _hook_job(name: str, namespace: str, hook: str, image: str,
-              command: list, op: dict) -> dict:
-    pod_spec = {
+              command: list, op: dict,
+              job_name: Optional[str] = None) -> dict:
+    pod_spec = _pod_spec_passthrough(op, {
         "serviceAccountName": name,
         "restartPolicy": "OnFailure",
         "containers": [{
@@ -190,25 +201,16 @@ def _hook_job(name: str, namespace: str, hook: str, image: str,
             "imagePullPolicy": op.get("imagePullPolicy") or "IfNotPresent",
             "command": command,
         }],
-    }
-    if op.get("imagePullSecrets"):
-        pod_spec["imagePullSecrets"] = [
-            {"name": s} if isinstance(s, str) else s
-            for s in op["imagePullSecrets"]]
-    # hook pods must be schedulable wherever the operator is: on clusters
-    # where every schedulable node is tainted (dedicated TPU pools), a
-    # hook Job without the operator's tolerations would pend forever and
-    # hang the release operation
-    for key in ("nodeSelector", "affinity", "tolerations",
-                "priorityClassName"):
-        if op.get(key):
-            pod_spec[key] = op[key]
+    })
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
-        "metadata": {"name": name, "namespace": namespace,
+        "metadata": {"name": job_name or name, "namespace": namespace,
                      "annotations": _hook_annotations(hook, "1")},
         "spec": {"backoffLimit": 6,
+                 # plain-apply installs have no Helm hook-delete; finished
+                 # hook Jobs clean themselves up
+                 "ttlSecondsAfterFinished": 3600,
                  "template": {"metadata": {"labels": {"app": name}},
                               "spec": pod_spec}},
     }
@@ -226,8 +228,16 @@ def upgrade_crd_hook(namespace: str, image: str,
          "resources": ["customresourcedefinitions"],
          "verbs": ["create", "get", "list", "watch", "patch", "update"]},
     ])
+    # Jobs are immutable and run-once: version the name by image so a
+    # plain re-apply after a version bump creates a FRESH Job (and thus
+    # actually re-applies the CRDs) instead of erroring on the completed
+    # one; ttlSecondsAfterFinished reaps the old names
+    import hashlib
+
+    suffix = hashlib.sha256(image.encode()).hexdigest()[:8]
     docs.append(_hook_job(name, namespace, "pre-upgrade", image,
-                          ["tpu-operator-maintenance", "apply-crds"], op))
+                          ["tpu-operator-maintenance", "apply-crds"], op,
+                          job_name=f"{name}-{suffix}"))
     return docs
 
 

@@ -40,7 +40,7 @@ from .packaging import (
 # [tool.setuptools.package-data])
 VALUES_FILE = pathlib.Path(__file__).resolve().parent / "values.yaml"
 
-TOP_LEVEL_KEYS = {"namespace", "operator", "clusterPolicy"}
+TOP_LEVEL_KEYS = {"namespace", "operator", "clusterPolicy", "pluginConfig"}
 
 
 def default_values() -> Dict[str, Any]:
@@ -113,6 +113,49 @@ def render_cluster_policy(values: Dict[str, Any]) -> Optional[dict]:
     return cr
 
 
+def render_plugin_config_map(values: Dict[str, Any]) -> Optional[dict]:
+    """Ship the per-node plugin-config ConfigMap from values
+    (devicePlugin.config.create/data slot, templates/plugin_config.yaml).
+    Every entry is parsed with the plugin's own loader at render time, so
+    a config the plugin would reject fails the install render instead of
+    being silently kept-out at reload time."""
+    pc = values.get("pluginConfig") or {}
+    if not pc.get("create") or not pc.get("data"):
+        return None
+    cp = values.get("clusterPolicy") or {}
+    name = ((cp.get("spec") or {}).get("devicePlugin") or {}).get("configMap")
+    if not name:
+        raise ValueError(
+            "pluginConfig.create is true but "
+            "clusterPolicy.spec.devicePlugin.configMap names no ConfigMap")
+    from ..deviceplugin.plugin import parse_plugin_config
+
+    data = {}
+    for key, text in pc["data"].items():
+        if not isinstance(text, str):
+            raise ValueError(f"pluginConfig.data.{key}: must be a YAML "
+                             f"string (use a block scalar)")
+        try:
+            parse_plugin_config(key, text)
+        except Exception as e:  # surface WITH the key, whatever the type
+            raise ValueError(f"pluginConfig.data.{key}: {e}")
+        data[key] = text
+    # the most common typo: a defaultConfig that names no shipped entry
+    # would strand every unlabeled node on the built-in sharing policy at
+    # reload time — both values are in hand here, so fail the render
+    default = ((cp.get("spec") or {}).get("devicePlugin")
+               or {}).get("defaultConfig")
+    if default and default not in data:
+        raise ValueError(
+            f"clusterPolicy.spec.devicePlugin.defaultConfig {default!r} "
+            f"is not a key of pluginConfig.data {sorted(data)}")
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name,
+                         "namespace": values.get("namespace",
+                                                 "tpu-operator")},
+            "data": data}
+
+
 def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dict]:
     from ..api.crd import all_crds
 
@@ -138,6 +181,9 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
     op = values.get("operator") or {}
     if op.get("upgradeCRD"):
         docs.extend(upgrade_crd_hook(ns, operator_image(values), op))
+    pc = render_plugin_config_map(values)
+    if pc is not None:
+        docs.append(pc)
     cr = render_cluster_policy(values)
     if cr is not None:
         docs.append(cr)

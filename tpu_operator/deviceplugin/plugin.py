@@ -115,8 +115,13 @@ def parse_plugin_config(name: str, text: str) -> PluginConfig:
     if policy not in ("exclusive", "time-shared"):
         raise ValueError(f"config {name!r}: unknown sharingPolicy "
                          f"{policy!r} (exclusive|time-shared)")
+    try:
+        replicas = int(raw.get("sharingReplicas") or 1)
+    except (TypeError, ValueError):
+        raise ValueError(f"config {name!r}: sharingReplicas must be an "
+                         f"integer, got {raw.get('sharingReplicas')!r}")
     return PluginConfig(name, sharing_policy=policy,
-                        sharing_replicas=int(raw.get("sharingReplicas", 1)))
+                        sharing_replicas=replicas)
 
 
 def read_plugin_config(config_dir: str, name: str) -> PluginConfig:

@@ -76,7 +76,12 @@ class Client(abc.ABC):
     """Minimal typed-by-convention CRUD + watch client."""
 
     @abc.abstractmethod
-    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: Optional[str] = None,
+            metadata_only: bool = False) -> dict:
+        """Fetch one object. ``metadata_only`` is an optimization hint
+        (PartialObjectMetadata negotiation): implementations MAY return
+        the full object; callers must only rely on ``metadata``."""
         ...
 
     @abc.abstractmethod

@@ -61,7 +61,10 @@ class FakeClient(Client):
 
     # -- CRUD --------------------------------------------------------------
 
-    def get(self, api_version, kind, name, namespace=None):
+    def get(self, api_version, kind, name, namespace=None,
+            metadata_only=False):
+        # metadata_only is a wire-size hint; the fake returns the full
+        # object (permitted by the Client contract)
         with self._lock:
             obj = self._store.get(self._key(api_version, kind, name, namespace))
             if obj is None:
