@@ -147,15 +147,41 @@ def child_main() -> int:
         else:  # harness proof on host devices: keep it tiny
             res = collectives.run(size_mb=4.0, iters=2, repeats=1)
         print(f"# allreduce: {res}", file=sys.stderr)
+        # the full primitive suite rides along (informational; psum is
+        # the headline) — one bus-GB/s figure per collective. Run in a
+        # bounded worker thread: a hung collective (fabric fault) must
+        # not forfeit the already-measured headline to the subprocess
+        # timeout — neither an exception nor a deadlock may reach here.
+        import threading
+
+        suite_doc: dict = {"error": "timeout after 180s"}
+
+        def _run_suite():
+            nonlocal suite_doc
+            try:
+                suite = collectives.run_suite(
+                    size_mb=32.0 if platform == "tpu" else 0.5,
+                    iters=4 if platform == "tpu" else 1, repeats=1)
+                suite_doc = {op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
+                                  "correct": r.correct}
+                             for op, r in suite.items()}
+            except Exception as e:
+                suite_doc = {"error": f"{type(e).__name__}: {e}"}
+
+        worker = threading.Thread(target=_run_suite, daemon=True)
+        worker.start()
+        worker.join(timeout=180.0)
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
             return _emit({
                 "metric": "validator_ici_allreduce_bus_bandwidth",
                 "value": round(res.bus_bw_gbps, 2), "unit": "GB/s",
+                "collective_suite": suite_doc,
                 "vs_baseline": 0.0}, platform, res.correct)
         return _emit({
             "metric": "validator_ici_allreduce_fraction_of_peak",
             "value": round(value, 4), "unit": "fraction_of_ici_peak",
+            "collective_suite": suite_doc,
             "vs_baseline": round(value / BASELINE_FRACTION, 4)},
             platform, res.correct)
 

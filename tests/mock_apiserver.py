@@ -85,6 +85,11 @@ class MockApiServer:
         self.uid = 0
         self.fail_next_writes = 0            # inject N 409s on PUT/PATCH
         self.watchers: list[tuple[str, queue.Queue, threading.Event]] = []
+        # (rv, coll, alt_coll, event) log so a watch carrying
+        # ?resourceVersion=X replays everything newer than X — real
+        # apiserver semantics, required by informer-style clients that
+        # RESUME after a stream drop instead of re-listing
+        self.event_log: list[tuple[int, str, str, dict]] = []
         handler = type("H", (_Handler,), {"server_state": self})
         self.http = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self.thread = threading.Thread(target=self.http.serve_forever,
@@ -128,7 +133,13 @@ class MockApiServer:
         coll = collection_of(obj_path)
         alt = all_namespaces_collection(obj_path)
         evt = {"type": type_, "object": copy.deepcopy(obj)}
+        try:
+            evt_rv = int((obj.get("metadata") or {}).get(
+                "resourceVersion") or 0)
+        except (TypeError, ValueError):
+            evt_rv = self.rv
         with self.lock:
+            self.event_log.append((evt_rv, coll, alt, evt))
             for prefix, q, _closed in self.watchers:
                 if prefix in (coll, alt):
                     q.put(evt)
@@ -145,6 +156,9 @@ class MockApiServer:
             obj = self.objects.pop(path, None)
         if obj is None:
             return None
+        # real apiserver bumps rv on delete; the event log needs it so a
+        # resuming watcher (rv = last MODIFIED it saw) gets the DELETED
+        obj.setdefault("metadata", {})["resourceVersion"] = self.next_rv()
         self.publish("DELETED", path, obj)
         uid = (obj.get("metadata") or {}).get("uid")
         if uid:
@@ -198,15 +212,22 @@ class _Handler(BaseHTTPRequestHandler):
         u = urlparse(self.path)
         q = parse_qs(u.query)
         if q.get("watch") == ["true"]:
-            return self._serve_watch(u.path)
+            since = (q.get("resourceVersion") or [""])[0]
+            return self._serve_watch(u.path, since)
         with self.st.lock:
             if u.path in self.st.objects:
                 return self._send(200, copy.deepcopy(self.st.objects[u.path]))
         if is_collection_path(u.path):
+            # items and rv must be captured under ONE lock: an rv read
+            # after a concurrent write would be newer than the snapshot,
+            # and a watch resuming from it would never see that write
+            with self.st.lock:
+                items = self._collect(u.path, q)
+                rv = str(self.st.rv)
             return self._send(200, {
                 "kind": "List",
-                "items": self._collect(u.path, q),
-                "metadata": {"resourceVersion": str(self.st.rv)}})
+                "items": items,
+                "metadata": {"resourceVersion": rv}})
         self._not_found()
 
     def _collect(self, coll_path: str, q):
@@ -231,10 +252,21 @@ class _Handler(BaseHTTPRequestHandler):
             items.append(item)
         return items
 
-    def _serve_watch(self, coll_path: str):
+    def _serve_watch(self, coll_path: str, since_rv: str = ""):
         q: queue.Queue = queue.Queue()
         closed = threading.Event()
         with self.st.lock:
+            # replay events newer than the client's resourceVersion FIRST
+            # (registered under the lock, so nothing can slip between the
+            # replay snapshot and live delivery)
+            if since_rv:
+                try:
+                    since = int(since_rv)
+                except ValueError:
+                    since = 0
+                for rv, coll, alt, evt in self.st.event_log:
+                    if rv > since and coll_path in (coll, alt):
+                        q.put(evt)
             self.st.watchers.append((coll_path, q, closed))
         try:
             self.send_response(200)

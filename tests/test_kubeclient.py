@@ -488,18 +488,23 @@ class TestList:
 
 
 class TestWatch:
-    def test_watch_lists_then_streams_then_reconnects(self, apiserver, client):
+    def test_watch_resumes_after_stream_drop_without_relist(self, apiserver,
+                                                           client):
+        """Informer semantics: a normal stream recycle resumes the watch
+        from the last seen resourceVersion — the server replays what was
+        missed — with NO fresh list (re-listing the collection on every
+        few-minute server-side recycle is steady O(collection) load)."""
         apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w1"] = pod("w1")
         got = []
         done = threading.Event()
 
         def handler(evt):
             got.append((evt.type, evt.obj["metadata"]["name"]))
-            if len(got) >= 4:
+            if ("DELETED", "w1") in got:
                 done.set()
 
-        # first watch connection: one MODIFIED, then the server closes the
-        # stream; the client must re-list (ADDED again) and re-watch
+        # stream 1: one MODIFIED, then the server closes the stream;
+        # stream 2 (the resumed watch): DELETED
         apiserver.watch_batches.put([
             {"type": "MODIFIED", "object": pod("w1")}])
         apiserver.watch_batches.put([
@@ -511,8 +516,10 @@ class TestWatch:
             unsub()
         assert got[0] == ("ADDED", "w1")      # initial list
         assert ("MODIFIED", "w1") in got      # first stream
-        assert ("DELETED", "w1") in got       # after reconnect
+        assert ("DELETED", "w1") in got       # after resume
         assert apiserver.watch_connections >= 2
+        # the drop did NOT trigger a second list: exactly one ADDED
+        assert [e for e in got if e[0] == "ADDED"] == [("ADDED", "w1")]
 
     def test_watch_error_event_triggers_relist(self, apiserver, client):
         apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w2"] = pod("w2")

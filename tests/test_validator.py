@@ -122,6 +122,19 @@ class TestComponents:
         assert info.get("DEVICES") == "8"
         assert "BUS_BW_GBPS" in info
 
+    def test_ici_full_suite_reports_every_primitive(self, valdir,
+                                                    monkeypatch):
+        """ICI_FULL_SUITE=true adds one oracle-checked bus figure per
+        collective primitive to the barrier info (the NCCL-tests slot)."""
+        monkeypatch.setenv("ICI_SIZE_MB", "2")
+        monkeypatch.setenv("ICI_FULL_SUITE", "true")
+        monkeypatch.setenv("ICI_SUITE_SIZE_MB", "0.5")
+        info = validate_ici(allow_cpu=True)
+        for op in ("all_reduce", "all_gather", "reduce_scatter",
+                   "all_to_all", "ppermute"):
+            assert f"SUITE_{op.upper()}_BUS_GBPS" in info
+        assert barrier.is_ready("ici-ready")
+
     def test_dcn_skipped_single_slice(self, valdir, monkeypatch):
         from tpu_operator.validator.components import validate_dcn
 

@@ -68,6 +68,30 @@ class TestCollectives:
         assert res.correct
         assert res.bus_bw_gbps > 0
 
+    @pytest.mark.parametrize("op", sorted(collectives._BUS_FACTOR))
+    def test_collective_suite_each_op_oracle_checked(self, op):
+        """Every primitive of the fabric suite (the NCCL-tests slot)
+        must move real data correctly over the 8-device ring."""
+        res = collectives.run_collective(op, size_mb=0.5, iters=2,
+                                         repeats=1)
+        assert res.op == op and res.devices == 8
+        assert res.correct, f"{op} diverged from its numpy oracle"
+        assert res.bus_bw_gbps > 0
+
+    def test_bus_accounting_factors(self):
+        """Ring bus-bandwidth factors match the standard accounting."""
+        f = collectives._BUS_FACTOR
+        n = 8
+        assert f["all_reduce"](n) == pytest.approx(2 * 7 / 8)
+        assert f["all_gather"](n) == f["reduce_scatter"](n) \
+            == f["all_to_all"](n) == pytest.approx(7 / 8)
+        assert f["ppermute"](n) == 1.0
+
+    def test_run_suite_returns_all_ops(self):
+        suite = collectives.run_suite(size_mb=0.25, iters=1, repeats=1)
+        assert set(suite) == set(collectives._BUS_FACTOR)
+        assert all(r.correct for r in suite.values())
+
 
 class TestPallasProbe:
     def test_triad_correct_in_interpret_mode(self):

@@ -332,8 +332,14 @@ class HTTPClient(Client):
     # -- watch -------------------------------------------------------------
 
     def watch(self, api_version, kind, handler: Callable[[WatchEvent], None]):
-        """List+watch in a daemon thread with automatic re-list on stream
-        drop (informer-lite). Returns an unsubscribe callable."""
+        """List+watch in a daemon thread (informer-lite). A dropped
+        stream RESUMES from the last seen resourceVersion — the apiserver
+        replays anything missed — instead of re-listing the world; only
+        410 Gone (version fell out of the server's window) or a transport
+        failure forces a fresh list. Server-side watch timeouts recycle
+        every stream every few minutes, so re-listing per drop would be
+        steady O(collection) apiserver load per watcher on big clusters.
+        Returns an unsubscribe callable."""
         stop = threading.Event()
 
         import logging
@@ -341,11 +347,13 @@ class HTTPClient(Client):
         log = logging.getLogger("tpu_operator.kubeclient")
 
         def run():
+            rv = None  # None -> list before watching
             while not stop.is_set() and not self._stop.is_set():
                 try:
-                    items, rv = self._list_raw(api_version, kind)
-                    for obj in items:
-                        handler(WatchEvent("ADDED", obj))
+                    if rv is None:
+                        items, rv = self._list_raw(api_version, kind)
+                        for obj in items:
+                            handler(WatchEvent("ADDED", obj))
                     url = self._url(api_version, kind, None, None)
                     if is_namespaced(kind):
                         url = f"{self._base(api_version)}/{plural_of(kind)}"
@@ -363,21 +371,31 @@ class HTTPClient(Client):
                                 continue
                             evt = json.loads(line)
                             etype = evt.get("type", "MODIFIED")
+                            obj = evt.get("object", {})
+                            new_rv = (obj.get("metadata")
+                                      or {}).get("resourceVersion")
                             if etype == "BOOKMARK":
+                                if new_rv:
+                                    rv = new_rv
                                 continue
                             if etype == "ERROR":
-                                # e.g. 410 Gone: resourceVersion too old —
-                                # break out and re-list from scratch
+                                # 410 Gone: resourceVersion too old — the
+                                # ONE case that requires a fresh list
                                 log.warning("watch %s error event: %s",
                                             kind, evt.get("object"))
+                                rv = None
                                 break
-                            obj = evt.get("object", {})
+                            if new_rv:
+                                rv = new_rv
                             obj.setdefault("apiVersion", api_version)
                             obj.setdefault("kind", kind)
                             handler(WatchEvent(etype, obj))
+                    # normal stream end (server recycle): loop resumes the
+                    # watch from rv without re-listing
                 except Exception as e:
                     log.warning("watch %s failed (%s: %s); re-listing in 2s",
                                 kind, type(e).__name__, e)
+                    rv = None  # transport fault: state unknown, re-list
                     if stop.wait(2.0):
                         return
 

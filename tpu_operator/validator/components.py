@@ -245,6 +245,17 @@ def validate_ici(threshold: Optional[float] = None,
             raise ValidationFailed(
                 f"ICI allreduce reached {res.fraction_of_peak:.1%} of peak, "
                 f"below the {thr:.0%} threshold")
+    if os.environ.get("ICI_FULL_SUITE", "").lower() == "true":
+        # the NCCL-tests slot: one figure per primitive in the barrier
+        # file (informational — the psum number above stays the gate; a
+        # primitive that moves wrong data still fails hard)
+        suite = collectives.run_suite(
+            size_mb=float(os.environ.get("ICI_SUITE_SIZE_MB", "64")))
+        for op, r in suite.items():
+            if not r.correct:
+                raise ValidationFailed(f"collective {op} produced wrong "
+                                       f"values")
+            info[f"SUITE_{op.upper()}_BUS_GBPS"] = f"{r.bus_bw_gbps:.2f}"
     barrier.write_status("ici-ready", info)
     return info
 

@@ -99,6 +99,186 @@ def run(size_mb: float = 256.0, iters: int = 10, repeats: int = 5,
         device_kind=kind, correct=correct)
 
 
+# ---------------------------------------------------------------------------
+# full collective suite (the NCCL-tests slot: one number per primitive)
+# ---------------------------------------------------------------------------
+
+# per-chip ICI bytes moved per element byte of input, ring algorithms
+# (the standard bus-bandwidth accounting NCCL-tests uses):
+#   all_reduce       2*(n-1)/n   (reduce-scatter + all-gather phases)
+#   all_gather        (n-1)/n    (each chip receives the other n-1 blocks)
+#   reduce_scatter    (n-1)/n
+#   all_to_all        (n-1)/n    (keeps its own block local)
+#   ppermute          1          (whole buffer crosses one hop)
+_BUS_FACTOR = {
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+}
+
+
+@dataclass
+class CollectiveResult:
+    op: str
+    devices: int
+    bytes_per_device: int
+    seconds: float
+    algo_bw_gbps: float
+    bus_bw_gbps: float
+    peak_ici_gbps: Optional[float]
+    fraction_of_peak: Optional[float]
+    device_kind: str
+    correct: bool
+
+
+def _step_fn(op: str, n: int):
+    """The one-shot, shape-stable body of a collective — shared by the
+    timed scan chain and the correctness oracle so the two can never
+    drift apart. Shape-stable means the op output feeds the next input
+    directly (no HBM rebuild inside the timed loop — except
+    reduce_scatter, whose output is 1/n of its input and is re-expanded
+    by an all_gather, so that chain times the RS+AG pair and its per-op
+    figure is conservative)."""
+
+    def norm(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, "ring", to="varying")
+        return lax.pvary(x, "ring")  # pragma: no cover - older jax
+
+    if op == "all_reduce":
+        def one(c):
+            return norm(lax.psum(c, "ring") * (1.0 / n))
+    elif op == "all_gather":
+        def one(c):
+            g = lax.all_gather(c, "ring", axis=0, tiled=True)  # (n*k,)
+            # slice a REMOTE block (the next device's): the local block
+            # never crossed the wire, so checking it would prove nothing
+            # about the fabric
+            i = (lax.axis_index("ring") + 1) % n
+            k = c.shape[0]
+            return lax.dynamic_slice_in_dim(g, i * k, k, axis=0)
+    elif op == "reduce_scatter":
+        def one(c):
+            s = lax.psum_scatter(c, "ring", scatter_dimension=0,
+                                 tiled=True) * (1.0 / n)      # (k/n,)
+            return lax.all_gather(s, "ring", axis=0, tiled=True)
+    elif op == "all_to_all":
+        def one(c):
+            y = lax.all_to_all(c.reshape(n, -1), "ring", split_axis=0,
+                               concat_axis=0, tiled=False)
+            return y.reshape(c.shape)
+    elif op == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def one(c):
+            return lax.ppermute(c, "ring", perm=perm)
+    else:
+        raise ValueError(f"unknown collective {op!r}")
+    return one
+
+
+def _chain_fn(op: str, mesh, n: int, iters: int):
+    """A jitted scan of ``iters`` executions of the collective with a
+    data dependence between steps."""
+    one = _step_fn(op, n)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("ring"), out_specs=P("ring"))
+    def chain(shard):
+        out, _ = lax.scan(lambda c, _: (one(c), ()), shard, None,
+                          length=iters)
+        return out
+
+    return chain
+
+
+def _oracle_ok(op: str, mesh, n: int) -> bool:
+    """Tiny-shape correctness check of the SAME step the timed chain
+    runs, against a numpy oracle (the timed loop's inputs are constant
+    ones, which would mask routing errors)."""
+    import numpy as np
+
+    k = 8 * n
+    x = jnp.arange(n * k, dtype=jnp.float32).reshape(n, k)
+    xs = np.asarray(x)
+    one = _step_fn(op, n)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("ring", None),
+             out_specs=P("ring", None))
+    def apply_once(shard):
+        return one(shard.reshape(-1)).reshape(1, k)
+
+    got = np.asarray(apply_once(x))
+    if op == "all_reduce":
+        want = np.tile(xs.sum(axis=0) / n, (n, 1))
+    elif op == "all_gather":
+        # device i returns device (i+1)%n's shard
+        want = np.roll(xs, -1, axis=0)
+    elif op == "reduce_scatter":
+        # RS averages blocks of the concatenated shards; AG re-gathers:
+        # every device ends with the blockwise means, identical everywhere
+        want = np.tile(xs.reshape(n, n, k // n).sum(axis=0).reshape(k) / n,
+                       (n, 1))
+    elif op == "all_to_all":
+        want = xs.reshape(n, n, k // n).swapaxes(0, 1).reshape(n, k)
+    else:  # ppermute: shard i lands on device i+1
+        want = np.roll(xs, 1, axis=0)
+    return bool(np.allclose(got, want, rtol=1e-4))
+
+
+def run_collective(op: str, size_mb: float = 64.0, iters: int = 10,
+                   repeats: int = 5, devices=None) -> CollectiveResult:
+    """Measure one collective primitive over the ICI ring (NCCL-tests
+    slot). ``size_mb`` is the per-device buffer size."""
+    import numpy as np
+
+    mesh = ring_mesh(devices)
+    n = mesh.devices.size
+    # per-device k elements, divisible by n*n so all_to_all/RS tile evenly
+    k = max(1, int(size_mb * 1e6 / 4) // (n * n)) * n * n
+    x = jnp.ones((n, k), dtype=jnp.float32)
+
+    chain = _chain_fn(op, mesh, n, iters)
+    xf = x.reshape(n * k)  # shard_map over P("ring"): k per device
+    out = chain(xf)
+    np.asarray(out[:1])  # compile + sync
+
+    calls = 4
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        o = xf
+        for _ in range(calls):
+            o = chain(o)
+        np.asarray(o[:1])
+        best = min(best, time.perf_counter() - t0)
+
+    per_iter = best / (iters * calls)
+    nbytes = k * 4
+    algo = nbytes / per_iter / 1e9
+    bus = _BUS_FACTOR[op](n) * nbytes / per_iter / 1e9
+    kind = getattr(mesh.devices.flat[0], "device_kind", "cpu")
+    spec = chip_spec_for(kind)
+    return CollectiveResult(
+        op=op, devices=n, bytes_per_device=nbytes, seconds=best,
+        algo_bw_gbps=algo, bus_bw_gbps=bus,
+        peak_ici_gbps=spec.ici_bw_gbps if spec else None,
+        fraction_of_peak=(bus / spec.ici_bw_gbps) if spec else None,
+        device_kind=kind, correct=_oracle_ok(op, mesh, n))
+
+
+def run_suite(size_mb: float = 64.0, iters: int = 10, repeats: int = 3,
+              devices=None, ops=None) -> dict:
+    """One CollectiveResult per primitive — the full fabric picture the
+    reference leaves to NCCL-tests inside user workloads."""
+    return {op: run_collective(op, size_mb=size_mb, iters=iters,
+                               repeats=repeats, devices=devices)
+            for op in (ops or list(_BUS_FACTOR))}
+
+
 def main() -> int:
     import json
 
