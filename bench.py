@@ -119,6 +119,7 @@ def _emit(doc: dict, platform: str, ok: bool) -> int:
 
 def child_main() -> int:
     """Run the actual measurement in this process; print the JSON line."""
+    child_start = time.monotonic()
     budget = float(os.environ.get("TPUOP_BENCH_CHILD_TIMEOUT", "0") or 0)
     if budget > 30:
         # backend init can hang at the C level (remote PJRT tunnel); dump
@@ -170,7 +171,15 @@ def child_main() -> int:
 
         worker = threading.Thread(target=_run_suite, daemon=True)
         worker.start()
-        worker.join(timeout=180.0)
+        # never outlive the child's own budget: the faulthandler
+        # self-terminates at budget-15s and the parent kills at budget,
+        # either of which would forfeit the measured headline
+        if budget > 0:
+            remaining = budget - (time.monotonic() - child_start)
+            join_s = max(5.0, min(180.0, remaining - 25.0))
+        else:
+            join_s = 180.0
+        worker.join(timeout=join_s)
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
             return _emit({

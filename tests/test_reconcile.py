@@ -393,3 +393,37 @@ def test_template_kinds_scan_includes_conditional_docs():
     assert ("node.k8s.io/v1", "RuntimeClass") not in kinds
     om = next(s for s in build_states() if s.name == "operator-metrics")
     assert ("monitoring.coreos.com/v1", "PrometheusRule") in om.sweep_kinds()
+
+
+class TestScale:
+    """Operational-performance guard: the reconcile loop's contract is
+    all-operands-Ready well under the reference's 5-minute install
+    budget (SURVEY.md section 6), and a steady-state pass must be
+    hash-skip cheap even with hundreds of nodes."""
+
+    def test_200_node_cluster_converges_fast_and_steady_state_is_noop(self):
+        c = make_cluster(n_tpu=200, n_cpu=20)
+        c.create(new_cluster_policy())
+        t0 = time.monotonic()
+        rec, _ = reconcile_once(c)
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        elapsed = time.monotonic() - t0
+        got = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "ready"
+        # every TPU node labeled, no CPU node touched
+        labeled = [n for n in c.list("v1", "Node")
+                   if (n["metadata"].get("labels") or {}).get(L.TPU_PRESENT)]
+        assert len(labeled) == 200
+        assert elapsed < 60.0, f"200-node convergence took {elapsed:.1f}s"
+
+        # steady state: another full pass rewrites nothing (hash-skip)
+        rvs = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
+               for d in c.list("apps/v1", "DaemonSet")}
+        t1 = time.monotonic()
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        steady = time.monotonic() - t1
+        rvs2 = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
+                for d in c.list("apps/v1", "DaemonSet")}
+        assert rvs2 == rvs, "steady-state reconcile rewrote DaemonSets"
+        assert steady < 20.0, f"steady-state pass took {steady:.1f}s"

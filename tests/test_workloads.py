@@ -79,12 +79,16 @@ class TestCollectives:
         assert res.bus_bw_gbps > 0
 
     def test_bus_accounting_factors(self):
-        """Ring bus-bandwidth factors match the standard accounting."""
+        """Ring bus-bandwidth factors match the standard accounting,
+        normalized by per-device INPUT size: all_gather receives n-1
+        full shards (NCCL's (n-1)/n is relative to the total gathered
+        size, i.e. the same traffic)."""
         f = collectives._BUS_FACTOR
         n = 8
         assert f["all_reduce"](n) == pytest.approx(2 * 7 / 8)
-        assert f["all_gather"](n) == f["reduce_scatter"](n) \
-            == f["all_to_all"](n) == pytest.approx(7 / 8)
+        assert f["all_gather"](n) == pytest.approx(7.0)
+        assert f["reduce_scatter"](n) == f["all_to_all"](n) \
+            == pytest.approx(7 / 8)
         assert f["ppermute"](n) == 1.0
 
     def test_run_suite_returns_all_ops(self):
