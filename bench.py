@@ -155,31 +155,40 @@ def child_main() -> int:
         # timeout — neither an exception nor a deadlock may reach here.
         import threading
 
-        suite_doc: dict = {"error": "timeout after 180s"}
+        suite_doc: dict = {}
 
         def _run_suite():
-            nonlocal suite_doc
             try:
                 suite = collectives.run_suite(
                     size_mb=32.0 if platform == "tpu" else 0.5,
                     iters=4 if platform == "tpu" else 1, repeats=1)
-                suite_doc = {op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
-                                  "correct": r.correct}
-                             for op, r in suite.items()}
+                suite_doc.update(
+                    {op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
+                          "correct": r.correct}
+                     for op, r in suite.items()})
             except Exception as e:
-                suite_doc = {"error": f"{type(e).__name__}: {e}"}
+                suite_doc["error"] = f"{type(e).__name__}: {e}"
 
-        worker = threading.Thread(target=_run_suite, daemon=True)
-        worker.start()
         # never outlive the child's own budget: the faulthandler
-        # self-terminates at budget-15s and the parent kills at budget,
-        # either of which would forfeit the measured headline
+        # self-terminates at budget-15s and the parent kills at budget.
+        # Reserve ~45s after the join for the telemetry scrape (HTTP
+        # round-trip with its own 10s timeout) + JSON emission; if that
+        # leaves nothing, skip the suite entirely rather than risk the
+        # already-measured headline.
         if budget > 0:
             remaining = budget - (time.monotonic() - child_start)
-            join_s = max(5.0, min(180.0, remaining - 25.0))
+            join_s = min(180.0, remaining - 45.0)
         else:
             join_s = 180.0
-        worker.join(timeout=join_s)
+        if join_s > 0:
+            worker = threading.Thread(target=_run_suite, daemon=True)
+            worker.start()
+            worker.join(timeout=join_s)
+            if worker.is_alive() and not suite_doc:
+                suite_doc["error"] = (f"suite still running after "
+                                      f"{join_s:.0f}s; dropped")
+        else:
+            suite_doc["error"] = "skipped: no budget left after headline"
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
             return _emit({

@@ -268,6 +268,80 @@ class TestNodeMetricsExporter:
             server.shutdown()
             server.server_close()
 
+    def test_perf_figures_republished_as_gauges(self, valdir, fake_chips):
+        """The proofs' measured numbers (MXU utilization, ICI fraction,
+        per-primitive suite figures, HBM fraction) become scrapeable
+        per-node gauges — not values buried in hostPath files."""
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        barrier.write_status("jax-ready", {"MXU_UTILIZATION": "0.942"})
+        barrier.write_status("ici-ready", {
+            "FRACTION_OF_PEAK": "0.85",
+            "SUITE_ALL_GATHER_BUS_GBPS": "123.40",
+            "SUITE_PPERMUTE_BUS_GBPS": "55.00"})
+        barrier.write_status("hbm-ready", {"FRACTION_OF_PEAK": "0.91"})
+        m = NodeMetrics("tpu-0")
+        m.collect_once()
+        body = m.render().decode()
+        assert ('tpu_operator_node_matmul_mxu_utilization'
+                '{node="tpu-0"} 0.942') in body
+        assert ('tpu_operator_node_ici_fraction_of_peak'
+                '{node="tpu-0"} 0.85') in body
+        assert ('tpu_operator_node_collective_bus_gbps'
+                '{node="tpu-0",op="all_gather"} 123.4') in body
+        assert ('tpu_operator_node_collective_bus_gbps'
+                '{node="tpu-0",op="ppermute"} 55.0') in body
+        assert ('tpu_operator_node_hbm_fraction_of_peak'
+                '{node="tpu-0"} 0.91') in body
+
+    def test_perf_gauges_absent_until_proofs_run(self, valdir, fake_chips):
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        m = NodeMetrics("tpu-0")
+        m.collect_once()
+        body = m.render().decode()
+        # no series with a node label until a proof wrote a figure
+        assert 'tpu_operator_node_matmul_mxu_utilization{' not in body
+        assert 'tpu_operator_node_collective_bus_gbps{' not in body
+
+    def test_perf_gauges_cleared_when_barrier_file_goes(self, valdir,
+                                                        fake_chips):
+        """A vanished barrier file (preStop cleanup, re-validation) must
+        REMOVE the perf series, not freeze the old healthy value on a
+        degraded node's dashboard."""
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        barrier.write_status("jax-ready", {"MXU_UTILIZATION": "0.95"})
+        barrier.write_status("ici-ready", {
+            "FRACTION_OF_PEAK": "0.86",
+            "SUITE_PPERMUTE_BUS_GBPS": "55.00"})
+        m = NodeMetrics("tpu-0")
+        m.collect_once()
+        assert 'mxu_utilization{node="tpu-0"} 0.95' in m.render().decode()
+        barrier.cleanup_all()  # the validator's preStop
+        m.collect_once()
+        body = m.render().decode()
+        assert 'tpu_operator_node_matmul_mxu_utilization{' not in body
+        assert 'tpu_operator_node_ici_fraction_of_peak{' not in body
+        assert 'op="ppermute"' not in body
+
+    def test_suite_gauges_cleared_when_suite_disabled(self, valdir,
+                                                      fake_chips):
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        barrier.write_status("ici-ready", {
+            "FRACTION_OF_PEAK": "0.86",
+            "SUITE_ALL_TO_ALL_BUS_GBPS": "44.10"})
+        m = NodeMetrics("tpu-0")
+        m.collect_once()
+        assert 'op="all_to_all"' in m.render().decode()
+        # ici re-proven without ICI_FULL_SUITE: no SUITE_ keys anymore
+        barrier.write_status("ici-ready", {"FRACTION_OF_PEAK": "0.85"})
+        m.collect_once()
+        body = m.render().decode()
+        assert 'op="all_to_all"' not in body
+        assert 'ici_fraction_of_peak{node="tpu-0"} 0.85' in body
+
 
 class TestValidatorCLI:
     def test_wait_subcommand(self, valdir):

@@ -55,6 +55,27 @@ class NodeMetrics:
             "tpu_operator_node_driver_revalidation_ok",
             "1 when the last periodic driver re-proof succeeded",
             labelnames=("node",), registry=self.registry)
+        # performance figures measured by the proofs (barrier file INFO
+        # lines) re-published as scrapeable gauges — the perf floor
+        # becomes a continuously observable per-node signal, not a value
+        # buried in a hostPath file
+        self.mxu_utilization = Gauge(
+            "tpu_operator_node_matmul_mxu_utilization",
+            "Fraction of peak bf16 the jax proof sustained",
+            labelnames=("node",), registry=self.registry)
+        self.ici_fraction = Gauge(
+            "tpu_operator_node_ici_fraction_of_peak",
+            "Fraction of peak ICI bandwidth the psum proof reached",
+            labelnames=("node",), registry=self.registry)
+        self.hbm_fraction = Gauge(
+            "tpu_operator_node_hbm_fraction_of_peak",
+            "Fraction of peak HBM bandwidth the STREAM probe reached",
+            labelnames=("node",), registry=self.registry)
+        self.collective_bus = Gauge(
+            "tpu_operator_node_collective_bus_gbps",
+            "Per-primitive ICI bus bandwidth from the full suite",
+            labelnames=("op", "node"), registry=self.registry)
+        self._published_ops: set = set()
         self._reval_count = 0
 
     @staticmethod
@@ -92,6 +113,51 @@ class NodeMetrics:
         info = barrier.read_status("driver-ready") or {}
         self.chips.labels(node=self.node_name).set(
             int(info.get("CHIP_COUNT", "0") or 0))
+        self._publish_perf_figures()
+
+    def _publish_perf_figures(self) -> None:
+        """Re-publish the proofs' measured figures. A figure whose source
+        (barrier file or key) has gone away is REMOVED, not left frozen:
+        a stale series would show a degraded node's dashboard the old
+        healthy perf floor as if it were current."""
+
+        def as_float(s):
+            try:
+                return float(s)
+            except (TypeError, ValueError):
+                return None
+
+        def set_or_remove(gauge, value, **labels):
+            labels = {**labels, "node": self.node_name}
+            if value is not None:
+                gauge.labels(**labels).set(value)
+            else:
+                try:  # remove() takes values in declared-labelname order
+                    gauge.remove(*[labels[n] for n in gauge._labelnames])
+                except KeyError:
+                    pass  # never published
+
+        jax_info = barrier.read_status("jax-ready") or {}
+        set_or_remove(self.mxu_utilization,
+                      as_float(jax_info.get("MXU_UTILIZATION")))
+        ici_info = barrier.read_status("ici-ready") or {}
+        set_or_remove(self.ici_fraction,
+                      as_float(ici_info.get("FRACTION_OF_PEAK")))
+        present_ops = set()
+        for key, val in ici_info.items():
+            if key.startswith("SUITE_") and key.endswith("_BUS_GBPS"):
+                bw = as_float(val)
+                if bw is not None:
+                    op = key[len("SUITE_"):-len("_BUS_GBPS")].lower()
+                    present_ops.add(op)
+                    self.collective_bus.labels(
+                        op=op, node=self.node_name).set(bw)
+        for op in self._published_ops - present_ops:
+            set_or_remove(self.collective_bus, None, op=op)
+        self._published_ops = present_ops
+        hbm_info = barrier.read_status("hbm-ready") or {}
+        set_or_remove(self.hbm_fraction,
+                      as_float(hbm_info.get("FRACTION_OF_PEAK")))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

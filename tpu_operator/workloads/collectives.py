@@ -29,32 +29,12 @@ from ..parallel.mesh import ring_mesh, shard_map
 from .hardware import chip_spec_for
 
 
-@dataclass
-class AllReduceResult:
-    devices: int
-    bytes_per_device: int
-    seconds: float
-    algo_bw_gbps: float
-    bus_bw_gbps: float
-    peak_ici_gbps: Optional[float]
-    fraction_of_peak: Optional[float]
-    device_kind: str
-    correct: bool
-
-
 def run(size_mb: float = 256.0, iters: int = 10, repeats: int = 5,
-        devices=None) -> AllReduceResult:
-    """The gating psum measurement — one timing harness for the whole
-    suite (run_collective), re-shaped into the result type the validator
-    and bench consume."""
-    r = run_collective("all_reduce", size_mb=size_mb, iters=iters,
-                       repeats=repeats, devices=devices)
-    return AllReduceResult(
-        devices=r.devices, bytes_per_device=r.bytes_per_device,
-        seconds=r.seconds, algo_bw_gbps=r.algo_bw_gbps,
-        bus_bw_gbps=r.bus_bw_gbps, peak_ici_gbps=r.peak_ici_gbps,
-        fraction_of_peak=r.fraction_of_peak, device_kind=r.device_kind,
-        correct=r.correct)
+        devices=None) -> "CollectiveResult":
+    """The gating psum measurement — one timing harness and one result
+    type for the whole suite (run_collective)."""
+    return run_collective("all_reduce", size_mb=size_mb, iters=iters,
+                          repeats=repeats, devices=devices)
 
 
 # ---------------------------------------------------------------------------
@@ -82,7 +62,6 @@ _BUS_FACTOR = {
 
 @dataclass
 class CollectiveResult:
-    op: str
     devices: int
     bytes_per_device: int
     seconds: float
@@ -92,6 +71,11 @@ class CollectiveResult:
     fraction_of_peak: Optional[float]
     device_kind: str
     correct: bool
+    op: str = "all_reduce"
+
+
+# the historical name the validator/bench consume for the psum gate
+AllReduceResult = CollectiveResult
 
 
 def _step_fn(op: str, n: int):
