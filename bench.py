@@ -155,19 +155,20 @@ def child_main() -> int:
         # timeout — neither an exception nor a deadlock may reach here.
         import threading
 
-        suite_doc: dict = {}
+        box: dict = {}  # worker publishes ONE fresh dict; never mutates
+        # an object the emitter may be serializing concurrently
 
         def _run_suite():
             try:
                 suite = collectives.run_suite(
                     size_mb=32.0 if platform == "tpu" else 0.5,
                     iters=4 if platform == "tpu" else 1, repeats=1)
-                suite_doc.update(
-                    {op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
-                          "correct": r.correct}
-                     for op, r in suite.items()})
+                box["doc"] = {
+                    op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
+                         "correct": r.correct}
+                    for op, r in suite.items()}
             except Exception as e:
-                suite_doc["error"] = f"{type(e).__name__}: {e}"
+                box["doc"] = {"error": f"{type(e).__name__}: {e}"}
 
         # never outlive the child's own budget: the faulthandler
         # self-terminates at budget-15s and the parent kills at budget.
@@ -184,11 +185,11 @@ def child_main() -> int:
             worker = threading.Thread(target=_run_suite, daemon=True)
             worker.start()
             worker.join(timeout=join_s)
-            if worker.is_alive() and not suite_doc:
-                suite_doc["error"] = (f"suite still running after "
-                                      f"{join_s:.0f}s; dropped")
+            suite_doc = box.get("doc") or {
+                "error": f"suite still running after {join_s:.0f}s; "
+                         f"dropped"}
         else:
-            suite_doc["error"] = "skipped: no budget left after headline"
+            suite_doc = {"error": "skipped: no budget left after headline"}
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
             return _emit({

@@ -527,3 +527,35 @@ class TestPerfProofThresholdBranches:
         info = validate_jax(matmul_size=64, allow_cpu=True)
         assert info["MXU_UTILIZATION"] == "0.250"
         assert barrier.is_ready("jax-ready")
+
+
+class TestDCNBandwidthProbe:
+    """DCN_BANDWIDTH_PROBE=true extends the reachability proof with a
+    measured cross-slice psum figure (fake-slice split for test
+    clusters whose devices carry no slice_index)."""
+
+    def test_probe_figures_land_in_barrier_info(self, valdir, monkeypatch):
+        import socket
+        import threading
+
+        from tpu_operator.validator.components import validate_dcn
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        threading.Thread(target=lambda: srv.accept(),
+                         daemon=True).start()
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                           f"127.0.0.1:{port}")
+        monkeypatch.setenv("DCN_BANDWIDTH_PROBE", "true")
+        monkeypatch.setenv("DCN_PROBE_FAKE_SLICES", "2")
+        monkeypatch.setenv("DCN_PROBE_SIZE_MB", "0.5")
+        try:
+            info = validate_dcn(timeout=5)
+        finally:
+            srv.close()
+        assert info["DCN_SLICES"] == "2"
+        assert float(info["DCN_BUS_GBPS"]) > 0
+        assert barrier.is_ready("dcn-ready")

@@ -320,6 +320,7 @@ def validate_dcn(timeout: Optional[float] = None) -> Dict[str, str]:
         timeout if timeout is not None
         else float(os.environ.get("DCN_TIMEOUT_S", "60")))
     last_err: Optional[Exception] = None
+    info: Optional[Dict[str, str]] = None
     while time.monotonic() < deadline:
         start = time.perf_counter()
         try:
@@ -331,14 +332,63 @@ def validate_dcn(timeout: Optional[float] = None) -> Dict[str, str]:
                 "SLICE_ID": os.environ.get("MEGASCALE_SLICE_ID", ""),
                 "RTT_MS": f"{rtt_ms:.2f}",
             }
-            barrier.write_status("dcn-ready", info)
-            return info
+            break
         except OSError as e:
             last_err = e
             time.sleep(1.0)
-    raise ValidationFailed(
-        f"megascale coordinator {coordinator} unreachable over DCN: "
-        f"{last_err}")
+    if info is None:
+        raise ValidationFailed(
+            f"megascale coordinator {coordinator} unreachable over DCN: "
+            f"{last_err}")
+    # outside the connect-retry loop: a probe error must never be
+    # misread as coordinator unreachability (and never re-run per retry)
+    _maybe_dcn_bandwidth_probe(info)
+    barrier.write_status("dcn-ready", info)
+    return info
+
+
+def _maybe_dcn_bandwidth_probe(info: Dict[str, str]) -> None:
+    """DCN_BANDWIDTH_PROBE=true: measure the cross-slice gradient-sync
+    path (psum over the hybrid mesh's dcn axis) and add its figures to
+    the barrier info — the measured-bandwidth counterpart of the TCP
+    reachability check, like validate_ici is to the driver proof.
+    ``DCN_PROBE_FAKE_SLICES=N`` splits the visible devices into N equal
+    groups for fake/test clusters whose devices carry no slice_index.
+    Wrong psum results fail the proof; a probe that cannot run (e.g.
+    devices not visible from this pod) records the error and leaves the
+    reachability verdict standing."""
+    if os.environ.get("DCN_BANDWIDTH_PROBE", "").lower() != "true":
+        return
+    from ..parallel import multihost
+
+    try:
+        fake_n = int(os.environ.get("DCN_PROBE_FAKE_SLICES", "0") or 0)
+        kwargs = {}
+        if fake_n > 1:
+            import jax
+
+            devs = jax.devices()
+            per = len(devs) // fake_n
+            if per < 1:
+                raise ValueError(
+                    f"DCN_PROBE_FAKE_SLICES={fake_n} exceeds the "
+                    f"{len(devs)} visible devices")
+            index = {id(d): i for i, d in enumerate(devs)}
+            kwargs = {"devices": devs[:per * fake_n],
+                      "slice_getter": lambda d: index[id(d)] // per}
+        res = multihost.dcn_allreduce_probe(
+            size_mb=float(os.environ.get("DCN_PROBE_SIZE_MB", "64")),
+            **kwargs)
+    except Exception as e:
+        # a probe that cannot RUN (no visible backend, bad config) is a
+        # recorded error, not a failed proof — reachability stands; only
+        # a probe that ran and moved WRONG DATA fails below
+        info["DCN_PROBE_ERROR"] = f"{type(e).__name__}: {e}"
+        return
+    if not res.correct:
+        raise ValidationFailed("DCN psum produced wrong values")
+    info["DCN_SLICES"] = str(res.slices)
+    info["DCN_BUS_GBPS"] = f"{res.bus_bw_gbps:.2f}"
 
 
 def validate_fencing() -> Dict[str, str]:

@@ -127,22 +127,25 @@ class NodeMetrics:
             except (TypeError, ValueError):
                 return None
 
-        def set_or_remove(gauge, value, **labels):
-            labels = {**labels, "node": self.node_name}
+        def set_or_remove(gauge, value, ordered_label_values):
+            """``ordered_label_values`` in the gauge's declared labelname
+            order (we declared them, so the caller knows it — no reliance
+            on prometheus_client internals)."""
             if value is not None:
-                gauge.labels(**labels).set(value)
+                gauge.labels(*ordered_label_values).set(value)
             else:
-                try:  # remove() takes values in declared-labelname order
-                    gauge.remove(*[labels[n] for n in gauge._labelnames])
+                try:
+                    gauge.remove(*ordered_label_values)
                 except KeyError:
                     pass  # never published
 
+        node = self.node_name
         jax_info = barrier.read_status("jax-ready") or {}
         set_or_remove(self.mxu_utilization,
-                      as_float(jax_info.get("MXU_UTILIZATION")))
+                      as_float(jax_info.get("MXU_UTILIZATION")), (node,))
         ici_info = barrier.read_status("ici-ready") or {}
         set_or_remove(self.ici_fraction,
-                      as_float(ici_info.get("FRACTION_OF_PEAK")))
+                      as_float(ici_info.get("FRACTION_OF_PEAK")), (node,))
         present_ops = set()
         for key, val in ici_info.items():
             if key.startswith("SUITE_") and key.endswith("_BUS_GBPS"):
@@ -150,14 +153,13 @@ class NodeMetrics:
                 if bw is not None:
                     op = key[len("SUITE_"):-len("_BUS_GBPS")].lower()
                     present_ops.add(op)
-                    self.collective_bus.labels(
-                        op=op, node=self.node_name).set(bw)
+                    self.collective_bus.labels(op=op, node=node).set(bw)
         for op in self._published_ops - present_ops:
-            set_or_remove(self.collective_bus, None, op=op)
+            set_or_remove(self.collective_bus, None, (op, node))
         self._published_ops = present_ops
         hbm_info = barrier.read_status("hbm-ready") or {}
         set_or_remove(self.hbm_fraction,
-                      as_float(hbm_info.get("FRACTION_OF_PEAK")))
+                      as_float(hbm_info.get("FRACTION_OF_PEAK")), (node,))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
