@@ -189,9 +189,10 @@ class TestDCNProbe:
     def _fake_two_slices():
         import jax
 
+        from tpu_operator.parallel.multihost import fake_slice_getter
+
         devs = jax.devices()[:8]
-        index = {id(d): i for i, d in enumerate(devs)}
-        return devs, lambda d: index[id(d)] // 4
+        return devs, fake_slice_getter(devs, 2)
 
     def test_probe_on_fake_two_slice_mesh(self):
         from tpu_operator.parallel.multihost import dcn_allreduce_probe

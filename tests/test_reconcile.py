@@ -427,3 +427,23 @@ class TestScale:
                 for d in c.list("apps/v1", "DaemonSet")}
         assert rvs2 == rvs, "steady-state reconcile rewrote DaemonSets"
         assert steady < 20.0, f"steady-state pass took {steady:.1f}s"
+
+
+def test_cr_state_transitions_emit_events_once():
+    """StateChanged Events fire on transitions only — a 5s not-ready
+    requeue loop must not grow the event stream."""
+    c = make_cluster()
+    c.create(new_cluster_policy())
+    rec, _ = reconcile_once(c)
+    rec.reconcile(Request(name="tpu-cluster-policy"))  # still notReady
+    events = [e for e in c.list("v1", "Event")
+              if e["reason"] == "StateChanged"]
+    assert len(events) == 1  # new -> notReady, once
+    assert events[0]["count"] == 1
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(Request(name="tpu-cluster-policy"))
+    rec.reconcile(Request(name="tpu-cluster-policy"))  # steady ready
+    msgs = sorted(e["message"] for e in c.list("v1", "Event")
+                  if e["reason"] == "StateChanged")
+    assert len(msgs) == 2
+    assert any("-> ready" in m for m in msgs)

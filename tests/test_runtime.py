@@ -341,3 +341,68 @@ class TestController:
             "cloud.google.com/gke-tpu-topology": "2x2"}}})
         assert pred(new_relevant, old)
         assert fired == []
+
+
+class TestEventRecorder:
+    """Kubernetes Event recording (EventRecorder slot): create-or-count
+    correlation, namespace placement, best-effort failure behavior."""
+
+    def _node(self, name="tpu-0"):
+        return {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name, "uid": "u1"}}
+
+    def test_creates_event_in_operator_ns_for_cluster_scoped(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        c = FakeClient()
+        rec = EventRecorder(c, namespace="tpu-operator")
+        rec.event(self._node(), "Normal", "TestReason", "hello")
+        [evt] = c.list("v1", "Event")
+        assert evt["metadata"]["namespace"] == "tpu-operator"
+        assert evt["involvedObject"]["kind"] == "Node"
+        assert evt["involvedObject"]["name"] == "tpu-0"
+        assert evt["reason"] == "TestReason" and evt["count"] == 1
+        assert evt["source"]["component"] == "tpu-operator"
+
+    def test_repeat_bumps_count_not_objects(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        c = FakeClient()
+        rec = EventRecorder(c)
+        for _ in range(3):
+            rec.event(self._node(), "Warning", "DrainBlocked", "pdb")
+        [evt] = c.list("v1", "Event")
+        assert evt["count"] == 3
+
+    def test_distinct_messages_get_distinct_events(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        c = FakeClient()
+        rec = EventRecorder(c)
+        rec.event(self._node(), "Normal", "R", "m1")
+        rec.event(self._node(), "Normal", "R", "m2")
+        assert len(c.list("v1", "Event")) == 2
+
+    def test_recording_failure_never_raises(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        class BrokenClient(FakeClient):
+            def create(self, obj):
+                raise RuntimeError("apiserver down")
+
+            def get_or_none(self, *a, **k):
+                raise RuntimeError("apiserver down")
+
+        rec = EventRecorder(BrokenClient())
+        rec.event(self._node(), "Normal", "R", "m")  # must not raise
+
+    def test_namespaced_object_events_in_its_namespace(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        c = FakeClient()
+        rec = EventRecorder(c, namespace="tpu-operator")
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p1", "namespace": "workloads"}}
+        rec.event(pod, "Normal", "R", "m")
+        [evt] = c.list("v1", "Event")
+        assert evt["metadata"]["namespace"] == "workloads"

@@ -690,3 +690,37 @@ class TestPerNodeUpgradeOptOut:
         rec = UpgradeReconciler(client=c, namespace="tpu-operator")
         rec.reconcile(Request(name="tpu-cluster-policy"))
         assert L.UPGRADE_STATE not in labels_of(c.get("v1", "Node", "tpu-0"))
+
+
+class TestUpgradeEvents:
+    """Node Events at every FSM transition (the reference upgrade lib's
+    recorder calls, drain_manager.go:105-129): kubectl describe node
+    shows the rollout's footprint."""
+
+    def test_full_walk_emits_start_and_complete(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        c.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DONE
+        reasons = {(e["involvedObject"]["name"], e["reason"], e["type"])
+                   for e in c.list("v1", "Event")}
+        assert ("tpu-0", "DriverUpgradeStarted", "Normal") in reasons
+        assert ("tpu-0", "DriverUpgradeComplete", "Normal") in reasons
+
+    def test_validation_timeout_emits_failure_warning(self):
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        clock[0] += 10_000  # blow through the validation deadline
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        events = [e for e in c.list("v1", "Event")
+                  if e["reason"] == "DriverUpgradeFailed"]
+        assert events, "no DriverUpgradeFailed event"
+        assert events[0]["type"] == "Warning"
+        assert "timed out" in events[0]["message"]

@@ -48,12 +48,17 @@ class ClusterPolicyReconciler(Reconciler):
     name = "tpuclusterpolicy"
 
     def __init__(self, client, namespace: Optional[str] = None,
-                 state_manager: Optional[StateManager] = None):
+                 state_manager: Optional[StateManager] = None,
+                 recorder=None):
+        from ..runtime.events import EventRecorder
+
         self.client = client
         self.namespace = namespace or os.environ.get(
             "OPERATOR_NAMESPACE", "tpu-operator")
         self.state_manager = state_manager or StateManager(
             client=client, namespace=self.namespace)
+        self.recorder = recorder or EventRecorder(client,
+                                                  namespace=self.namespace)
 
     # -- wiring (SetupWithManager analog, clusterpolicy_controller.go:355) --
 
@@ -197,5 +202,14 @@ class ClusterPolicyReconciler(Reconciler):
             return False
 
     def _set_state(self, cr: dict, state: str) -> None:
+        prev = get_nested(cr, "status", "state", default=None)
+        if prev != state:
+            # transition-only: a 5s not-ready requeue must not flood
+            # Events (the recorder would dedup-count, but even counting
+            # is noise for a non-transition)
+            self.recorder.event(
+                cr, "Normal" if state == STATE_READY else "Warning",
+                "StateChanged",
+                f"TPUClusterPolicy state: {prev or 'new'} -> {state}")
         set_nested(cr, state, "status", "state")
         set_nested(cr, self.namespace, "status", "namespace")

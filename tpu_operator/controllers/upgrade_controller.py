@@ -130,10 +130,17 @@ class UpgradeReconciler(Reconciler):
     name = "tpu-upgrade"
 
     def __init__(self, client, namespace: str = "tpu-operator",
-                 now=time.time):
+                 now=time.time, recorder=None):
+        from ..runtime.events import EventRecorder
+
         self.client = client
         self.namespace = namespace
         self.now = now  # injectable clock for deadline tests
+        # node Events on every FSM transition (the reference's upgrade
+        # lib does the same, drain_manager.go:105-129): kubectl describe
+        # node is where operators look first when a node misbehaves
+        self.recorder = recorder or EventRecorder(client,
+                                                  namespace=namespace)
 
     def setup_controller(self, controller: Controller, manager: Manager):
         controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed,
@@ -345,6 +352,8 @@ class UpgradeReconciler(Reconciler):
             self._annotate(m.node, **{L.UPGRADE_FAILED_AT: stamp,
                                       L.UPGRADE_FAILED_REASON: reason,
                                       L.UPGRADE_STAGE_STARTED: None})
+            self.recorder.event(m.node, "Warning", "DriverUpgradeFailed",
+                                reason)
         self._set_unit_state(members, STATE_FAILED)
         OPERATOR_METRICS.driver_upgrades_failed.inc()
 
@@ -510,6 +519,9 @@ class UpgradeReconciler(Reconciler):
             if state == STATE_CORDON:
                 for m in members:
                     self._cordon(m.node, True)
+                    self.recorder.event(
+                        m.node, "Normal", "DriverUpgradeStarted",
+                        "Node cordoned; scheduling drain of the node")
                 self._stamp_stage(members)
                 state = STATE_DRAIN
                 self._set_unit_state(members, state)
@@ -557,6 +569,11 @@ class UpgradeReconciler(Reconciler):
                                 "drain deadline passed on unit [%s]; "
                                 "force-deleted remaining TPU pods",
                                 ",".join(m.name for m in members))
+                            for m in members:
+                                self.recorder.event(
+                                    m.node, "Warning", "DrainForced",
+                                    f"Drain deadline ({drain_timeout}s) "
+                                    f"passed; remaining TPU pods deleted")
                             state = STATE_POD_RESTART
                             self._set_unit_state(members, state)
                         else:
@@ -630,6 +647,9 @@ class UpgradeReconciler(Reconciler):
                     self._annotate(m.node,
                                    **{L.UPGRADE_STAGE_STARTED: None})
                     self._set_node_state(m.node, STATE_DONE)
+                    self.recorder.event(
+                        m.node, "Normal", "DriverUpgradeComplete",
+                        "New libtpu revision validated; node uncordoned")
                     OPERATOR_METRICS.driver_upgrades_done.inc()
                 log.info("upgrade unit [%s] complete",
                          ",".join(m.name for m in members))

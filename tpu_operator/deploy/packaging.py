@@ -44,7 +44,7 @@ def cluster_role() -> dict:
             {"apiGroups": [""],
              "resources": ["pods", "pods/eviction", "services",
                            "serviceaccounts", "configmaps", "namespaces",
-                           "endpoints"],
+                           "endpoints", "events"],
              "verbs": ["get", "list", "watch", "create", "update", "patch",
                        "delete"]},
             {"apiGroups": ["apps"],

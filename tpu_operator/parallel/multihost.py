@@ -110,6 +110,19 @@ def slice_id_of(device) -> int:
     return int(getattr(device, "slice_index", 0) or 0)
 
 
+def fake_slice_getter(devices: Sequence[jax.Device], n_slices: int,
+                      ) -> Callable:
+    """Split ``devices`` into ``n_slices`` equal index-contiguous groups —
+    the slice_getter fake/test clusters (CPU devices carry no
+    slice_index) inject into hybrid/training meshes and the DCN probe."""
+    per = len(devices) // n_slices
+    if per < 1:
+        raise ValueError(f"{n_slices} slices exceed the "
+                         f"{len(devices)} visible devices")
+    index = {id(d): i for i, d in enumerate(devices)}
+    return lambda d: index[id(d)] // per
+
+
 def group_by_slice(devices: Sequence[jax.Device],
                    slice_getter: Callable = slice_id_of,
                    ) -> List[List[jax.Device]]:

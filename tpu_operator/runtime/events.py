@@ -1,0 +1,87 @@
+"""Kubernetes Event recording (the controller-runtime EventRecorder
+slot, cmd/gpu-operator/main.go:145; the reference's upgrade library emits
+node Events at every drain/upgrade-state transition, vendored
+pkg/upgrade/drain_manager.go:105-129).
+
+`kubectl describe node/cr` visibility for operator decisions: Events are
+the one surface cluster users actually look at when a node misbehaves.
+Best-effort by design — an apiserver hiccup while recording must never
+fail the reconcile that triggered it."""
+
+from __future__ import annotations
+
+import datetime
+import logging
+from typing import Optional
+
+from .client import Client
+from .objects import name_of, namespace_of
+
+log = logging.getLogger("tpu_operator.events")
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+class EventRecorder:
+    """Create-or-count Event objects like client-go's correlator: a
+    repeat of the same (object, reason, message) bumps ``count`` and
+    ``lastTimestamp`` instead of flooding new objects."""
+
+    def __init__(self, client: Client, component: str = "tpu-operator",
+                 namespace: str = "tpu-operator"):
+        self.client = client
+        self.component = component
+        self.namespace = namespace
+
+    def _event_name(self, involved: dict, reason: str, message: str) -> str:
+        import hashlib
+
+        key = (f"{involved.get('kind')}/{involved.get('name')}"
+               f"/{reason}/{message}")
+        digest = hashlib.sha256(key.encode()).hexdigest()[:12]
+        return f"{involved.get('name') or 'obj'}.{digest}"
+
+    def event(self, obj: dict, type_: str, reason: str,
+              message: str) -> None:
+        """Record one event against ``obj`` (best-effort)."""
+        try:
+            involved = {
+                "kind": obj.get("kind", ""),
+                "name": name_of(obj),
+                "namespace": namespace_of(obj),
+                "apiVersion": obj.get("apiVersion", ""),
+                "uid": (obj.get("metadata") or {}).get("uid", ""),
+            }
+            # Events live in a namespace: the involved object's, else the
+            # operator's (cluster-scoped objects like Nodes)
+            ns = involved["namespace"] or self.namespace
+            name = self._event_name(involved, reason, message)
+            existing = self.client.get_or_none("v1", "Event", name, ns)
+            now = _now()
+            if existing is not None:
+                existing["count"] = int(existing.get("count", 1)) + 1
+                existing["lastTimestamp"] = now
+                self.client.update(existing)
+                return
+            self.client.create({
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": ns},
+                "involvedObject": involved,
+                "reason": reason,
+                "message": message,
+                "type": type_,
+                "count": 1,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "source": {"component": self.component},
+            })
+        except Exception as e:  # never fail the reconcile for an event
+            log.debug("event %s/%s not recorded: %s", reason,
+                      name_of(obj), e)

@@ -369,13 +369,9 @@ def _maybe_dcn_bandwidth_probe(info: Dict[str, str]) -> None:
 
             devs = jax.devices()
             per = len(devs) // fake_n
-            if per < 1:
-                raise ValueError(
-                    f"DCN_PROBE_FAKE_SLICES={fake_n} exceeds the "
-                    f"{len(devs)} visible devices")
-            index = {id(d): i for i, d in enumerate(devs)}
-            kwargs = {"devices": devs[:per * fake_n],
-                      "slice_getter": lambda d: index[id(d)] // per}
+            kwargs = {"devices": devs[:max(per, 1) * fake_n],
+                      "slice_getter": multihost.fake_slice_getter(
+                          devs, fake_n)}
         res = multihost.dcn_allreduce_probe(
             size_mb=float(os.environ.get("DCN_PROBE_SIZE_MB", "64")),
             **kwargs)
