@@ -169,3 +169,18 @@ class TestMustGather:
         assert summary["upgrade_nodes"] == 1
         assert summary["kinds"]["PodDisruptionBudget"] == 1
         assert list((out / "upgrade").glob("poddisruptionbudget_*.yaml"))
+
+    def test_events_collected_in_bundle(self, tmp_path):
+        from tpu_operator.cli.must_gather import gather
+        from tpu_operator.runtime import FakeClient
+        from tpu_operator.runtime.events import EventRecorder
+
+        c = FakeClient()
+        c.add_node("h0", labels={})
+        EventRecorder(c).event(c.get("v1", "Node", "h0"), "Warning",
+                               "DriverUpgradeFailed", "drain timed out")
+        out = tmp_path / "bundle"
+        summary = gather(c, out)
+        assert summary["kinds"]["Event"] == 1
+        [evt_file] = list((out / "events").glob("event_*.yaml"))
+        assert "DriverUpgradeFailed" in evt_file.read_text()

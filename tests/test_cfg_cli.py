@@ -336,3 +336,128 @@ class TestGenerate:
 
         errs, _ = validate_cr(sample_cluster_policy())
         assert errs == []
+
+
+class TestDiff:
+    """Live-vs-rendered drift detection (kubectl-diff/helm-diff slot):
+    missing, match, and drift verdicts over the real install stream."""
+
+    @staticmethod
+    def _apply(client, docs):
+        for d in docs:
+            client.create(d)
+
+    @staticmethod
+    def _docs():
+        from tpu_operator.deploy.values import default_values, render_bundle
+
+        return render_bundle(default_values(), include_crds=False)
+
+    def test_fresh_cluster_everything_missing(self):
+        from tpu_operator.deploy.diff import diff_bundle, render_report
+        from tpu_operator.runtime import FakeClient
+
+        results = diff_bundle(FakeClient(), self._docs())
+        assert all(r["verdict"] == "missing" for r in results)
+        report, clean = render_report(results)
+        assert not clean and "MISSING" in report
+
+    def test_applied_cluster_matches(self):
+        from tpu_operator.deploy.diff import diff_bundle, render_report
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        self._apply(c, self._docs())
+        results = diff_bundle(c, self._docs())
+        assert all(r["verdict"] == "match" for r in results), results
+        _, clean = render_report(results)
+        assert clean
+
+    def test_server_defaulted_fields_are_not_drift(self):
+        from tpu_operator.deploy.diff import diff_bundle
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        self._apply(c, self._docs())
+        # the apiserver stamps rv/uid; an admission hook defaults a field
+        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep["spec"]["revisionHistoryLimit"] = 10  # defaulted, not in docs
+        c.update(dep)
+        results = diff_bundle(c, self._docs())
+        assert all(r["verdict"] == "match" for r in results)
+
+    def test_mutated_field_reports_drift_with_diff(self):
+        from tpu_operator.deploy.diff import diff_bundle, render_report
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        self._apply(c, self._docs())
+        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep["spec"]["replicas"] = 5  # someone kubectl-edited the operator
+        c.update(dep)
+        results = diff_bundle(c, self._docs())
+        drifted = [r for r in results if r["verdict"] == "drift"]
+        assert [r["name"] for r in drifted] == ["tpu-operator"]
+        assert "replicas" in drifted[0]["diff"]
+        report, clean = render_report(results)
+        assert not clean and "DRIFT   Deployment" in report
+
+    def test_cli_diff_against_live_http_apiserver(self, monkeypatch,
+                                                  capsys):
+        from mock_apiserver import MockApiServer
+
+        import tpu_operator.runtime.kubeclient as kc
+        from tpu_operator.cli.tpuop_cfg import main
+
+        srv = MockApiServer().start()
+        try:
+            cfg = kc.KubeConfig(server=srv.url, token="t",
+                                namespace="tpu-operator")
+            monkeypatch.setattr(kc.KubeConfig, "load",
+                                classmethod(lambda cls: cfg))
+            # nothing applied yet -> rc 1, everything missing
+            assert main(["diff", "operator"]) == 1
+            out = capsys.readouterr().out
+            assert "MISSING" in out and "missing" in out.splitlines()[-1]
+            # apply the SAME stream the CLI renders, then diff is clean
+            from tpu_operator.deploy.packaging import generate
+
+            client = kc.HTTPClient(cfg)
+            for d in generate("operator"):
+                client.create(d)
+            assert main(["diff", "operator"]) == 0
+            assert "0 missing, 0 drifted" in capsys.readouterr().out
+        finally:
+            srv.stop()
+
+
+    def test_defaulted_list_item_fields_are_not_drift(self):
+        """Real apiservers default container-level fields
+        (terminationMessagePath, ports[].protocol); projection must
+        reach inside list items."""
+        from tpu_operator.deploy.diff import diff_bundle
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        self._apply(c, self._docs())
+        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        ctr = dep["spec"]["template"]["spec"]["containers"][0]
+        ctr["terminationMessagePath"] = "/dev/termination-log"
+        ctr["ports"][0]["protocol"] = "TCP"
+        c.update(dep)
+        results = diff_bundle(c, self._docs())
+        assert all(r["verdict"] == "match" for r in results), [
+            r for r in results if r["verdict"] != "match"]
+
+    def test_diff_output_free_of_yaml_anchors(self):
+        from tpu_operator.deploy.diff import diff_bundle
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        self._apply(c, self._docs())
+        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep["spec"]["replicas"] = 9
+        c.update(dep)
+        [drift] = [r for r in diff_bundle(c, self._docs())
+                   if r["verdict"] == "drift"]
+        assert "&id" not in drift["diff"] and "*id" not in drift["diff"]

@@ -10,8 +10,8 @@ from __future__ import annotations
 import datetime
 from typing import Optional
 
-from ..runtime.client import Client
-from ..runtime.objects import get_nested, set_nested
+from ..runtime.client import Client, ConflictError
+from ..runtime.objects import get_nested, name_of, namespace_of, set_nested
 
 COND_READY = "Ready"
 COND_ERROR = "Error"
@@ -52,24 +52,48 @@ def set_condition(cr: dict, type_: str, status: str, reason: str,
     return True
 
 
+def update_status_with_retry(client: Client, cr: dict,
+                              attempts: int = 3) -> None:
+    """Status write with retry-on-conflict (client-go
+    retry.RetryOnConflict semantics): the CR's spec/metadata move under
+    the reconciler constantly (users edit the spec, the upgrade
+    controller annotates), and a 409 here otherwise costs the whole
+    reconcile a backoff requeue — on a busy cluster that starves
+    convergence. Status is reconciler-owned, so re-getting the object
+    and re-applying OUR status over the fresh resourceVersion is safe
+    last-writer-wins on fields nobody else writes."""
+    for attempt in range(attempts):
+        try:
+            client.update_status(cr)
+            return
+        except ConflictError:
+            if attempt == attempts - 1:
+                raise
+            fresh = client.get(cr.get("apiVersion", ""),
+                               cr.get("kind", ""), name_of(cr),
+                               namespace_of(cr) or None)
+            fresh["status"] = cr.get("status") or {}
+            cr = fresh
+
+
 def set_ready(client: Client, cr: dict, message: str = "") -> None:
     """Ready=True, Error=False (conditions.Updater.SetConditionsReady)."""
     set_condition(cr, COND_READY, "True", REASON_RECONCILED, message)
     set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
-    client.update_status(cr)
+    update_status_with_retry(client, cr)
 
 
 def set_not_ready(client: Client, cr: dict, reason: str, message: str) -> None:
     set_condition(cr, COND_READY, "False", reason, message)
     set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
-    client.update_status(cr)
+    update_status_with_retry(client, cr)
 
 
 def set_error(client: Client, cr: dict, reason: str, message: str) -> None:
     """Ready=False, Error=True (SetConditionsError)."""
     set_condition(cr, COND_READY, "False", reason, message)
     set_condition(cr, COND_ERROR, "True", reason, message)
-    client.update_status(cr)
+    update_status_with_retry(client, cr)
 
 
 def get_condition(cr: dict, type_: str) -> Optional[dict]:

@@ -32,6 +32,9 @@ DUMP_KINDS = [
     # drains block on these; a stuck upgrade is unreadable without them
     ("policy/v1", "PodDisruptionBudget", "upgrade"),
     ("coordination.k8s.io/v1", "Lease", "leader"),
+    # the operator's decision trail (upgrade transitions, CR state
+    # changes) — the first thing support reads in a bundle
+    ("v1", "Event", "events"),
 ]
 
 

@@ -88,7 +88,36 @@ def main(argv=None) -> int:
                         "(Helm-values slot); implies schema validation of "
                         "the rendered ClusterPolicy")
 
+    d = sub.add_parser(
+        "diff", help="compare the rendered install stream against the "
+                     "live cluster (kubectl-diff/helm-diff slot); exit 1 "
+                     "on drift or missing objects")
+    d.add_argument("what", nargs="?", default="all",
+                   choices=["crds", "operator", "all"])
+    d.add_argument("-n", "--namespace", default=None)
+    d.add_argument("--image", default="")
+    d.add_argument("--values", default="")
+
     args = p.parse_args(argv)
+
+    if args.cmd == "diff":
+        docs = _generate_docs(args)
+        if docs is None:
+            return 1
+        from ..deploy.diff import diff_bundle, render_report
+        from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+        try:
+            # request-time failures (apiserver down, RBAC denies a GET)
+            # must be a clean message + rc 1, not a traceback
+            client = HTTPClient(KubeConfig.load())
+            results = diff_bundle(client, docs)
+        except Exception as e:
+            print(f"cannot diff against the cluster: {e}", file=sys.stderr)
+            return 1
+        report, clean = render_report(results)
+        print(report)
+        return 0 if clean else 1
 
     if args.cmd == "generate":
         docs = _generate_docs(args)

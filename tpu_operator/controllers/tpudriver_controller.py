@@ -83,7 +83,7 @@ class TPUDriverReconciler(Reconciler):
             conditions.set_error(self.client, cr, "MissingClusterPolicy",
                                  "no TPUClusterPolicy found; create one first")
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            self.client.update_status(cr)
+            conditions.update_status_with_retry(self.client, cr)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
         policy_spec = TPUClusterPolicySpec.from_obj(policies[0])
 
@@ -92,7 +92,7 @@ class TPUDriverReconciler(Reconciler):
         except ValidationError as e:
             conditions.set_error(self.client, cr, "Conflict", str(e))
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            self.client.update_status(cr)
+            conditions.update_status_with_retry(self.client, cr)
             return Result()  # user must fix the CR; no requeue loop
 
         spec = TPUDriverSpec.from_obj(cr)
@@ -131,13 +131,13 @@ class TPUDriverReconciler(Reconciler):
             conditions.set_not_ready(self.client, cr, "NoMatchingNodes",
                                      "nodeSelector matches no TPU nodes")
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            self.client.update_status(cr)
+            conditions.update_status_with_retry(self.client, cr)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         ok, msg = objects_ready(self.client, applied)
         if not ok:
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            self.client.update_status(cr)
+            conditions.update_status_with_retry(self.client, cr)
             conditions.set_not_ready(
                 self.client,
                 self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),
@@ -145,7 +145,7 @@ class TPUDriverReconciler(Reconciler):
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         set_nested(cr, STATE_READY, "status", "state")
-        self.client.update_status(cr)
+        conditions.update_status_with_retry(self.client, cr)
         conditions.set_ready(
             self.client,
             self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),

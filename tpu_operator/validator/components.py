@@ -369,7 +369,11 @@ def _maybe_dcn_bandwidth_probe(info: Dict[str, str]) -> None:
 
             devs = jax.devices()
             per = len(devs) // fake_n
-            kwargs = {"devices": devs[:max(per, 1) * fake_n],
+            if per < 1:
+                raise ValueError(
+                    f"DCN_PROBE_FAKE_SLICES={fake_n} exceeds the "
+                    f"{len(devs)} visible devices")
+            kwargs = {"devices": devs[:per * fake_n],
                       "slice_getter": multihost.fake_slice_getter(
                           devs, fake_n)}
         res = multihost.dcn_allreduce_probe(
