@@ -46,6 +46,7 @@ class _State:
         self.watch_batches = queue.Queue()  # each item: list of event dicts
         self.watch_connections = 0
         self.rv = 100
+        self.fail_next_writes = 0   # inject N 409s on PUT (conflict tests)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -201,6 +202,10 @@ class _Handler(BaseHTTPRequestHandler):
         current = self.state.objects.get(target)
         if current is None:
             return self._not_found()
+        if self.state.fail_next_writes > 0:
+            self.state.fail_next_writes -= 1
+            return self._send(409, {"kind": "Status", "status": "Failure",
+                                    "reason": "Conflict", "code": 409})
         sent_rv = (body.get("metadata") or {}).get("resourceVersion")
         have_rv = (current.get("metadata") or {}).get("resourceVersion")
         if sent_rv and have_rv and sent_rv != have_rv:
@@ -659,3 +664,53 @@ class TestTokenRotation:
         assert cfg.token_file == str(sa / "token")
         client = kc.HTTPClient(cfg)
         assert isinstance(client.session.auth, kc._FileTokenAuth)
+
+
+class TestStatusConflictRetry:
+    """update_status_with_retry against a live apiserver injecting 409s
+    (client-go RetryOnConflict semantics): the write must survive
+    injected conflicts by re-getting and re-applying the status, and
+    give up only when conflicts outlast the attempts."""
+
+    def _policy(self, client):
+        from tpu_operator.api import new_cluster_policy
+
+        return client.create(new_cluster_policy())
+
+    def test_retry_survives_injected_conflicts(self, apiserver, client):
+        from tpu_operator.api import conditions
+
+        cr = self._policy(client)
+        cr.setdefault("status", {})["state"] = "ready"
+        apiserver.fail_next_writes = 2
+        conditions.update_status_with_retry(client, cr, attempts=3)
+        assert apiserver.fail_next_writes == 0  # the 409s were consumed
+        got = client.get("tpu.graft.dev/v1", "TPUClusterPolicy",
+                         "tpu-cluster-policy")
+        assert got["status"]["state"] == "ready"
+
+    def test_retry_preserves_status_payload_across_regets(self, apiserver,
+                                                          client):
+        from tpu_operator.api import conditions
+
+        cr = self._policy(client)
+        conditions.set_condition(cr, "Ready", "True", "Reconciled", "all ok")
+        apiserver.fail_next_writes = 1
+        conditions.update_status_with_retry(client, cr, attempts=3)
+        got = client.get("tpu.graft.dev/v1", "TPUClusterPolicy",
+                         "tpu-cluster-policy")
+        [cond] = [c for c in got["status"]["conditions"]
+                  if c["type"] == "Ready"]
+        assert cond["message"] == "all ok"
+
+    def test_exhausted_attempts_reraise(self, apiserver, client):
+        import pytest as _pytest
+
+        from tpu_operator.api import conditions
+        from tpu_operator.runtime.client import ConflictError
+
+        cr = self._policy(client)
+        cr.setdefault("status", {})["state"] = "ready"
+        apiserver.fail_next_writes = 10
+        with _pytest.raises(ConflictError):
+            conditions.update_status_with_retry(client, cr, attempts=3)

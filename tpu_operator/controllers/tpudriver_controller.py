@@ -80,19 +80,20 @@ class TPUDriverReconciler(Reconciler):
         # (nvidiadriver_controller.go:80-125)
         policies = self.client.list(V1, KIND_CLUSTER_POLICY)
         if not policies:
+            # state first, conditions second: set_* writes status once —
+            # a trailing second write would 409 by construction (the
+            # server bumped rv on the first)
+            set_nested(cr, STATE_NOT_READY, "status", "state")
             conditions.set_error(self.client, cr, "MissingClusterPolicy",
                                  "no TPUClusterPolicy found; create one first")
-            set_nested(cr, STATE_NOT_READY, "status", "state")
-            conditions.update_status_with_retry(self.client, cr)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
         policy_spec = TPUClusterPolicySpec.from_obj(policies[0])
 
         try:
             validate_node_selectors(self.client, cr)
         except ValidationError as e:
-            conditions.set_error(self.client, cr, "Conflict", str(e))
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            conditions.update_status_with_retry(self.client, cr)
+            conditions.set_error(self.client, cr, "Conflict", str(e))
             return Result()  # user must fix the CR; no requeue loop
 
         spec = TPUDriverSpec.from_obj(cr)
@@ -128,27 +129,22 @@ class TPUDriverReconciler(Reconciler):
             sweep_kinds=template_kinds(
                 str(self.manifests_root / "state-libtpu-driver")))
         if not pools:
+            set_nested(cr, STATE_NOT_READY, "status", "state")
             conditions.set_not_ready(self.client, cr, "NoMatchingNodes",
                                      "nodeSelector matches no TPU nodes")
-            set_nested(cr, STATE_NOT_READY, "status", "state")
-            conditions.update_status_with_retry(self.client, cr)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         ok, msg = objects_ready(self.client, applied)
         if not ok:
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            conditions.update_status_with_retry(self.client, cr)
             conditions.set_not_ready(
-                self.client,
-                self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),
+                self.client, cr,
                 conditions.REASON_OPERANDS_NOT_READY, msg)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         set_nested(cr, STATE_READY, "status", "state")
-        conditions.update_status_with_retry(self.client, cr)
         conditions.set_ready(
-            self.client,
-            self.client.get(V1ALPHA1, KIND_TPU_DRIVER, request.name),
+            self.client, cr,
             f"libtpu ready on {len(pools)} pool(s): "
             + ", ".join(p.name for p in pools))
         log.info("TPUDriver %s ready across pools %s", request.name,

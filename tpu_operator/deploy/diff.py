@@ -54,9 +54,12 @@ def _project(live, desired):
     if isinstance(desired, dict) and isinstance(live, dict):
         return {k: _project(live[k], v)
                 for k, v in desired.items() if k in live}
-    if isinstance(desired, list) and isinstance(live, list) \
-            and len(desired) == len(live):
-        return [_project(lv, dv) for lv, dv in zip(live, desired)]
+    if isinstance(desired, list) and isinstance(live, list):
+        # project the common prefix even when lengths differ (an added
+        # sidecar must not pollute the diff with the ORIGINAL items'
+        # server defaults); extra live items stay whole
+        return [_project(lv, dv) for lv, dv in zip(live, desired)] \
+            + live[len(desired):]
     return live
 
 
