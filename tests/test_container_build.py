@@ -133,3 +133,15 @@ def test_entrypoints_in_dockerfile_are_declared_scripts():
     for ep in re.findall(r'^ENTRYPOINT \["([^"]+)"\]',
                          DOCKERFILE.read_text(), re.M):
         assert ep in scripts, f"ENTRYPOINT {ep!r} is not a console script"
+
+
+def test_buildx_multiarch_target_present():
+    """multi-arch.mk slot: a buildx target with a multi-platform list
+    must exist for every image (buildx-% pattern + PLATFORMS default)."""
+    text = MAKEFILE.read_text()
+    assert "buildx-%:" in text
+    assert "buildx-all:" in text
+    m = re.search(r"^PLATFORMS \?= (.+)$", text, re.M)
+    assert m, "PLATFORMS default missing"
+    platforms = m.group(1).split(",")
+    assert "linux/amd64" in platforms and "linux/arm64" in platforms

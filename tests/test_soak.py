@@ -150,12 +150,18 @@ def test_chaos_soak_converges_after_every_disruption():
         return "node removed", ready
 
     def drop_watches():
+        # pair the disruption with a mutation the operator must still
+        # apply: "ready" alone is already true when the streams drop, so
+        # it would never prove the clients resumed
         srv.drop_watch_streams()
-        return "all watch streams dropped", ready
+        desc, pred = mutate_policy()
+        return f"watch streams dropped + {desc}", pred
 
     def inject_conflicts():
-        srv.fail_next_writes = rng.randrange(1, 4)
-        return f"{srv.fail_next_writes} write conflicts injected", ready
+        n = rng.randrange(1, 4)
+        srv.fail_next_writes = n
+        desc, pred = mutate_policy()
+        return f"{n} write conflicts injected + {desc}", pred
 
     moves = [mutate_policy, delete_operand, add_node, remove_node,
              drop_watches, inject_conflicts]
