@@ -44,6 +44,25 @@ fi
 grep -q "INVALID values" "$WORK/err"
 stage values-pipeline
 
+# -- lifecycle hooks: upgrade-CRD rides the stream, cleanup is explicit ---
+cat > "$WORK/hook-values.yaml" <<'EOF'
+operator:
+  upgradeCRD: true
+  cleanupCRD: true
+EOF
+$PY -m tpu_operator.cli.tpuop_cfg generate all \
+    --values "$WORK/hook-values.yaml" > "$WORK/bundle-hooks.yaml" \
+    2> "$WORK/hooks.err"
+grep -q "tpu-operator-upgrade-crd" "$WORK/bundle-hooks.yaml"
+# the DESTRUCTIVE cleanup Job must NOT be in the install stream
+if grep -q "tpu-operator-cleanup-crd" "$WORK/bundle-hooks.yaml"; then
+  echo "FAIL: cleanup Job leaked into the install stream"; exit 1
+fi
+grep -q "generate cleanup" "$WORK/hooks.err"   # the reminder note
+$PY -m tpu_operator.cli.tpuop_cfg generate cleanup > "$WORK/cleanup.yaml"
+grep -q "tpu-operator-cleanup-crd" "$WORK/cleanup.yaml"
+stage lifecycle-hooks
+
 # -- offline CR validation (gpuop-cfg slot) -------------------------------
 $PY - > "$WORK/policy.yaml" <<'EOF'
 import yaml

@@ -158,10 +158,13 @@ def test_chaos_soak_converges_after_every_disruption():
         return f"watch streams dropped + {desc}", pred
 
     def inject_conflicts():
+        # mutate FIRST, then arm the conflicts: armed first, the
+        # adversary's own update retry loop would consume the 409s and
+        # the operator would never see one
+        desc, pred = mutate_policy()
         n = rng.randrange(1, 4)
         srv.fail_next_writes = n
-        desc, pred = mutate_policy()
-        return f"{n} write conflicts injected + {desc}", pred
+        return f"{desc} + {n} write conflicts injected", pred
 
     moves = [mutate_policy, delete_operand, add_node, remove_node,
              drop_watches, inject_conflicts]

@@ -51,11 +51,21 @@ def _client_and_identity():
     return HTTPClient(KubeConfig.load()), node, ns, image
 
 
+# components whose proofs initialize a JAX backend; the JAX_PLATFORMS
+# pin (and its jax import cost) applies only to these — `wait`/`cleanup`
+# and the devfs-only proofs must stay jax-free
+_JAX_COMPONENTS = {"jax", "ici", "hbm", "dcn", "plugin", "metrics"}
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname).1s %(name)s %(message)s")
     log = logging.getLogger("tpu_validator")
+    if getattr(args, "component", None) in _JAX_COMPONENTS:
+        from ..workloads.backend import honor_jax_platforms_env
+
+        honor_jax_platforms_env()
 
     from ..validator import barrier, components
 

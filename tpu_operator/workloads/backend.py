@@ -120,6 +120,23 @@ def _clear_backend_cache() -> None:
             pass
 
 
+def honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS authoritative even under out-of-tree PJRT
+    plugins that override it at import time: jax.config wins over a
+    plugin, so a caller exporting JAX_PLATFORMS=cpu (tests, the shell
+    e2e, fake clusters) must never end up blocked on an unreachable
+    remote backend. No-op when the env var is unset."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # no jax / unknown platform: the caller will surface it
+
+
 def init_devices(attempts: int = 3, backoff_s: float = 5.0,
                  platform: Optional[str] = None, log=None) -> "list":
     """jax.devices() with retry/backoff on backend-init failure.
