@@ -339,3 +339,30 @@ class TestPluginConfig:
         assert p.reload_plugin_config() is False
         p.refresh_devices()
         assert len(p._devices) == 2 and p.plugin_config is None
+
+
+class TestEnvContract:
+    def test_template_env_names_match_plugin_reads(self, monkeypatch,
+                                                   tmp_path):
+        """The DaemonSet template sets TPU_PLUGIN_CONFIG_DIR/DEFAULT;
+        the plugin constructed with NO args (the container entrypoint
+        path) must pick exactly those env names up."""
+        import pathlib
+
+        cfgdir = tmp_path / "configs"
+        cfgdir.mkdir()
+        (cfgdir / "gold").write_text(
+            "sharingPolicy: time-shared\nsharingReplicas: 3\n")
+        monkeypatch.setenv("TPU_FAKE_CHIPS", "2")
+        monkeypatch.setenv("TPU_PLUGIN_CONFIG_DIR", str(cfgdir))
+        monkeypatch.setenv("TPU_PLUGIN_CONFIG_DEFAULT", "gold")
+        monkeypatch.delenv("TPU_PLUGIN_CONFIG_SELECT", raising=False)
+        p = TPUDevicePlugin(socket_dir=str(tmp_path))
+        p.refresh_devices()
+        assert len(p._devices) == 6  # 2 chips x 3 replicas from env config
+        # and the template really sets those names (cross-check)
+        text = (pathlib.Path(__file__).resolve().parents[1] /
+                "manifests/state-tpu-device-plugin/0500_daemonset.yaml"
+                ).read_text()
+        assert "TPU_PLUGIN_CONFIG_DIR" in text
+        assert "TPU_PLUGIN_CONFIG_DEFAULT" in text

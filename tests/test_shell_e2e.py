@@ -21,6 +21,7 @@ def test_end_to_end_script():
     stages = [ln.split()[1] for ln in proc.stdout.splitlines()
               if ln.startswith("STAGE_OK")]
     assert stages == ["install-manifests", "values-pipeline",
-                      "validate-clusterpolicy", "verify-operator",
-                      "restart-operator", "validator-components",
-                      "workload-proof", "isolated-plane"]
+                      "lifecycle-hooks", "validate-clusterpolicy",
+                      "verify-operator", "restart-operator",
+                      "validator-components", "workload-proof",
+                      "isolated-plane"]

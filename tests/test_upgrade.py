@@ -724,3 +724,54 @@ class TestUpgradeEvents:
         assert events, "no DriverUpgradeFailed event"
         assert events[0]["type"] == "Warning"
         assert "timed out" in events[0]["message"]
+
+
+class TestTPUDriverCRUpgradePath:
+    """The rolling-upgrade FSM selects driver DaemonSets by the
+    component label, so per-pool DaemonSets rendered by the TPUDriver CR
+    (engine-B path) roll through the same cordon/drain/validate walk as
+    the ClusterPolicy-rendered one — prove it end to end."""
+
+    def test_tpudriver_rendered_ds_rolls_through_fsm(self):
+        from tpu_operator.api.tpudriver import V1ALPHA1, new_tpu_driver
+        from tpu_operator.controllers.tpudriver_controller import (
+            TPUDriverReconciler,
+        )
+
+        c = FakeClient()
+        c.add_node("tpu-0", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x1",
+            L.GKE_ACCELERATOR_COUNT: "4",
+            L.deploy_label("libtpu-driver"): "true"},
+            allocatable={"google.com/tpu": "4"})
+        c.create(new_cluster_policy(spec={
+            "libtpu": {"enabled": False},  # CRD mode: no policy-owned DS
+            "upgradePolicy": {"autoUpgrade": True,
+                              "maxParallelUpgrades": 1}}))
+        prec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        prec.reconcile(Request(name="tpu-cluster-policy"))
+        c.create(new_tpu_driver("pool-a"))
+        drec = TPUDriverReconciler(client=c, namespace="tpu-operator")
+        drec.reconcile(Request(name="pool-a"))
+        c.simulate_kubelet(ready=True)
+        drec.reconcile(Request(name="pool-a"))
+        cr = c.get(V1ALPHA1, "TPUDriver", "pool-a")
+        assert cr["status"]["state"] == "ready"
+
+        # change the driver flavor: OnDelete keeps the old pod running
+        cr["spec"] = {"installDir": "/opt/new-flavor"}
+        c.update(cr)
+        drec.reconcile(Request(name="pool-a"))
+        c.simulate_kubelet(ready=True)
+
+        urec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        urec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_VALIDATION
+        assert get_nested(node, "spec", "unschedulable") is True
+        c.simulate_kubelet(ready=True)  # kubelet recreates on new revision
+        urec.reconcile(Request(name="tpu-cluster-policy"))
+        node = c.get("v1", "Node", "tpu-0")
+        assert labels_of(node)[L.UPGRADE_STATE] == STATE_DONE
+        assert not get_nested(node, "spec", "unschedulable", default=False)

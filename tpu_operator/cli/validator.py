@@ -51,10 +51,13 @@ def _client_and_identity():
     return HTTPClient(KubeConfig.load()), node, ns, image
 
 
-# components whose proofs initialize a JAX backend; the JAX_PLATFORMS
-# pin (and its jax import cost) applies only to these — `wait`/`cleanup`
-# and the devfs-only proofs must stay jax-free
-_JAX_COMPONENTS = {"jax", "ici", "hbm", "dcn", "plugin", "metrics"}
+# components whose in-process proofs can initialize a JAX backend; the
+# JAX_PLATFORMS pin (and its jax import cost) applies only to these —
+# `wait`/`cleanup` and the apiserver-only paths (plugin spawns a pod,
+# metrics reads barrier files) stay jax-import-free. `driver` is here
+# because discover_chips() falls back to jax enumeration under
+# TPU_VALIDATOR_USE_JAX=true.
+_JAX_COMPONENTS = {"jax", "ici", "hbm", "dcn", "driver", "runtime"}
 
 
 def main(argv=None) -> int:
