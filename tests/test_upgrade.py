@@ -775,3 +775,67 @@ class TestTPUDriverCRUpgradePath:
         node = c.get("v1", "Node", "tpu-0")
         assert labels_of(node)[L.UPGRADE_STATE] == STATE_DONE
         assert not get_nested(node, "spec", "unschedulable", default=False)
+
+
+class TestIsolatedPlaneDrain:
+    def test_isolated_and_vtpu_pods_are_drained_too(self):
+        """gpuPodSpecFilter prefix semantics (main.go:198-207): pods
+        holding google.com/tpu-isolated or google.com/vtpu occupy chips
+        exactly like google.com/tpu ones — a libtpu swap must evict them
+        before the driver pod restarts."""
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        add_tpu_pod(c, "shared", "tpu-0")
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "isolated-wl", "namespace": "default"},
+                  "spec": {"nodeName": "tpu-0", "containers": [{
+                      "name": "c", "resources": {"requests": {
+                          "google.com/tpu-isolated": "1"}}}]},
+                  "status": {"phase": "Running"}})
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "vtpu-wl", "namespace": "default"},
+                  "spec": {"nodeName": "tpu-0", "containers": [{
+                      "name": "c", "resources": {"requests": {
+                          "google.com/vtpu": "1"}}}]},
+                  "status": {"phase": "Running"}})
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "cpu-only", "namespace": "default"},
+                  "spec": {"nodeName": "tpu-0", "containers": [{
+                      "name": "c", "resources": {"requests": {
+                          "cpu": "1"}}}]},
+                  "status": {"phase": "Running"}})
+        by_node = rec._tpu_workload_pods_by_node()
+        names = sorted(p["metadata"]["name"] for p in by_node["tpu-0"])
+        assert "isolated-wl" in names and "vtpu-wl" in names
+        assert "shared" in names and "cpu-only" not in names
+
+    def test_completed_pods_not_in_drain_set(self):
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "done-job", "namespace": "default"},
+                  "spec": {"nodeName": "tpu-0", "containers": [{
+                      "name": "c", "resources": {"requests": {
+                          "google.com/tpu": "4"}}}]},
+                  "status": {"phase": "Succeeded"}})
+        assert "tpu-0" not in rec._tpu_workload_pods_by_node()
+
+    def test_renamed_plugin_resources_still_drained(self):
+        """isolatedPlugin.resourceName / vtpuResourceName are CR knobs; a
+        renamed resource's pods must still land in the drain set."""
+        c, prec = build_converged_cluster(n_nodes=1)
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr["spec"]["isolatedDevicePlugin"] = {
+            "resourceName": "example.com/tpu-dedicated"}
+        c.update(cr)
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "renamed-wl", "namespace": "default"},
+                  "spec": {"nodeName": "tpu-0", "containers": [{
+                      "name": "c", "resources": {"requests": {
+                          "example.com/tpu-dedicated": "1"}}}]},
+                  "status": {"phase": "Running"}})
+        change_driver_spec(c, prec)
+        # drive one pass: the drain stage must evict the renamed consumer
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert c.get_or_none("v1", "Pod", "renamed-wl", "default") is None

@@ -57,7 +57,8 @@ def _client_and_identity():
 # metrics reads barrier files) stay jax-import-free. `driver` is here
 # because discover_chips() falls back to jax enumeration under
 # TPU_VALIDATOR_USE_JAX=true.
-_JAX_COMPONENTS = {"jax", "ici", "hbm", "dcn", "driver", "runtime"}
+_JAX_COMPONENTS = {"jax", "ici", "hbm", "dcn", "driver", "runtime",
+                   "fencing"}  # fencing names chips via discover_chips too
 
 
 def main(argv=None) -> int:

@@ -216,16 +216,27 @@ class UpgradeReconciler(Reconciler):
                    for c in get_nested(pod, "status", "conditions",
                                        default=[]) or [])
 
-    def _tpu_workload_pods_by_node(self) -> Dict[str, List[dict]]:
-        """node -> pods consuming google.com/tpu — the drain set (the
+    def _tpu_workload_pods_by_node(
+            self, resource_names: Optional[tuple] = None,
+    ) -> Dict[str, List[dict]]:
+        """node -> pods consuming TPU resources — the drain set (the
         reference drains with a GPU-pod selector, main.go:105-117). One
-        cluster-wide LIST per reconcile, not one per draining node."""
+        cluster-wide LIST per reconcile, not one per draining node.
+        ``resource_names`` carries the policy's configured plugin
+        resource names (shared/isolated/vTPU can all be renamed); the
+        defaults always apply too."""
+        names = tuple(resource_names or ()) + (L.TPU_RESOURCE,
+                                               L.VTPU_RESOURCE)
         out: Dict[str, List[dict]] = {}
         for pod in self.client.list("v1", "Pod"):
             node_name = get_nested(pod, "spec", "nodeName")
             if not node_name:
                 continue
             if get_nested(pod, "metadata", "deletionTimestamp"):
+                continue
+            # completed pods hold no chips (main.go:209 phase filter)
+            if get_nested(pod, "status", "phase",
+                          default="Running") in ("Succeeded", "Failed"):
                 continue
             if labels_of(pod).get(L.UPGRADE_SKIP_DRAIN) == "true":
                 continue
@@ -240,7 +251,12 @@ class UpgradeReconciler(Reconciler):
             for ctr in get_nested(pod, "spec", "containers", default=[]) or []:
                 requests.update(get_nested(ctr, "resources", "requests",
                                            default={}) or {})
-            if L.TPU_RESOURCE in requests:
+            # prefix match like the reference's gpuPodSpecFilter
+            # (nvidia.com/gpu* + nvidia.com/mig-*, main.go:198-207):
+            # isolated (google.com/tpu-isolated) and fractional
+            # (google.com/vtpu) consumers hold chips too and must leave
+            # before a libtpu swap
+            if any(str(r).startswith(n) for r in requests for n in names):
                 out.setdefault(node_name, []).append(pod)
         return out
 
@@ -457,7 +473,15 @@ class UpgradeReconciler(Reconciler):
         def drain_pods_on(node_name: str) -> List[dict]:
             nonlocal workload_pods
             if workload_pods is None:
-                workload_pods = self._tpu_workload_pods_by_node()
+                # the configured plugin resource names: renamed shared/
+                # isolated/vTPU resources must still land in the drain set
+                dp = spec.device_plugin
+                iso = spec.isolated_device_plugin
+                workload_pods = self._tpu_workload_pods_by_node(
+                    resource_names=tuple(n for n in (
+                        dp.resource_name if dp else None,
+                        iso.resource_name if iso else None,
+                        iso.vtpu_resource_name if iso else None) if n))
             return workload_pods.get(node_name, [])
 
         budget = max(1, policy.max_parallel_upgrades or 1)
