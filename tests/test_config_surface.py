@@ -391,3 +391,57 @@ def test_device_plugin_config_map_changes_render():
     env = {e["name"]: e.get("value") for e in ctr["env"]}
     assert env["TPU_PLUGIN_CONFIG_DEFAULT"] == "probe-key"
     assert any(m["name"] == "plugin-config" for m in ctr["volumeMounts"])
+
+
+def test_every_proof_has_a_cr_override_slot():
+    """Every validation initContainer in the chain must be overridable
+    from validator.<proof> (transformValidatorComponent slot) — a proof
+    without a slot can't be tuned or disabled per cluster."""
+    out = render_state("operator-validation", merged(
+        BASE_SPEC, "validator", {
+            "driver": {"env": [{"name": "P_DRIVER", "value": "1"}]},
+            "runtime": {"env": [{"name": "P_RUNTIME", "value": "1"}]},
+            "jax": {"env": [{"name": "P_JAX", "value": "1"}]},
+            "ici": {"env": [{"name": "P_ICI", "value": "1"}]},
+            "hbm": {"env": [{"name": "P_HBM", "value": "1"}]},
+            "dcn": {"env": [{"name": "P_DCN", "value": "1"}]},
+            "plugin": {"env": [{"name": "P_PLUGIN", "value": "1"}]},
+        }))
+    for marker in ("P_DRIVER", "P_RUNTIME", "P_JAX", "P_ICI", "P_HBM",
+                   "P_DCN", "P_PLUGIN"):
+        assert marker in out, f"{marker} not rendered"
+
+
+def test_hbm_proof_disable_knob():
+    out = render_state("operator-validation", merged(
+        BASE_SPEC, "validator", {"hbm": {"enabled": False}}))
+    assert "hbm-validation" not in out
+    assert "dcn-validation" in out  # the rest of the chain stays
+
+
+def test_aux_proof_disable_knobs_work():
+    out = render_state("operator-validation", merged(
+        BASE_SPEC, "validator", {"dcn": {"enabled": False},
+                                 "runtime": {"enabled": False}}))
+    assert "dcn-validation" not in out
+    assert "runtime-validation" not in out
+    assert "ici-validation" in out
+
+
+def test_core_proof_disable_rejected_at_validation():
+    """validator.driver/jax/ici/plugin.enabled=false would wedge every
+    node (their barrier files gate all operands) — the schema accepts the
+    field shape, so a semantic rule must reject it."""
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.api.validate import validate_cr
+
+    for proof in ("driver", "jax", "ici", "plugin"):
+        errs, _ = validate_cr(new_cluster_policy(spec={
+            "validator": {proof: {"enabled": False}}}))
+        assert any("core proofs cannot be disabled" in e for e in errs), \
+            f"{proof}: no semantic rejection"
+    # aux proofs stay disableable
+    errs, _ = validate_cr(new_cluster_policy(spec={
+        "validator": {"hbm": {"enabled": False},
+                      "dcn": {"enabled": False}}}))
+    assert errs == []

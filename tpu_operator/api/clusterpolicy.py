@@ -266,6 +266,9 @@ class ValidatorSpec(ComponentSpec):
     driver: Optional[ComponentSpec] = None
     jax: Optional[ComponentSpec] = None
     ici: Optional[ComponentSpec] = None
+    hbm: Optional[ComponentSpec] = None
+    dcn: Optional[ComponentSpec] = None
+    runtime: Optional[ComponentSpec] = None
     matmul_size: Optional[int] = field(
         default=4096, description="N for the NxN bf16 matmul MXU proof")
     ici_bandwidth_threshold: Optional[float] = field(

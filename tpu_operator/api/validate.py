@@ -98,4 +98,24 @@ def validate_cr(cr: dict) -> Tuple[List[str], str]:
     errs.extend(_schema_errors(cr.get("spec") or {},
                                schema["properties"]["spec"], "/spec"))
     errs.extend(_image_errors(cr))
+    errs.extend(_semantic_errors(cr, kind))
     return errs, kind
+
+
+def _semantic_errors(cr: dict, kind: str) -> List[str]:
+    """Rules the type schema can't express. Core validation proofs write
+    the barrier files every operand's initContainer gates on — a policy
+    that disables one would render cleanly and then wedge every node
+    (operands blocked forever on a file nobody writes)."""
+    errs: List[str] = []
+    if kind != KIND_CLUSTER_POLICY:
+        return errs
+    validator = (cr.get("spec") or {}).get("validator") or {}
+    for proof in ("driver", "jax", "ici", "plugin"):
+        sub = validator.get(proof)
+        if isinstance(sub, dict) and sub.get("enabled") is False:
+            errs.append(
+                f"/spec/validator/{proof}/enabled: core proofs cannot be "
+                f"disabled — {proof}-ready gates downstream operands "
+                f"(disable aux proofs instead: hbm/dcn/runtime)")
+    return errs

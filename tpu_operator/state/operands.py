@@ -384,16 +384,27 @@ def _validation_data(ctx: SyncContext) -> dict:
     data = common_data(ctx, spec, "operator-validation", "tpu-validator")
     data["MatmulSize"] = spec.matmul_size or 4096
     data["IciThreshold"] = spec.ici_bandwidth_threshold or 0.8
-    data["RuntimeEnabled"] = ctx.spec.tpu_runtime.is_enabled()
+    # aux proofs honor their per-proof enabled knob; runtime-validation
+    # additionally follows the tpu-runtime operand. The CORE proofs
+    # (driver/jax/ici, and plugin under devicePlugin) cannot be disabled
+    # here — validate_cr rejects that, because their barrier files gate
+    # every operand and a missing proof would wedge the node.
+    data["RuntimeEnabled"] = ctx.spec.tpu_runtime.is_enabled() and (
+        spec.runtime.is_enabled() if spec.runtime else True)
     data["PluginEnabled"] = ctx.spec.device_plugin.is_enabled()
+    data["HbmEnabled"] = spec.hbm.is_enabled() if spec.hbm else True
+    data["DcnEnabled"] = spec.dcn.is_enabled() if spec.dcn else True
     # per-proof ComponentSpec overrides (validator.plugin.env slot of the
     # reference: transformValidatorComponent, object_controls.go:2129) —
     # applied to the matching validation initContainer post-render
     data["ProofOverrides"] = _proof_overrides(data["Image"], {
         "driver-validation": spec.driver,
+        "runtime-validation": spec.runtime,
         "plugin-validation": spec.plugin,
         "jax-validation": spec.jax,
         "ici-validation": spec.ici,
+        "hbm-validation": spec.hbm,
+        "dcn-validation": spec.dcn,
     })
     return data
 
