@@ -28,6 +28,20 @@ JAX_PLATFORMS=cpu to prove the harness end-to-end (vs_baseline pinned to
 
 Details (device kind, absolute TFLOP/s / GB/s, timings, diagnostics) go
 to stderr; stdout carries exactly one JSON line.
+
+The headline line also carries the round's other hardware proofs as
+fields (VERDICT r3 #6 — one parseable line, every proof on the record):
+``hbm_triad`` (the Pallas STREAM-triad HBM figure with its own
+vs_baseline against the validator's 0.5 bar) and ``telemetry`` (a real
+exporter->scrape->health-engine pipeline sample).
+
+Wedged-tunnel handling (VERDICT r3 #1): when an attempt times out inside
+backend init and no LOCAL process holds the TPU device nodes, the remote
+end of the PJRT tunnel is wedged (observed to take 1h+ to clear).
+Burning identical full-length attempts is pointless, so the parent
+switches to holder-wait: cheap init-only probes spaced across most of
+--total-timeout, escalating to a full measurement the moment a probe
+sees the chip.
 """
 
 from __future__ import annotations
@@ -40,6 +54,9 @@ import sys
 import time
 
 BASELINE_FRACTION = 0.80
+# the validator's HBM bar (validator/components.py:validate_hbm): triad
+# must sustain >=50% of published HBM bandwidth; healthy v5e measures ~0.8
+HBM_BASELINE_FRACTION = 0.50
 
 
 # ----------------------------------------------------------------- child
@@ -102,6 +119,74 @@ def _scrape_telemetry(platform: str) -> dict | None:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _bounded_worker(fn, budget: float, child_start: float,
+                    cap_s: float) -> dict:
+    """Run ``fn`` (which returns a doc dict) in a daemon worker bounded by
+    the remaining child budget, reserving ~45s for the telemetry scrape
+    (its own 10s HTTP timeout) + JSON emission. A hung measurement must
+    never forfeit the already-measured headline to the subprocess timeout
+    — neither an exception nor a deadlock may reach the caller. The
+    worker publishes ONE fresh dict; it never mutates an object the
+    emitter may be serializing concurrently."""
+    import threading
+
+    box: dict = {}
+
+    def _run():
+        try:
+            box["doc"] = fn()
+        except Exception as e:
+            box["doc"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if budget > 0:
+        remaining = budget - (time.monotonic() - child_start)
+        join_s = min(cap_s, remaining - 45.0)
+    else:
+        join_s = cap_s
+    if join_s <= 0:
+        return {"error": "skipped: no budget left after headline"}
+    worker = threading.Thread(target=_run, daemon=True)
+    worker.start()
+    worker.join(timeout=join_s)
+    return box.get("doc") or {
+        "error": f"still running after {join_s:.0f}s; dropped"}
+
+
+def _hbm_triad_probe(platform: str, budget: float,
+                     child_start: float) -> dict | None:
+    """The Pallas STREAM-triad HBM figure for the official record
+    (VERDICT r3 #6: it previously rode along only as stderr). Runs after
+    the headline is already measured, bounded so it cannot forfeit it."""
+    if platform != "tpu":
+        return None
+
+    def _probe():
+        from tpu_operator.workloads import pallas_probe
+
+        r = pallas_probe.run(size_mb=512.0, iters=24, repeats=2)
+        if r.fraction_of_peak is not None:
+            doc = {
+                "metric": "validator_hbm_triad_fraction_of_peak",
+                "value": round(r.fraction_of_peak, 4),
+                "unit": "fraction_of_hbm_peak",
+                "bandwidth_gbps": round(r.bandwidth_gbps, 1),
+                "vs_baseline": round(
+                    r.fraction_of_peak / HBM_BASELINE_FRACTION, 4),
+            }
+        else:  # unknown chip: absolute figure, no baseline claim
+            doc = {
+                "metric": "validator_hbm_triad_bandwidth",
+                "value": round(r.bandwidth_gbps, 1), "unit": "GB/s",
+                "vs_baseline": 0.0,
+            }
+        if not r.correct:
+            doc["metric"] += "_invalid"
+            doc["vs_baseline"] = 0.0
+        return doc
+
+    return _bounded_worker(_probe, budget, child_start, cap_s=120.0)
+
+
 def _emit(doc: dict, platform: str, ok: bool) -> int:
     """Print the JSON line. ``_platform`` rides along for the parent (which
     strips it); a failed correctness check invalidates the number rather
@@ -135,6 +220,13 @@ def child_main() -> int:
     # process per attempt also sidesteps any cached-failure state)
     devices = backend.init_devices(
         attempts=1, platform=os.environ.get("TPUOP_BENCH_PLATFORM") or None)
+
+    if os.environ.get("TPUOP_BENCH_PROBE"):
+        # holder-wait mode: init-only liveness check, no measurement
+        print(json.dumps({"metric": "probe", "value": len(devices),
+                          "unit": "devices", "vs_baseline": 0.0,
+                          "_platform": devices[0].platform}))
+        return 0
     platform = devices[0].platform
     kind = getattr(devices[0], "device_kind", "")
     spec = hardware.chip_spec_for(kind)
@@ -149,47 +241,18 @@ def child_main() -> int:
             res = collectives.run(size_mb=4.0, iters=2, repeats=1)
         print(f"# allreduce: {res}", file=sys.stderr)
         # the full primitive suite rides along (informational; psum is
-        # the headline) — one bus-GB/s figure per collective. Run in a
-        # bounded worker thread: a hung collective (fabric fault) must
-        # not forfeit the already-measured headline to the subprocess
-        # timeout — neither an exception nor a deadlock may reach here.
-        import threading
-
-        box: dict = {}  # worker publishes ONE fresh dict; never mutates
-        # an object the emitter may be serializing concurrently
-
-        def _run_suite():
-            try:
-                suite = collectives.run_suite(
-                    size_mb=32.0 if platform == "tpu" else 0.5,
-                    iters=4 if platform == "tpu" else 1, repeats=1)
-                box["doc"] = {
-                    op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
+        # the headline) — one bus-GB/s figure per collective, bounded so
+        # a hung collective (fabric fault) cannot forfeit the headline
+        def _suite():
+            suite = collectives.run_suite(
+                size_mb=32.0 if platform == "tpu" else 0.5,
+                iters=4 if platform == "tpu" else 1, repeats=1)
+            return {op: {"bus_bw_gbps": round(r.bus_bw_gbps, 2),
                          "correct": r.correct}
                     for op, r in suite.items()}
-            except Exception as e:
-                box["doc"] = {"error": f"{type(e).__name__}: {e}"}
 
-        # never outlive the child's own budget: the faulthandler
-        # self-terminates at budget-15s and the parent kills at budget.
-        # Reserve ~45s after the join for the telemetry scrape (HTTP
-        # round-trip with its own 10s timeout) + JSON emission; if that
-        # leaves nothing, skip the suite entirely rather than risk the
-        # already-measured headline.
-        if budget > 0:
-            remaining = budget - (time.monotonic() - child_start)
-            join_s = min(180.0, remaining - 45.0)
-        else:
-            join_s = 180.0
-        if join_s > 0:
-            worker = threading.Thread(target=_run_suite, daemon=True)
-            worker.start()
-            worker.join(timeout=join_s)
-            suite_doc = box.get("doc") or {
-                "error": f"suite still running after {join_s:.0f}s; "
-                         f"dropped"}
-        else:
-            suite_doc = {"error": "skipped: no budget left after headline"}
+        suite_doc = _bounded_worker(_suite, budget, child_start,
+                                    cap_s=180.0)
         value = res.fraction_of_peak
         if value is None:  # unknown chip: report absolute bus bandwidth
             return _emit({
@@ -220,17 +283,23 @@ def child_main() -> int:
         size, iters, calls = 16384, 20, 3
     res = matmul.run(size=size, iters=iters, calls=calls, repeats=3)
     print(f"# matmul: {res}", file=sys.stderr)
+    hbm_doc = _hbm_triad_probe(platform, budget, child_start)
+    if hbm_doc is not None:
+        print(f"# hbm_triad: {hbm_doc}", file=sys.stderr)
     if res.utilization is not None:
-        return _emit({
+        doc = {
             "metric": "validator_matmul_mxu_utilization",
             "value": round(res.utilization, 4),
             "unit": "fraction_of_peak_bf16",
-            "vs_baseline": round(res.utilization / BASELINE_FRACTION, 4)},
-            platform, res.checksum_ok)
-    return _emit({
-        "metric": "validator_matmul_throughput",
-        "value": round(res.tflops, 2), "unit": "TFLOP/s",
-        "vs_baseline": 0.0}, platform, res.checksum_ok)
+            "vs_baseline": round(res.utilization / BASELINE_FRACTION, 4)}
+    else:
+        doc = {
+            "metric": "validator_matmul_throughput",
+            "value": round(res.tflops, 2), "unit": "TFLOP/s",
+            "vs_baseline": 0.0}
+    if hbm_doc is not None:
+        doc["hbm_triad"] = hbm_doc
+    return _emit(doc, platform, res.checksum_ok)
 
 
 # ---------------------------------------------------------------- parent
@@ -274,11 +343,52 @@ def _run_child(timeout_s: float, extra_env: dict | None = None):
     return line, rc, stderr[-2000:]
 
 
-def _diagnose(note: str) -> None:
+def _diagnose(note: str) -> list:
     from tpu_operator.workloads import backend
 
     print(f"# {note}", file=sys.stderr)
-    backend.log_holders(lambda msg: print(msg, file=sys.stderr))
+    holders = backend.diagnose_holders()  # one scan: log + return the same
+    for h in holders:
+        print(f"#   chip held by pid={h.pid} ({h.cmdline}) via {h.paths}",
+              file=sys.stderr)
+    if not holders:
+        print(f"#   no local holder found; env: "
+              f"{backend.describe_environment()}", file=sys.stderr)
+    return holders
+
+
+def _holder_wait(deadline: float, attempt_timeout: float,
+                 probe_timeout: float = 90.0) -> bool:
+    """Wedged-tunnel mode: an attempt timed out inside backend init while
+    no LOCAL process held the TPU device nodes — the remote end of the
+    tunnel is wedged (the BENCH_r03 signature; clears in tens of minutes
+    to 1h+). Spend the remaining budget on cheap init-only probes with
+    long spacing, reserving one full attempt's worth at the end. Returns
+    True as soon as a probe sees the chip."""
+    sleep_s = 120.0
+    reserve = attempt_timeout + 30.0
+    n = 0
+    while deadline - time.monotonic() > reserve + probe_timeout:
+        n += 1
+        print(f"# holder-wait probe {n} "
+              f"({deadline - time.monotonic():.0f}s budget left)",
+              file=sys.stderr)
+        result, rc, _tail = _run_child(
+            probe_timeout, {"TPUOP_BENCH_PROBE": "1"})
+        if rc == 0 and result is not None \
+                and result.get("_platform") == "tpu":
+            print("# holder-wait: probe saw the TPU; escalating to a "
+                  "full attempt", file=sys.stderr)
+            return True
+        wait = min(sleep_s, deadline - time.monotonic() - reserve)
+        if wait <= 0:
+            break
+        print(f"# holder-wait: tunnel still down; sleeping {wait:.0f}s",
+              file=sys.stderr)
+        time.sleep(wait)
+    print("# holder-wait: budget exhausted without a live probe",
+          file=sys.stderr)
+    return False
 
 
 def main() -> int:
@@ -300,6 +410,7 @@ def main() -> int:
     delay = args.backoff
     non_tpu_result = None  # best silent-fallback candidate, marked later
     invalid_result = None  # TPU ran but failed its correctness check
+    holder_waited = False  # wedged-tunnel wait engages at most once
     min_budget = min(30.0, args.attempt_timeout)
     for attempt in range(1, args.attempts + 1):
         budget = min(args.attempt_timeout, deadline - time.monotonic())
@@ -310,7 +421,9 @@ def main() -> int:
             break
         print(f"# attempt {attempt}/{args.attempts} "
               f"(budget {budget:.0f}s)", file=sys.stderr)
+        t_attempt = time.monotonic()
         result, rc, tail = _run_child(budget)
+        elapsed = time.monotonic() - t_attempt
         if result is not None:
             platform = result.pop("_platform", "unknown")
             if rc == 0 and platform == "tpu":
@@ -330,7 +443,23 @@ def main() -> int:
                 _diagnose(f"attempt {attempt} failed rc={rc} on "
                           f"platform={platform!r}")
         else:
-            _diagnose(f"attempt {attempt} failed rc={rc}: ...{tail[-300:]!r}")
+            holders = _diagnose(
+                f"attempt {attempt} failed rc={rc}: ...{tail[-300:]!r}")
+            # the wedged-tunnel signature: the child burned (nearly) its
+            # whole budget without emitting a number and nothing local
+            # holds the chip. Both the parent-kill path (rc=-1) and the
+            # child's own faulthandler watchdog, which exits rc=1 at
+            # budget-15s, must match — gate on elapsed time, not rc.
+            if (result is None and not holders and not holder_waited
+                    and elapsed > budget * 0.8
+                    and attempt < args.attempts
+                    and deadline - time.monotonic()
+                    > args.attempt_timeout + 120.0):
+                # probe-and-wait instead of burning identical full-length
+                # attempts (VERDICT r3 #1)
+                holder_waited = True
+                _holder_wait(deadline, args.attempt_timeout)
+                continue
         if attempt < args.attempts and time.monotonic() + delay < deadline:
             print(f"# backing off {delay:.0f}s", file=sys.stderr)
             time.sleep(delay)
