@@ -84,6 +84,9 @@ class MockApiServer:
         self.rv = 100
         self.uid = 0
         self.fail_next_writes = 0            # inject N 409s on PUT/PATCH
+        # (group, version, plural) -> openAPIV3Schema for registered CRDs;
+        # writes to matching CR collections run admission (CEL + types)
+        self.crd_schemas: dict[tuple, dict] = {}
         self.watchers: list[tuple[str, queue.Queue, threading.Event]] = []
         # (rv, coll, alt_coll, event) log so a watch carrying
         # ?resourceVersion=X replays everything newer than X — real
@@ -125,9 +128,38 @@ class MockApiServer:
         meta.setdefault("uid", self.next_uid())
         meta["resourceVersion"] = self.next_rv()
         meta.setdefault("generation", 1)
+        self.maybe_register_crd(obj)
         with self.lock:
             self.objects[path] = obj
         self.publish(event, path, obj)
+
+    # -- CRD admission (the real apiserver's CEL/schema gate) --------------
+
+    def maybe_register_crd(self, obj: dict):
+        """Storing a CustomResourceDefinition activates admission for its
+        collections, like a real apiserver establishing the CR endpoint."""
+        if obj.get("kind") != "CustomResourceDefinition":
+            return
+        spec = obj.get("spec") or {}
+        group = spec.get("group", "")
+        plural = (spec.get("names") or {}).get("plural", "")
+        with self.lock:
+            for ver in spec.get("versions") or []:
+                schema = ((ver.get("schema") or {})
+                          .get("openAPIV3Schema") or {})
+                self.crd_schemas[(group, ver.get("name", ""),
+                                  plural)] = schema
+
+    def schema_for_collection(self, coll_path: str):
+        """openAPIV3Schema for a CR collection path, else None. Handles
+        cluster-scoped (/apis/g/v/plural) and namespaced
+        (/apis/g/v/namespaces/ns/plural) shapes."""
+        segs = _segments(coll_path)
+        if not segs or segs[0] != "apis" or len(segs) < 4:
+            return None
+        group, version, plural = segs[1], segs[2], segs[-1]
+        with self.lock:
+            return self.crd_schemas.get((group, version, plural))
 
     def publish(self, type_: str, obj_path: str, obj: dict):
         coll = collection_of(obj_path)
@@ -318,14 +350,34 @@ class _Handler(BaseHTTPRequestHandler):
             exists = path in self.st.objects
         if exists:
             return self._conflict("AlreadyExists")
+        errs = self._admission(u.path.rstrip("/"), body, None)
+        if errs:
+            return self._invalid(errs)
         meta = body.setdefault("metadata", {})
         meta["uid"] = self.st.next_uid()
         meta["resourceVersion"] = self.st.next_rv()
         meta.setdefault("generation", 1)
+        self.st.maybe_register_crd(body)
         with self.st.lock:
             self.st.objects[path] = body
         self.st.publish("ADDED", path, body)
         self._send(201, body)
+
+    def _admission(self, coll_path: str, new: dict, old):
+        """Registered-CRD admission: structural schema + CEL transition
+        rules, exactly what bounces at `kubectl apply` on a real
+        apiserver (nvidiadriver_types.go:40-186 parity)."""
+        schema = self.st.schema_for_collection(coll_path)
+        if schema is None:
+            return []
+        from tpu_operator.api.validate import admission_errors
+
+        return admission_errors(new, old, schema)
+
+    def _invalid(self, errs):
+        self._send(422, {"kind": "Status", "status": "Failure",
+                         "reason": "Invalid",
+                         "message": "; ".join(errs), "code": 422})
 
     def _serve_eviction(self, pod_path):
         with self.st.lock:
@@ -394,6 +446,9 @@ class _Handler(BaseHTTPRequestHandler):
             merged = copy.deepcopy(current)
             merged["status"] = body.get("status")
         else:
+            errs = self._admission(collection_of(target), body, current)
+            if errs:
+                return self._invalid(errs)
             merged = body
             meta = merged.setdefault("metadata", {})
             meta["uid"] = (current.get("metadata") or {}).get("uid")
@@ -401,6 +456,7 @@ class _Handler(BaseHTTPRequestHandler):
             meta["generation"] = (
                 cur_gen + 1
                 if merged.get("spec") != current.get("spec") else cur_gen)
+            self.st.maybe_register_crd(merged)
         if self._noop(current, merged):
             return self._send(200, copy.deepcopy(current))
         merged.setdefault("metadata", {})["resourceVersion"] = \
@@ -445,6 +501,11 @@ class _Handler(BaseHTTPRequestHandler):
             return out
 
         merged = merge(current, body)
+        # real apiservers run CEL/schema admission on every write verb —
+        # a merge-patch must not slip past what PUT would bounce
+        errs = self._admission(collection_of(u.path), merged, current)
+        if errs:
+            return self._invalid(errs)
         if self._noop(current, merged):
             return self._send(200, copy.deepcopy(current))
         merged.setdefault("metadata", {})["resourceVersion"] = \

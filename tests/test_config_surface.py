@@ -438,8 +438,8 @@ def test_core_proof_disable_rejected_at_validation():
     for proof in ("driver", "jax", "ici", "plugin"):
         errs, _ = validate_cr(new_cluster_policy(spec={
             "validator": {proof: {"enabled": False}}}))
-        assert any("core proofs cannot be disabled" in e for e in errs), \
-            f"{proof}: no semantic rejection"
+        assert any(f"core proof '{proof}' cannot be disabled" in e
+                   for e in errs), f"{proof}: no semantic rejection"
     # aux proofs stay disableable
     errs, _ = validate_cr(new_cluster_policy(spec={
         "validator": {"hbm": {"enabled": False},

@@ -30,6 +30,7 @@ from tpu_operator.runtime.objects import get_nested, labels_of
 
 from mock_apiserver import MockApiServer
 
+import os
 import time
 
 NS = "tpu-operator"
@@ -74,16 +75,34 @@ def cluster():
         srv.stop()
 
 
+def load_factor():
+    """Deadline scale for convergence waits (VERDICT r3 #2): under
+    parallel CI the box is oversubscribed roughly by the xdist worker
+    count, so fixed wall-clock budgets that pass serially cry wolf at
+    -n 8. Scale them by the advertised contention."""
+    workers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1") or 1)
+    return max(1.0, workers / 2.0)
+
+
 def wait_for(ops, pred, desc, timeout=60.0):
-    """Wait for ``pred`` while ticking the HTTP kubelet."""
-    end = time.time() + timeout
+    """Wait for ``pred`` while ticking the HTTP kubelet.
+
+    ``pred`` is evaluated every pass even when the kubelet tick hits a
+    transient write race — otherwise sustained contention (operator
+    writes vs kubelet status writes) could starve the check forever
+    while the condition it waits for is already true.
+    """
+    end = time.time() + timeout * load_factor()
     last_err = None
     while time.time() < end:
         try:
             simulate_kubelet(ops, ready=True)
+        except Exception as e:  # transient races while converging
+            last_err = e
+        try:
             if pred():
                 return
-        except Exception as e:  # transient races while converging
+        except Exception as e:
             last_err = e
         time.sleep(0.25)
     raise AssertionError(f"timed out waiting for {desc} "
@@ -101,16 +120,22 @@ def install(ops, spec=None):
 
 def update_spec(ops, mutate):
     """Read-modify-write the CR spec with conflict retry (what kubectl
-    apply/edit does)."""
-    for _ in range(10):
+    apply/edit does). Deadline-based rather than attempt-counted so
+    sustained-but-transient contention cannot exhaust it."""
+    from tpu_operator.runtime.client import ConflictError
+
+    end = time.time() + 10.0 * load_factor()
+    last = None
+    while time.time() < end:
         cr = ops.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
         mutate(cr.setdefault("spec", {}))
         try:
             ops.update(cr)
             return
-        except Exception:
+        except ConflictError as e:  # anything else (e.g. a 422) is final
+            last = e
             time.sleep(0.1)
-    raise AssertionError("could not update CR after 10 attempts")
+    raise AssertionError(f"could not update CR (last error: {last})")
 
 
 class TestHTTPLifecycle:

@@ -526,6 +526,27 @@ class TestWatch:
         # the drop did NOT trigger a second list: exactly one ADDED
         assert [e for e in got if e[0] == "ADDED"] == [("ADDED", "w1")]
 
+    def test_read_timeout_detection_through_requests_wrappers(self):
+        """The idle-watch 300s read timeout does NOT arrive as
+        requests.ReadTimeout during streaming — urllib3's ReadTimeoutError
+        comes wrapped in ConnectionError — and ConnectTimeout (server
+        down) must NOT match, or reconnects would spin without backoff."""
+        import requests as rq
+
+        from urllib3.exceptions import ReadTimeoutError
+
+        f = HTTPClient._is_read_timeout
+        assert f(rq.exceptions.ReadTimeout("read timed out"))
+        # the streaming wrapper shape: ConnectionError(ReadTimeoutError)
+        inner = ReadTimeoutError(None, "http://x", "Read timed out.")
+        assert f(rq.exceptions.ConnectionError(inner))
+        # chained via __cause__ instead of args
+        wrapped = rq.exceptions.ConnectionError("boom")
+        wrapped.__cause__ = inner
+        assert f(wrapped)
+        assert not f(rq.exceptions.ConnectTimeout("connect timed out"))
+        assert not f(RuntimeError("unrelated"))
+
     def test_watch_error_event_triggers_relist(self, apiserver, client):
         apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w2"] = pod("w2")
         got = []

@@ -62,9 +62,27 @@ def _crd(kind: str, plural: str, singular: str, version: str,
     }
 
 
+# the proofs whose barrier files every operand's initContainer gates on;
+# a policy disabling one renders cleanly and then wedges every node, so
+# it must bounce at `kubectl apply` (admission), not sit NotReady
+CORE_PROOFS = ("driver", "jax", "ici", "plugin")
+
+
 def cluster_policy_crd() -> dict:
+    schema = schema_of(TPUClusterPolicySpec)
+    # admission-time analog of validate.py's _semantic_errors core-proof
+    # rule, as CEL like the reference's XValidation blocks
+    # (nvidiadriver_types.go:40-186)
+    schema["x-kubernetes-validations"] = [
+        {"rule": (f"!has(self.validator) || !has(self.validator.{p}) || "
+                  f"!has(self.validator.{p}.enabled) || "
+                  f"self.validator.{p}.enabled != false"),
+         "message": (f"validator core proof '{p}' cannot be disabled — "
+                     f"{p}-ready gates downstream operands (disable aux "
+                     f"proofs instead: hbm/dcn/runtime)")}
+        for p in CORE_PROOFS]
     return _crd(KIND_CLUSTER_POLICY, "tpuclusterpolicies", "tpuclusterpolicy",
-                "v1", schema_of(TPUClusterPolicySpec), ["tcp", "tpucp"])
+                "v1", schema, ["tcp", "tpucp"])
 
 
 def tpu_driver_crd() -> dict:
@@ -73,7 +91,29 @@ def tpu_driver_crd() -> dict:
     # NVIDIADriver (nvidiadriver_types.go:40-186)
     schema["properties"]["driverType"]["x-kubernetes-validations"] = [
         {"rule": "self == oldSelf",
-         "message": "driverType is immutable"}]
+         "message": "driverType is immutable — create a new TPUDriver "
+                    "resource instead"}]
+    # the channel selects a libtpu build stream per pool; switching
+    # streams in place is the usePrecompiled-flip hazard (a different
+    # artifact lineage under running workloads) — immutable, like the
+    # reference's usePrecompiled rule. `version` stays mutable: that IS
+    # the rolling-upgrade path.
+    schema["properties"]["channel"]["x-kubernetes-validations"] = [
+        {"rule": "self == oldSelf",
+         "message": "channel is immutable — create a new TPUDriver "
+                    "resource per build stream instead"}]
+    # enum tightening: catch typos at apply time, not reconcile time
+    schema["properties"]["channel"]["enum"] = ["stable", "nightly", "custom"]
+    schema["properties"]["driverType"]["enum"] = ["libtpu", "host"]
+    schema["properties"]["imagePullPolicy"]["enum"] = [
+        "Always", "IfNotPresent", "Never"]
+    # a custom channel has no default build tag to resolve — it must pin
+    # one explicitly
+    schema["x-kubernetes-validations"] = [
+        {"rule": "!has(self.channel) || self.channel != 'custom' || "
+                 "has(self.version)",
+         "message": "channel 'custom' requires an explicit version "
+                    "(build tag or digest)"}]
     return _crd(KIND_TPU_DRIVER, "tpudrivers", "tpudriver", "v1alpha1",
                 schema, ["tpud"],
                 [{"name": "Channel", "type": "string",

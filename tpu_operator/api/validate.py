@@ -8,9 +8,10 @@ CR must fail at render time).
 
 from __future__ import annotations
 
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 from . import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
+from . import cel
 from .crd import cluster_policy_crd, tpu_driver_crd
 
 
@@ -97,25 +98,33 @@ def validate_cr(cr: dict) -> Tuple[List[str], str]:
     schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
     errs.extend(_schema_errors(cr.get("spec") or {},
                                schema["properties"]["spec"], "/spec"))
+    errs.extend(cel.schema_cel_errors(cr.get("spec") or {}, None,
+                                      schema["properties"]["spec"], "/spec"))
     errs.extend(_image_errors(cr))
     errs.extend(_semantic_errors(cr, kind))
     return errs, kind
 
 
-def _semantic_errors(cr: dict, kind: str) -> List[str]:
-    """Rules the type schema can't express. Core validation proofs write
-    the barrier files every operand's initContainer gates on — a policy
-    that disables one would render cleanly and then wedge every node
-    (operands blocked forever on a file nobody writes)."""
-    errs: List[str] = []
-    if kind != KIND_CLUSTER_POLICY:
-        return errs
-    validator = (cr.get("spec") or {}).get("validator") or {}
-    for proof in ("driver", "jax", "ici", "plugin"):
-        sub = validator.get(proof)
-        if isinstance(sub, dict) and sub.get("enabled") is False:
-            errs.append(
-                f"/spec/validator/{proof}/enabled: core proofs cannot be "
-                f"disabled — {proof}-ready gates downstream operands "
-                f"(disable aux proofs instead: hbm/dcn/runtime)")
+def admission_errors(new: dict, old: Optional[dict],
+                     schema: dict) -> List[str]:
+    """What a real apiserver checks on create/update of a CR whose CRD
+    carries this openAPIV3Schema: structural types + enums, then every
+    CEL x-kubernetes-validations rule (transition rules only on update).
+    Used by the mock apiserver so admission-time rejection is testable
+    `kubectl apply`-shaped (nvidiadriver_types.go:40-186 parity)."""
+    spec_schema = (schema.get("properties") or {}).get("spec") or {}
+    new_spec = new.get("spec") or {}
+    old_spec = (old or {}).get("spec") if old is not None else None
+    errs = _schema_errors(new_spec, spec_schema, "/spec")
+    errs.extend(cel.schema_cel_errors(new_spec, old_spec, spec_schema,
+                                      "/spec"))
     return errs
+
+
+def _semantic_errors(cr: dict, kind: str) -> List[str]:
+    """Rules neither the type schema nor the CRD CEL rules express.
+    (The core-proof disable rejection moved into the ClusterPolicy CRD's
+    x-kubernetes-validations — crd.py CORE_PROOFS — so it now also
+    bounces at admission; schema_cel_errors above enforces the same rule
+    text offline.)"""
+    return []

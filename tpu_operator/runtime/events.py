@@ -45,7 +45,11 @@ class EventRecorder:
         key = (f"{involved.get('kind')}/{involved.get('name')}"
                f"/{reason}/{message}")
         digest = hashlib.sha256(key.encode()).hexdigest()[:12]
-        return f"{involved.get('name') or 'obj'}.{digest}"
+        # Node names can approach the 253-char object-name limit; an
+        # overlong Event name fails creation and the event is silently
+        # dropped. 240 leaves room for "." + 12-hex digest.
+        prefix = (involved.get("name") or "obj")[:240]
+        return f"{prefix}.{digest}"
 
     def event(self, obj: dict, type_: str, reason: str,
               message: str) -> None:

@@ -305,72 +305,95 @@ def simulate_kubelet(client: Client, ready: bool = True,
 
     ``ready=True`` marks scheduled pods available; ``stale_hash=True``
     forces pods onto a fake outdated revision.
+
+    Contention-safe: writes are skipped client-side when nothing would
+    change (a steady-state tick is read-only), and a 409 on one
+    DaemonSet — the operator wrote it between our list and our status
+    write — abandons only that DaemonSet's tick, like a real kubelet
+    catching up on its next sync, instead of aborting the whole pass.
     """
     for ds in client.list("apps/v1", "DaemonSet"):
-        # NB: DaemonSet pods tolerate the unschedulable taint, so cordoned
-        # nodes still receive daemon pods — required for driver-pod
-        # restarts during cordon+drain upgrades.
-        nodes = ds_scheduled_nodes(client, ds)
-        desired = len(nodes)
-        revision = object_hash(get_nested(ds, "spec", "template", default={}))
-        on_delete = get_nested(ds, "spec", "updateStrategy", "type",
-                               default="RollingUpdate") == "OnDelete"
-        ns = namespace_of(ds) or "default"
-        tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
-                                 default={}) or {}
-        updated = 0
-        n_ready = 0
-        base_hash = "stale" if stale_hash else revision
-        phase = "Running" if ready else "Pending"
-        ready_conds = [{"type": "Ready",
-                        "status": "True" if ready else "False"}]
-        for node in nodes:
-            pod_name = f"{name_of(ds)}-{name_of(node)}"
-            existing = client.get_or_none("v1", "Pod", pod_name, ns)
-            if existing is not None:
-                # OnDelete: the pod keeps its revision until deleted
-                pod_hash = (get_nested(existing, "metadata", "labels",
-                                       "controller-revision-hash")
-                            if on_delete and not stale_hash else base_hash)
-                existing["metadata"]["labels"] = {
-                    **tmpl_labels, "controller-revision-hash": pod_hash}
+        try:
+            _kubelet_tick_ds(client, ds, ready=ready, stale_hash=stale_hash)
+        except (ConflictError, NotFoundError, AlreadyExistsError):
+            # the operator raced us on this DS (wrote it, deleted a pod,
+            # or created one first); catch up on the next tick
+            continue
+
+
+def _kubelet_tick_ds(client: Client, ds: Mapping, ready: bool,
+                     stale_hash: bool) -> None:
+    # NB: DaemonSet pods tolerate the unschedulable taint, so cordoned
+    # nodes still receive daemon pods — required for driver-pod
+    # restarts during cordon+drain upgrades.
+    nodes = ds_scheduled_nodes(client, ds)
+    desired = len(nodes)
+    revision = object_hash(get_nested(ds, "spec", "template", default={}))
+    on_delete = get_nested(ds, "spec", "updateStrategy", "type",
+                           default="RollingUpdate") == "OnDelete"
+    ns = namespace_of(ds) or "default"
+    tmpl_labels = get_nested(ds, "spec", "template", "metadata", "labels",
+                             default={}) or {}
+    updated = 0
+    n_ready = 0
+    base_hash = "stale" if stale_hash else revision
+    phase = "Running" if ready else "Pending"
+    ready_conds = [{"type": "Ready",
+                    "status": "True" if ready else "False"}]
+    for node in nodes:
+        pod_name = f"{name_of(ds)}-{name_of(node)}"
+        existing = client.get_or_none("v1", "Pod", pod_name, ns)
+        if existing is not None:
+            # OnDelete: the pod keeps its revision until deleted
+            pod_hash = (get_nested(existing, "metadata", "labels",
+                                   "controller-revision-hash")
+                        if on_delete and not stale_hash else base_hash)
+            new_labels = {**tmpl_labels,
+                          "controller-revision-hash": pod_hash}
+            if (existing["metadata"].get("labels") != new_labels
+                    or get_nested(existing, "status", "phase") != phase
+                    or get_nested(existing, "status",
+                                  "conditions") != ready_conds):
+                existing["metadata"]["labels"] = new_labels
                 set_nested(existing, phase, "status", "phase")
                 set_nested(existing, ready_conds, "status", "conditions")
                 client.update(existing)
-            else:
-                pod_hash = base_hash
-                client.create({
-                    "apiVersion": "v1",
-                    "kind": "Pod",
-                    "metadata": {
-                        "name": pod_name,
-                        "namespace": ns,
-                        "labels": {**tmpl_labels,
-                                   "controller-revision-hash": pod_hash},
-                        "ownerReferences": [{
-                            "apiVersion": "apps/v1", "kind": "DaemonSet",
-                            "name": name_of(ds),
-                            "uid": get_nested(ds, "metadata", "uid"),
-                            "controller": True,
-                        }],
-                    },
-                    "spec": {"nodeName": name_of(node)},
-                    "status": {"phase": phase,
-                               "conditions": list(ready_conds)},
-                })
-            if pod_hash == revision:
-                updated += 1
-            if ready:
-                n_ready += 1
-        status = {
-            "desiredNumberScheduled": desired,
-            "currentNumberScheduled": desired,
-            "numberMisscheduled": 0,
-            "numberReady": n_ready,
-            "numberAvailable": n_ready,
-            "updatedNumberScheduled": updated,
-            "observedGeneration": get_nested(ds, "metadata", "generation",
-                                             default=1),
-        }
-        ds["status"] = status
+        else:
+            pod_hash = base_hash
+            client.create({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": pod_name,
+                    "namespace": ns,
+                    "labels": {**tmpl_labels,
+                               "controller-revision-hash": pod_hash},
+                    "ownerReferences": [{
+                        "apiVersion": "apps/v1", "kind": "DaemonSet",
+                        "name": name_of(ds),
+                        "uid": get_nested(ds, "metadata", "uid"),
+                        "controller": True,
+                    }],
+                },
+                "spec": {"nodeName": name_of(node)},
+                "status": {"phase": phase,
+                           "conditions": list(ready_conds)},
+            })
+        if pod_hash == revision:
+            updated += 1
+        if ready:
+            n_ready += 1
+    status = {
+        "desiredNumberScheduled": desired,
+        "currentNumberScheduled": desired,
+        "numberMisscheduled": 0,
+        "numberReady": n_ready,
+        "numberAvailable": n_ready,
+        "updatedNumberScheduled": updated,
+        "observedGeneration": get_nested(ds, "metadata", "generation",
+                                         default=1),
+    }
+    cur = ds.get("status") or {}
+    if any(cur.get(k) != v for k, v in status.items()):
+        ds["status"] = {**cur, **status}
         client.update_status(ds)

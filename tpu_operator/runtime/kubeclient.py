@@ -331,6 +331,32 @@ class HTTPClient(Client):
 
     # -- watch -------------------------------------------------------------
 
+    @staticmethod
+    def _is_read_timeout(e: BaseException) -> bool:
+        """True when the failure is an idle-stream read timeout. requests
+        does NOT surface it as ReadTimeout during streaming: urllib3's
+        ReadTimeoutError raised inside iter_lines() arrives wrapped in
+        requests.exceptions.ConnectionError, so walk the wrapper chain
+        (args + __cause__/__context__). ConnectTimeout (server
+        unreachable) deliberately does NOT match — that needs the backoff
+        path, not a tight resume loop."""
+        seen: set = set()
+        cur: Optional[BaseException] = e
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            if isinstance(cur, requests.exceptions.ConnectTimeout):
+                return False
+            if isinstance(cur, requests.exceptions.ReadTimeout) or \
+                    type(cur).__name__ == "ReadTimeoutError":
+                return True
+            nxt = None
+            for arg in getattr(cur, "args", ()):
+                if isinstance(arg, BaseException):
+                    nxt = arg
+                    break
+            cur = nxt or cur.__cause__ or cur.__context__
+        return "Read timed out" in str(e)
+
     def watch(self, api_version, kind, handler: Callable[[WatchEvent], None]):
         """List+watch in a daemon thread (informer-lite). A dropped
         stream RESUMES from the last seen resourceVersion — the apiserver
@@ -393,6 +419,16 @@ class HTTPClient(Client):
                     # normal stream end (server recycle): loop resumes the
                     # watch from rv without re-listing
                 except Exception as e:
+                    if self._is_read_timeout(e):
+                        # quiet collection: the 300s read timeout fired
+                        # before the server recycled the stream. rv tracks
+                        # the last fully-parsed event, so resuming from it
+                        # is safe — nulling it would re-list + replay
+                        # ADDED for the whole collection every ~5min per
+                        # idle watcher.
+                        log.debug("watch %s idle read timeout; resuming "
+                                  "from rv=%s", kind, rv)
+                        continue
                     log.warning("watch %s failed (%s: %s); re-listing in 2s",
                                 kind, type(e).__name__, e)
                     rv = None  # transport fault: state unknown, re-list

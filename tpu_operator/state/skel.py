@@ -37,6 +37,10 @@ from ..utils.hash import object_hash
 log = logging.getLogger("tpu_operator.state")
 
 
+_fully_swept: set = set()  # state names that have had a full sweep since
+# process start — see the first-reconcile widening below
+
+
 def apply_objects(client: Client, owner: Optional[dict], state_name: str,
                   objects: Iterable[dict], namespace: str,
                   sweep_kinds: Optional[set] = None) -> List[dict]:
@@ -44,7 +48,17 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
     objects. Also deletes stale objects still labeled for this state but no
     longer desired (cleanupStale analog). ``sweep_kinds`` — the
     (apiVersion, kind) set this state's templates can possibly emit —
-    bounds the stale sweep; None sweeps every known kind."""
+    bounds the stale sweep; None sweeps every known kind.
+
+    The bound is ignored on each state's FIRST reconcile after operator
+    start: ``sweep_kinds`` is scanned from the templates on disk, so a
+    kind an older operator version emitted but this version's templates
+    dropped entirely would otherwise never be swept — the 'stale grant
+    survives forever' failure, reintroduced across operator upgrades.
+    Steady-state reconciles keep the bounded (cheap) sweep."""
+    full_sweep = state_name not in _fully_swept
+    if full_sweep:
+        sweep_kinds = None
     applied: List[dict] = []
     desired_keys = set()
     for obj in objects:
@@ -78,6 +92,11 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
         applied.append(client.update(merged))
         log.info("[%s] updated %s/%s", state_name, obj["kind"], name_of(obj))
     _delete_stale(client, state_name, desired_keys, namespace, sweep_kinds)
+    if full_sweep:
+        # only after the widened sweep actually ran: an exception during
+        # apply or sweep must leave the state unmarked so the reconcile
+        # retry still performs the full first-start sweep
+        _fully_swept.add(state_name)
     return applied
 
 
