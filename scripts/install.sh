@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command install/upgrade/uninstall for the TPU operator — the
+# reference's `helm install/upgrade/uninstall gpu-operator` UX
+# (deployments/gpu-operator/) without requiring Helm. Thin wrapper over
+# `tpuop-cfg install|upgrade|uninstall`, which renders the full stream
+# from a values file and applies it against $KUBECONFIG (or the
+# in-cluster service account).
+#
+#   scripts/install.sh install  [-f values.yaml] [-n namespace] [--wait]
+#   scripts/install.sh upgrade  [-f values.yaml] [-n namespace] [--wait]
+#   scripts/install.sh uninstall [--purge-crds]
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}"
+
+VERB="${1:-}"
+case "$VERB" in
+  install|upgrade|uninstall) shift ;;
+  *) echo "usage: $0 install|upgrade|uninstall [args]" >&2; exit 2 ;;
+esac
+
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -f|--values) ARGS+=(--values "$2"); shift 2 ;;
+    -n|--namespace) ARGS+=(-n "$2"); shift 2 ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+
+# stock distros ship python3 only; prefer it, fall back to python
+PY="$(command -v python3 || command -v python)" || {
+  echo "python3 not found" >&2; exit 127; }
+exec "$PY" -m tpu_operator.cli.tpuop_cfg "$VERB" "${ARGS[@]+"${ARGS[@]}"}"

@@ -10,7 +10,7 @@ from __future__ import annotations
 import datetime
 from typing import Optional
 
-from ..runtime.client import Client, ConflictError
+from ..runtime.client import Client, ConflictError, NotFoundError
 from ..runtime.objects import get_nested, name_of, namespace_of, set_nested
 
 COND_READY = "Ready"
@@ -66,12 +66,20 @@ def update_status_with_retry(client: Client, cr: dict,
         try:
             client.update_status(cr)
             return
+        except NotFoundError:
+            # the CR was deleted mid-reconcile (uninstall races the
+            # in-flight pass): there is no status left to write and the
+            # next reconcile observes the deletion — not an error
+            return
         except ConflictError:
             if attempt == attempts - 1:
                 raise
-            fresh = client.get(cr.get("apiVersion", ""),
-                               cr.get("kind", ""), name_of(cr),
-                               namespace_of(cr) or None)
+            try:
+                fresh = client.get(cr.get("apiVersion", ""),
+                                   cr.get("kind", ""), name_of(cr),
+                                   namespace_of(cr) or None)
+            except NotFoundError:
+                return  # deleted between the conflict and the re-get
             fresh["status"] = cr.get("status") or {}
             cr = fresh
 

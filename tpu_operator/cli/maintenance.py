@@ -65,11 +65,14 @@ def apply_crds(client: Client) -> int:
 
 
 def cleanup(client: Client, timeout_s: float = 300.0,
-            poll_s: float = 2.0) -> bool:
+            poll_s: float = 2.0, drop_crds: bool = True) -> bool:
     """Delete every TPUClusterPolicy/TPUDriver CR, wait for them to go
     (operands tear down via owner GC / the reconcilers' delete paths
     while the operator still runs), then drop the CRDs themselves — the
-    cleanup_crd.yaml pre-delete hook. Returns True when fully cleaned."""
+    cleanup_crd.yaml pre-delete hook. ``drop_crds=False`` keeps the CRDs
+    (the `tpuop-cfg uninstall` default: CRD removal is a separate,
+    explicit decision, like Helm's keep-CRDs-on-uninstall convention).
+    Returns True when fully cleaned."""
     for api_version, kind in CR_KINDS:
         try:
             for cr in client.list(api_version, kind):
@@ -99,6 +102,8 @@ def cleanup(client: Client, timeout_s: float = 300.0,
                   "place (finalizers/operands may still be tearing down)",
                   timeout_s, remaining)
         return False
+    if not drop_crds:
+        return True
     from ..api.crd import all_crds
 
     for crd in all_crds():

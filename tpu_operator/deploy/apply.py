@@ -1,0 +1,127 @@
+"""Take the rendered install stream to a cluster — the Helm-verb slot.
+
+The reference's primary install path is its chart
+(deployments/gpu-operator/values.yaml, templates/clusterpolicy.yaml):
+`helm install/upgrade --wait` and `helm uninstall` with pre-upgrade /
+pre-delete hook Jobs (templates/upgrade_crd.yaml, cleanup_crd.yaml).
+This framework renders the same stream offline (deploy/values.py); this
+module supplies the verbs so ONE command takes an empty cluster to
+all-operands-ready:
+
+    tpuop-cfg install  --values f.yaml --wait
+    tpuop-cfg upgrade  --values f.yaml --wait     # re-applies CRDs first
+    tpuop-cfg uninstall [--purge-crds]
+
+Create-or-update carries the live resourceVersion (optimistic
+concurrency); uninstall sequences the cleanup the way the pre-delete
+hook does: CRs first (operands tear down through owner GC while the
+operator still runs), then the operator stream, then optionally the
+CRDs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..api import KIND_CLUSTER_POLICY, V1
+from ..runtime.client import Client, NotFoundError
+from ..runtime.objects import name_of, namespace_of
+
+Log = Callable[[str], None]
+
+
+def _ident(doc: dict) -> Tuple[str, str, str, Optional[str]]:
+    return (doc.get("apiVersion", ""), doc.get("kind", ""),
+            name_of(doc), namespace_of(doc) or None)
+
+
+def apply_docs(client: Client, docs: List[dict],
+               log: Log = lambda s: None) -> List[Tuple[str, str, str]]:
+    """Create-or-update every document, in stream order (render_bundle
+    already emits install order: CRDs -> Namespace -> RBAC -> operator
+    -> CR, matching Helm's kind ordering). Returns (verb, kind, name)
+    per document."""
+    out: List[Tuple[str, str, str]] = []
+    for doc in docs:
+        av, kind, name, ns = _ident(doc)
+        existing = client.get_or_none(av, kind, name, ns)
+        if existing is None:
+            _create_with_establish_retry(client, doc)
+            verb = "created"
+        else:
+            merged = dict(doc)
+            merged.setdefault("metadata", {})
+            merged["metadata"]["resourceVersion"] = (
+                existing.get("metadata") or {}).get("resourceVersion")
+            client.update(merged)
+            verb = "configured"
+        log(f"{verb} {kind}/{name}")
+        out.append((verb, kind, name))
+    return out
+
+
+def _create_with_establish_retry(client: Client, doc: dict,
+                                 attempts: int = 10,
+                                 backoff_s: float = 1.0) -> None:
+    """Create, riding out the CRD-establishment window: on a real
+    apiserver a CR POSTed right after its CRD returns 404 'no matches
+    for kind' until the discovery cache catches up (a few seconds). Only
+    custom-group kinds get the retry — a 404 on a built-in kind is a
+    genuine error."""
+    last: Optional[Exception] = None
+    n = attempts if "." in doc.get("apiVersion", "").split("/")[0] else 1
+    for attempt in range(n):
+        try:
+            client.create(doc)
+            return
+        except NotFoundError as e:
+            last = e
+            if attempt < n - 1:
+                time.sleep(backoff_s)
+    raise last  # type: ignore[misc]
+
+
+def delete_docs(client: Client, docs: List[dict], log: Log = lambda s: None,
+                keep_kinds: Tuple[str, ...] = ()) -> int:
+    """Delete the stream in reverse order (CR before its CRD, workloads
+    before RBAC), ignoring already-gone objects. ``keep_kinds`` skips
+    kinds the caller wants to survive (Namespace by default at the CLI:
+    deleting a shared namespace is not an uninstaller's call)."""
+    deleted = 0
+    for doc in reversed(docs):
+        av, kind, name, ns = _ident(doc)
+        if kind in keep_kinds:
+            continue
+        try:
+            client.delete(av, kind, name, ns)
+            log(f"deleted {kind}/{name}")
+            deleted += 1
+        except NotFoundError:
+            pass
+    return deleted
+
+
+def wait_policy_ready(client: Client, timeout_s: float = 300.0,
+                      poll_s: float = 2.0,
+                      log: Log = lambda s: None) -> bool:
+    """Block until every TPUClusterPolicy reports status.state == ready —
+    the `helm install --wait` contract, with the reference e2e's 5-minute
+    default budget (tests/e2e/gpu_operator_test.go:83-88)."""
+    deadline = time.monotonic() + timeout_s
+    last = "no TPUClusterPolicy observed yet"
+    while time.monotonic() < deadline:
+        try:
+            crs = client.list(V1, KIND_CLUSTER_POLICY)
+        except NotFoundError:
+            crs = []
+        if crs:
+            states = {name_of(c): ((c.get("status") or {}).get("state")
+                                   or "unset") for c in crs}
+            if all(s == "ready" for s in states.values()):
+                log(f"ready: {states}")
+                return True
+            last = str(states)
+        time.sleep(poll_s)
+    log(f"timed out after {timeout_s:.0f}s waiting for ready; last: {last}")
+    return False

@@ -135,6 +135,9 @@ def render_csv(values: Dict[str, Any]) -> dict:
                 "description": "Automates TPU software stack lifecycle "
                                "management in Kubernetes",
                 "support": PACKAGE_NAME,
+                # OLM reads this from the CSV object (the copy in
+                # metadata/annotations.yaml is informational)
+                "operatorframework.io/suggested-namespace": "tpu-operator",
             },
         },
         "spec": {
@@ -176,7 +179,9 @@ def render_csv(values: Dict[str, Any]) -> dict:
 
 
 def bundle_annotations() -> dict:
-    """metadata/annotations.yaml content of an OLM registry+v1 bundle."""
+    """metadata/annotations.yaml content of an OLM registry+v1 bundle,
+    including the scorecard test-config pointers OLM tooling reads
+    (ref bundle/metadata/annotations.yaml)."""
     return {
         "annotations": {
             "operators.operatorframework.io.bundle.mediatype.v1":
@@ -189,7 +194,37 @@ def bundle_annotations() -> dict:
                 DEFAULT_CHANNEL,
             "operators.operatorframework.io.bundle.channel.default.v1":
                 DEFAULT_CHANNEL,
+            "operators.operatorframework.io.test.config.v1":
+                "tests/scorecard/",
+            "operators.operatorframework.io.test.mediatype.v1":
+                "scorecard+v1",
+            "operatorframework.io/suggested-namespace": "tpu-operator",
         },
+    }
+
+
+def scorecard_config() -> dict:
+    """tests/scorecard/config.yaml — the operator-sdk scorecard stages
+    the reference bundle carries (bundle/tests/scorecard/config.yaml):
+    basic spec sanity plus OLM bundle validation, run in parallel."""
+    test = "quay.io/operator-framework/scorecard-test:latest"
+    return {
+        "kind": "Configuration",
+        "apiVersion": "scorecard.operatorframework.io/v1alpha3",
+        "metadata": {"name": "config"},
+        "stages": [{
+            "parallel": True,
+            "tests": [
+                {"image": test,
+                 "entrypoint": ["scorecard-test", "basic-check-spec"],
+                 "labels": {"suite": "basic",
+                            "test": "basic-check-spec-test"}},
+                {"image": test,
+                 "entrypoint": ["scorecard-test", "olm-bundle-validation"],
+                 "labels": {"suite": "olm",
+                            "test": "olm-bundle-validation-test"}},
+            ],
+        }],
     }
 
 
@@ -197,3 +232,38 @@ def render_bundle_stream(values: Dict[str, Any]) -> List[dict]:
     """The full bundle: CSV + owned CRDs (the manifests/ dir content)
     followed by the bundle annotations (the metadata/ dir content)."""
     return [render_csv(values)] + all_crds() + [bundle_annotations()]
+
+
+def write_bundle_dir(values: Dict[str, Any], out_dir: str) -> List[str]:
+    """Write the registry+v1 bundle DIRECTORY layout OLM tooling
+    consumes (`opm`, `operator-sdk bundle validate`, scorecard):
+
+        manifests/<csv>.clusterserviceversion.yaml + one file per CRD
+        metadata/annotations.yaml
+        tests/scorecard/config.yaml
+
+    CRD filenames follow the reference's `<group>_<plural>.yaml` form
+    (bundle/v24.3.0/manifests/nvidia.com_clusterpolicies.yaml). Returns
+    the relative paths written."""
+    import os
+
+    import yaml
+
+    def write(rel: str, doc: dict) -> str:
+        path = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+        return rel
+
+    written = [write(
+        f"manifests/{PACKAGE_NAME}.clusterserviceversion.yaml",
+        render_csv(values))]
+    for crd in all_crds():
+        group, plural = crd["spec"]["group"], crd["spec"]["names"]["plural"]
+        written.append(write(f"manifests/{group}_{plural}.yaml", crd))
+    written.append(write("metadata/annotations.yaml",
+                         bundle_annotations()))
+    written.append(write("tests/scorecard/config.yaml",
+                         scorecard_config()))
+    return written
