@@ -123,4 +123,23 @@ test -f "$TPU_VALIDATION_DIR/vtpu-ready"
 unset TPU_FENCING_FILE TPU_VTPU_FILE TPU_FAKE_CHIPS TPU_WORKLOAD_CONFIG
 stage isolated-plane
 
+# -- optional live-cluster tier (the holodeck/kind slot) ------------------
+# Opt-in: TPUOP_E2E_LIVE=1 with KUBECONFIG pointing at a real cluster
+# (e.g. kind) runs the actual lifecycle there: install --wait, drift
+# check, uninstall. The reference runs this tier on provisioned cloud
+# instances (tests/holodeck.yaml, tests/e2e/gpu_operator_test.go:36-100);
+# without TPU nodes the CR sits notReady, so --wait is only enforced
+# when TPUOP_E2E_EXPECT_READY=1 (a cluster with TPU-labeled nodes).
+if [[ "${TPUOP_E2E_LIVE:-}" == "1" && -n "${KUBECONFIG:-}" ]]; then
+  if [[ "${TPUOP_E2E_EXPECT_READY:-}" == "1" ]]; then
+    $PY -m tpu_operator.cli.tpuop_cfg install --wait \
+        --timeout "${TPUOP_E2E_TIMEOUT:-300}"
+  else
+    $PY -m tpu_operator.cli.tpuop_cfg install
+  fi
+  $PY -m tpu_operator.cli.tpuop_cfg diff       # fresh install: no drift
+  $PY -m tpu_operator.cli.tpuop_cfg uninstall --purge-crds
+  stage live-cluster
+fi
+
 echo "END_TO_END_OK"

@@ -23,8 +23,13 @@ esac
 ARGS=()
 while [[ $# -gt 0 ]]; do
   case "$1" in
-    -f|--values) ARGS+=(--values "$2"); shift 2 ;;
-    -n|--namespace) ARGS+=(-n "$2"); shift 2 ;;
+    -f|--values|-n|--namespace)
+      [[ $# -ge 2 ]] || { echo "error: $1 requires a value" >&2; exit 2; }
+      case "$1" in
+        -f|--values) ARGS+=(--values "$2") ;;
+        *) ARGS+=(-n "$2") ;;
+      esac
+      shift 2 ;;
     *) ARGS+=("$1"); shift ;;
   esac
 done

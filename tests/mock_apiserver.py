@@ -361,7 +361,22 @@ class _Handler(BaseHTTPRequestHandler):
         with self.st.lock:
             self.st.objects[path] = body
         self.st.publish("ADDED", path, body)
+        # the GC controller's job: an object created with an ownerRef to
+        # an already-deleted owner (an in-flight reconcile racing a
+        # cascade delete) is accepted and then collected, like a real
+        # cluster — without this, such orphans live forever in the mock
+        if self._dangling_owner(body):
+            self.st.cascade_delete(path)
         self._send(201, body)
+
+    def _dangling_owner(self, obj: dict) -> bool:
+        refs = (obj.get("metadata") or {}).get("ownerReferences") or []
+        if not refs:
+            return False
+        with self.st.lock:
+            live = {(o.get("metadata") or {}).get("uid")
+                    for o in self.st.objects.values()}
+        return any(r.get("uid") and r["uid"] not in live for r in refs)
 
     def _admission(self, coll_path: str, new: dict, old):
         """Registered-CRD admission: structural schema + CEL transition

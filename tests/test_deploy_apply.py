@@ -1,0 +1,147 @@
+"""Unit tests for deploy/apply.py — the Helm-verb engine underneath
+tpuop-cfg install/upgrade/uninstall (the e2e lifecycle lives in
+test_install_e2e.py; these pin the edge semantics)."""
+
+import pytest
+
+from tpu_operator.deploy import apply as apply_mod
+from tpu_operator.runtime.client import NotFoundError
+from tpu_operator.runtime.fake import FakeClient
+
+
+def doc(kind, name, api="v1", ns=None, **spec):
+    d = {"apiVersion": api, "kind": kind,
+         "metadata": {"name": name}, "spec": spec or {}}
+    if ns:
+        d["metadata"]["namespace"] = ns
+    return d
+
+
+class TestApplyDocs:
+    def test_create_then_configure(self):
+        c = FakeClient()
+        stream = [doc("ConfigMap", "a", ns="x")]
+        out = apply_mod.apply_docs(c, stream)
+        assert out == [("created", "ConfigMap", "a")]
+        stream[0]["spec"] = {"k": "v2"}
+        out = apply_mod.apply_docs(c, stream)
+        assert out == [("configured", "ConfigMap", "a")]
+        assert c.get("v1", "ConfigMap", "a", "x")["spec"] == {"k": "v2"}
+
+    def test_configure_carries_live_resource_version(self):
+        c = FakeClient()
+        apply_mod.apply_docs(c, [doc("ConfigMap", "a", ns="x")])
+        live = c.get("v1", "ConfigMap", "a", "x")
+        rv = live["metadata"]["resourceVersion"]
+        apply_mod.apply_docs(c, [doc("ConfigMap", "a", ns="x", k="v2")])
+        live2 = c.get("v1", "ConfigMap", "a", "x")
+        assert live2["metadata"]["resourceVersion"] != rv
+
+    def test_cr_create_retries_when_its_crd_ships_in_stream(self,
+                                                            monkeypatch):
+        """A CR POSTed right after its CRD 404s on a real apiserver until
+        discovery catches up; apply rides it out — but ONLY for groups
+        whose CRD is part of the same stream."""
+        calls = []
+
+        class Flaky:
+            def get_or_none(self, *a, **kw):
+                return None
+
+            def create(self, d):
+                if d.get("kind") == "CustomResourceDefinition":
+                    return d
+                calls.append(1)
+                if len(calls) < 3:
+                    raise NotFoundError("no matches for kind")
+                return d
+
+        monkeypatch.setattr(apply_mod.time, "sleep", lambda s: None)
+        crd = {"apiVersion": "apiextensions.k8s.io/v1",
+               "kind": "CustomResourceDefinition",
+               "metadata": {"name": "tpudrivers.tpu.graft.dev"},
+               "spec": {"group": "tpu.graft.dev",
+                        "names": {"plural": "tpudrivers"}}}
+        out = apply_mod.apply_docs(
+            Flaky(),
+            [crd, doc("TPUDriver", "d", api="tpu.graft.dev/v1alpha1")])
+        assert ("created", "TPUDriver", "d") in out
+        assert len(calls) == 3
+
+    def test_404_without_stream_crd_is_immediate(self, monkeypatch):
+        """Built-in kinds AND dotted groups whose CRD is absent from the
+        stream (rbac.authorization.k8s.io, missing third-party CRDs)
+        fail immediately — no establishment window applies to them."""
+        calls = []
+
+        class Flaky:
+            def get_or_none(self, *a, **kw):
+                return None
+
+            def create(self, d):
+                calls.append(1)
+                raise NotFoundError("nope")
+
+        monkeypatch.setattr(
+            apply_mod.time, "sleep",
+            lambda s: pytest.fail("must not sleep without a stream CRD"))
+        for d in (doc("ConfigMap", "a"),
+                  doc("ServiceMonitor", "m",
+                      api="monitoring.coreos.com/v1")):
+            calls.clear()
+            with pytest.raises(NotFoundError):
+                apply_mod.apply_docs(Flaky(), [d])
+            assert len(calls) == 1
+
+    def test_apply_does_not_mutate_caller_docs(self):
+        """The rendered stream may be reused (reinstall after delete); a
+        resourceVersion stamped into the caller's doc would poison the
+        later create."""
+        c = FakeClient()
+        stream = [doc("ConfigMap", "a", ns="x")]
+        apply_mod.apply_docs(c, stream)
+        stream[0]["spec"] = {"k": "v2"}
+        apply_mod.apply_docs(c, stream)  # configure path
+        assert "resourceVersion" not in stream[0]["metadata"]
+
+
+class TestDeleteDocs:
+    def test_reverse_order_and_keep_kinds(self):
+        c = FakeClient()
+        stream = [doc("Namespace", "ns1"),
+                  doc("ConfigMap", "a", ns="ns1"),
+                  doc("Service", "s", ns="ns1")]
+        apply_mod.apply_docs(c, stream)
+        deleted = apply_mod.delete_docs(c, stream,
+                                        keep_kinds=("Namespace",))
+        assert deleted == 2
+        assert c.get_or_none("v1", "Namespace", "ns1") is not None
+        assert c.get_or_none("v1", "ConfigMap", "a", "ns1") is None
+
+    def test_already_gone_is_fine(self):
+        c = FakeClient()
+        assert apply_mod.delete_docs(c, [doc("ConfigMap", "a")]) == 0
+
+
+class TestWaitPolicyReady:
+    def test_ready_cr_returns_true(self):
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+
+        c = FakeClient()
+        cr = new_cluster_policy()
+        cr["status"] = {"state": "ready"}
+        c.create(cr)
+        assert apply_mod.wait_policy_ready(c, timeout_s=2.0,
+                                           poll_s=0.05) is True
+
+    def test_never_ready_times_out_false(self):
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+
+        c = FakeClient()
+        c.create(new_cluster_policy())
+        assert apply_mod.wait_policy_ready(c, timeout_s=0.3,
+                                           poll_s=0.05) is False
+
+    def test_no_cr_times_out_false(self):
+        assert apply_mod.wait_policy_ready(FakeClient(), timeout_s=0.2,
+                                           poll_s=0.05) is False

@@ -96,6 +96,42 @@ class TestHTTPAndRemoteExporter:
         text = exporter.render().decode()
         assert 'tpu_hbm_total_bytes{chip="accel0",node="n1"}' in text
 
+    def test_unknown_hbm_usage_is_not_a_confident_zero(self, monkeypatch):
+        """ADVICE r3: when memory accounting is unavailable and hbm_total
+        is datasheet-derived, the exporter must say so instead of serving
+        used=0 — a dashboard can't tell an idle chip from missing
+        telemetry otherwise."""
+        from tpu_operator.metrics import libtpu_exporter as le
+
+        samples = [
+            le.ChipSample("chip0", hbm_used=0, hbm_total=16 << 30,
+                          hbm_usage_known=False),
+            le.ChipSample("chip1", hbm_used=1 << 30, hbm_total=16 << 30),
+        ]
+        monkeypatch.setattr(le, "collect", lambda: samples)
+        exporter = LibtpuExporter(node_name="n1")
+        assert exporter.collect_once() == 2
+        text = exporter.render().decode()
+        # the unknown chip: total present, usage series ABSENT, flag 0
+        assert 'tpu_hbm_total_bytes{chip="chip0",node="n1"}' in text
+        assert 'tpu_hbm_used_bytes{chip="chip0"' not in text
+        assert 'tpu_hbm_usage_known{chip="chip0",node="n1"} 0.0' in text
+        # the measured chip keeps the usage series and flags known
+        assert 'tpu_hbm_used_bytes{chip="chip1",node="n1"}' in text
+        assert 'tpu_hbm_usage_known{chip="chip1",node="n1"} 1.0' in text
+
+    def test_usage_known_round_trips_through_remote_engine(self):
+        from tpu_operator.metrics import libtpu_exporter as le
+        from tpu_operator.metrics.health_engine import (
+            sample_from_dict,
+            sample_to_dict,
+        )
+
+        s = le.ChipSample("c", hbm_total=16 << 30, hbm_usage_known=False)
+        assert sample_from_dict(sample_to_dict(s)).hbm_usage_known is False
+        s2 = le.ChipSample("c", hbm_used=1, hbm_total=2)
+        assert sample_from_dict(sample_to_dict(s2)).hbm_usage_known is True
+
 
 class TestOperandWiring:
     def mk_ctx(self, spec_dict):

@@ -140,6 +140,12 @@ def update_spec(ops, mutate):
 
 class TestHTTPLifecycle:
     def test_install_to_ready_and_uninstall(self, cluster):
+        # the gauge is a process-global singleton another test may have
+        # already set for the same policy name; clear it so the
+        # assertion below proves THIS run recorded it
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        OPERATOR_METRICS.install_to_ready.clear()
         srv, ops = cluster
         t_install = time.time()
         install(ops)

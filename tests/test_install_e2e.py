@@ -178,6 +178,24 @@ def test_install_rejects_invalid_values(cluster, capsys):
                     "CustomResourceDefinition") == []
 
 
+def test_diff_clean_after_install_then_flags_manual_edit(cluster, capsys):
+    """The kubectl-diff/helm-diff slot composes with the install verb: a
+    fresh install has zero drift; a manual kubectl-edit is flagged with
+    rc 1 (ref: config drift the operator would revert)."""
+    srv, ops = cluster
+    assert tpuop_cfg.main(["install"]) == 0
+    capsys.readouterr()
+    assert tpuop_cfg.main(["diff"]) == 0, capsys.readouterr().out
+
+    # a cluster-admin hand-edits the operator Deployment
+    dep = ops.get("apps/v1", "Deployment", "tpu-operator", NS)
+    dep["spec"]["replicas"] = 5
+    ops.update(dep)
+    assert tpuop_cfg.main(["diff"]) == 1
+    out = capsys.readouterr().out
+    assert "Deployment" in out
+
+
 def test_install_wall_time_stays_inside_budget(cluster):
     """BASELINE target #1 measured end to end through the install verb:
     install + operator boot -> all-operands-ready under 5 minutes."""

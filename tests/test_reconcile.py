@@ -395,6 +395,7 @@ def test_template_kinds_scan_includes_conditional_docs():
     assert ("monitoring.coreos.com/v1", "PrometheusRule") in om.sweep_kinds()
 
 
+@pytest.mark.soak  # ~35s 200-node sweep: scale tier, not the unit path
 class TestScale:
     """Operational-performance guard: the reconcile loop's contract is
     all-operands-Ready well under the reference's 5-minute install

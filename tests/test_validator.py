@@ -122,6 +122,7 @@ class TestComponents:
         assert info.get("DEVICES") == "8"
         assert "BUS_BW_GBPS" in info
 
+    @pytest.mark.jax  # compiles the full collective suite (~35s)
     def test_ici_full_suite_reports_every_primitive(self, valdir,
                                                     monkeypatch):
         """ICI_FULL_SUITE=true adds one oracle-checked bus figure per

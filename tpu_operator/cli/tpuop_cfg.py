@@ -72,58 +72,6 @@ def _generate_docs(args):
     return generate(args.what, namespace=namespace, image=args.image)
 
 
-def _sweep_operands(client, log, settle_s: float = 0.5,
-                    max_s: float = 30.0) -> int:
-    """Delete any operand object still carrying the state label after CR
-    teardown. Owner GC removes almost everything, but a reconcile pass
-    that fetched the CR just before deletion keeps applying states for
-    several seconds afterward, re-creating operands with dangling
-    ownerRefs (cluster GC would collect them eventually — an uninstaller
-    shouldn't leave that to chance). Sweep repeatedly until two
-    consecutive passes find nothing, so the in-flight pass has drained."""
-    import time as _time
-
-    from ..api.labels import STATE_LABEL
-    from ..runtime.client import NotFoundError
-    from ..runtime.objects import labels_of, name_of, namespace_of
-    from ..state.skel import SWEEPABLE_KINDS
-
-    from ..runtime.client import ListOptions
-
-    exists = ListOptions(label_selector={"matchExpressions": [
-        {"key": STATE_LABEL, "operator": "Exists"}]})
-
-    def one_pass() -> int:
-        n = 0
-        for av, kind in SWEEPABLE_KINDS:
-            try:
-                objs = client.list(av, kind, exists)
-            except NotFoundError:
-                continue
-            for obj in objs:
-                if STATE_LABEL not in labels_of(obj):
-                    continue
-                try:
-                    client.delete(av, kind, name_of(obj),
-                                  namespace_of(obj) or None)
-                    log(f"swept leftover {kind}/{name_of(obj)}")
-                    n += 1
-                except NotFoundError:
-                    pass
-        return n
-
-    swept = 0
-    clean = 0
-    deadline = _time.monotonic() + max_s
-    while clean < 2 and _time.monotonic() < deadline:
-        n = one_pass()
-        swept += n
-        clean = clean + 1 if n == 0 else 0
-        if clean < 2:
-            _time.sleep(settle_s)
-    return swept
-
-
 def _lifecycle(args) -> int:
     """install / upgrade / uninstall against the cluster KubeConfig.load()
     resolves (in-cluster SA or $KUBECONFIG) — the Helm-verb UX without
@@ -176,7 +124,7 @@ def _lifecycle_verbs(args, client, docs, log) -> int:
             print("uninstall incomplete: CRs still present",
                   file=sys.stderr)
             return 1
-        swept = _sweep_operands(client, log)
+        swept = apply_mod.sweep_operands(client, log)
         keep = ("Namespace", "CustomResourceDefinition") \
             if not args.purge_crds else ("Namespace",)
         n = apply_mod.delete_docs(client, docs, log=log, keep_kinds=keep)
@@ -192,7 +140,8 @@ def _lifecycle_verbs(args, client, docs, log) -> int:
         apply_crds(client)
     summary = apply_mod.apply_docs(client, docs, log=log)
     created = sum(1 for v, _, _ in summary if v == "created")
-    print(f"{args.cmd}ed: {created} created, "
+    past = {"install": "installed", "upgrade": "upgraded"}[args.cmd]
+    print(f"{past}: {created} created, "
           f"{len(summary) - created} configured")
     if args.wait:
         ok = apply_mod.wait_policy_ready(client, timeout_s=args.timeout,

@@ -106,7 +106,13 @@ class ClusterPolicyReconciler(Reconciler):
             self._first_seen.pop(request.name, None)
             self._ready_recorded.discard(request.name)
             return Result()
-        self._first_seen.setdefault(request.name, _time.monotonic())
+        if request.name not in self._first_seen:
+            self._first_seen[request.name] = _time.monotonic()
+            if get_nested(cr, "status", "state") == STATE_READY:
+                # an operator restart observing an already-ready CR is
+                # not an install — recording it would overwrite the real
+                # install figure with near-zero
+                self._ready_recorded.add(request.name)
 
         # singleton: the oldest CR by (creationTimestamp, name) wins
         all_crs = self.client.list(V1, KIND_CLUSTER_POLICY)

@@ -42,16 +42,24 @@ def apply_docs(client: Client, docs: List[dict],
     already emits install order: CRDs -> Namespace -> RBAC -> operator
     -> CR, matching Helm's kind ordering). Returns (verb, kind, name)
     per document."""
+    # groups whose CRDs ship in this very stream: only their CRs can hit
+    # the establishment window and deserve the create retry
+    stream_groups = {d.get("spec", {}).get("group", "")
+                     for d in docs
+                     if d.get("kind") == "CustomResourceDefinition"}
     out: List[Tuple[str, str, str]] = []
     for doc in docs:
         av, kind, name, ns = _ident(doc)
         existing = client.get_or_none(av, kind, name, ns)
         if existing is None:
-            _create_with_establish_retry(client, doc)
+            _create_with_establish_retry(client, doc, stream_groups)
             verb = "created"
         else:
+            # never mutate the caller's rendered doc: the stream may be
+            # reused (reinstall, delete) and a stamped resourceVersion
+            # would then poison a later create
             merged = dict(doc)
-            merged.setdefault("metadata", {})
+            merged["metadata"] = dict(doc.get("metadata") or {})
             merged["metadata"]["resourceVersion"] = (
                 existing.get("metadata") or {}).get("resourceVersion")
             client.update(merged)
@@ -62,15 +70,19 @@ def apply_docs(client: Client, docs: List[dict],
 
 
 def _create_with_establish_retry(client: Client, doc: dict,
+                                 stream_groups: set,
                                  attempts: int = 10,
                                  backoff_s: float = 1.0) -> None:
     """Create, riding out the CRD-establishment window: on a real
     apiserver a CR POSTed right after its CRD returns 404 'no matches
     for kind' until the discovery cache catches up (a few seconds). Only
-    custom-group kinds get the retry — a 404 on a built-in kind is a
-    genuine error."""
+    CRs of groups whose CRD ships in the SAME stream get the retry — a
+    404 on anything else (built-in kinds, dotted built-in groups like
+    rbac.authorization.k8s.io, absent third-party CRDs) is a genuine
+    error and fails immediately."""
     last: Optional[Exception] = None
-    n = attempts if "." in doc.get("apiVersion", "").split("/")[0] else 1
+    group = doc.get("apiVersion", "").split("/")[0]
+    n = attempts if group in stream_groups else 1
     for attempt in range(n):
         try:
             client.create(doc)
@@ -100,6 +112,54 @@ def delete_docs(client: Client, docs: List[dict], log: Log = lambda s: None,
         except NotFoundError:
             pass
     return deleted
+
+
+def sweep_operands(client: Client, log: Log = lambda s: None,
+                   settle_s: float = 0.5, max_s: float = 30.0) -> int:
+    """Delete any operand object still carrying the state label after CR
+    teardown. Owner GC removes almost everything, but a reconcile pass
+    that fetched the CR just before deletion keeps applying states for
+    several seconds afterward, re-creating operands with dangling
+    ownerRefs (cluster GC would collect them eventually — an uninstaller
+    shouldn't leave that to chance). Sweep repeatedly until two
+    consecutive passes find nothing, so the in-flight pass has drained."""
+    from ..api.labels import STATE_LABEL
+    from ..runtime.client import ListOptions
+    from ..runtime.objects import labels_of
+    from ..state.skel import SWEEPABLE_KINDS
+
+    exists = ListOptions(label_selector={"matchExpressions": [
+        {"key": STATE_LABEL, "operator": "Exists"}]})
+
+    def one_pass() -> int:
+        n = 0
+        for av, kind in SWEEPABLE_KINDS:
+            try:
+                objs = client.list(av, kind, exists)
+            except NotFoundError:
+                continue
+            for obj in objs:
+                if STATE_LABEL not in labels_of(obj):
+                    continue
+                try:
+                    client.delete(av, kind, name_of(obj),
+                                  namespace_of(obj) or None)
+                    log(f"swept leftover {kind}/{name_of(obj)}")
+                    n += 1
+                except NotFoundError:
+                    pass
+        return n
+
+    swept = 0
+    clean = 0
+    deadline = time.monotonic() + max_s
+    while clean < 2 and time.monotonic() < deadline:
+        n = one_pass()
+        swept += n
+        clean = clean + 1 if n == 0 else 0
+        if clean < 2:
+            time.sleep(settle_s)
+    return swept
 
 
 def wait_policy_ready(client: Client, timeout_s: float = 300.0,

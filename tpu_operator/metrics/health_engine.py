@@ -48,6 +48,7 @@ def sample_to_dict(s: ChipSample) -> Dict:
         "hbm_total": s.hbm_total,
         "tensorcore_util_pct": s.tensorcore_util_pct,
         "temperature_c": s.temperature_c,
+        "hbm_usage_known": getattr(s, "hbm_usage_known", True),
     }
 
 
@@ -58,7 +59,8 @@ def sample_from_dict(d: Dict) -> ChipSample:
         hbm_used=d.get("hbm_used", 0),
         hbm_total=d.get("hbm_total", 0),
         tensorcore_util_pct=d.get("tensorcore_util_pct", 0.0),
-        temperature_c=d.get("temperature_c"))
+        temperature_c=d.get("temperature_c"),
+        hbm_usage_known=d.get("hbm_usage_known", True))
 
 
 def evaluate_chip(s: ChipSample) -> Dict:

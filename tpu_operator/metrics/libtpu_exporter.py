@@ -35,13 +35,19 @@ class ChipSample:
     def __init__(self, chip_id: str, duty_cycle_pct: float = 0.0,
                  hbm_used: int = 0, hbm_total: int = 0,
                  tensorcore_util_pct: float = 0.0,
-                 temperature_c: Optional[float] = None):
+                 temperature_c: Optional[float] = None,
+                 hbm_usage_known: bool = True):
         self.chip_id = chip_id
         self.duty_cycle_pct = duty_cycle_pct
         self.hbm_used = hbm_used
         self.hbm_total = hbm_total
         self.tensorcore_util_pct = tensorcore_util_pct
         self.temperature_c = temperature_c
+        # False when the backend exposes no memory accounting and
+        # hbm_total fell back to the datasheet capacity: a dashboard must
+        # be able to tell an idle chip (used=0, known) from missing
+        # telemetry (used unobservable)
+        self.hbm_usage_known = hbm_usage_known
 
 
 def collect_fake() -> List[ChipSample]:
@@ -123,10 +129,13 @@ def collect_jax() -> List[ChipSample]:
         except Exception:
             pass
         hbm_total = stats.get("bytes_limit", 0)
+        usage_known = bool(hbm_total)
         if not hbm_total:
             # remote-PJRT backends (the tunneled-chip harness) expose no
             # memory_stats; the chip's datasheet capacity is still a true
-            # fact about the hardware and beats reporting 0 HBM
+            # fact about the hardware and beats reporting 0 HBM — but
+            # usage is then unobservable, and the sample says so instead
+            # of a confident used=0
             from ..workloads.hardware import chip_spec_for
 
             spec = chip_spec_for(getattr(d, "device_kind", ""))
@@ -135,7 +144,8 @@ def collect_jax() -> List[ChipSample]:
         out.append(ChipSample(
             f"chip{d.id}",
             hbm_used=stats.get("bytes_in_use", 0),
-            hbm_total=hbm_total))
+            hbm_total=hbm_total,
+            hbm_usage_known=usage_known))
     return out
 
 
@@ -192,6 +202,11 @@ class LibtpuExporter:
                             "TensorCore duty cycle (%)")
         self.hbm_used = g("tpu_hbm_used_bytes", "HBM bytes in use")
         self.hbm_total = g("tpu_hbm_total_bytes", "HBM capacity bytes")
+        self.hbm_usage_known = g(
+            "tpu_hbm_usage_known",
+            "1 when HBM usage is measured; 0 when the backend exposes no "
+            "memory accounting (tpu_hbm_used_bytes is then absent and "
+            "tpu_hbm_total_bytes is datasheet-derived)")
         self.tc_util = g("tpu_tensorcore_utilization_percent",
                          "TensorCore utilization (%)")
         self.temperature = g("tpu_temperature_celsius", "Chip temperature")
@@ -210,13 +225,18 @@ class LibtpuExporter:
         # drop series for chips that disappeared — serving a vanished
         # chip's last values forever would hide the failure from alerts
         for gauge in (self.duty_cycle, self.hbm_used, self.hbm_total,
-                      self.tc_util, self.temperature):
+                      self.tc_util, self.temperature,
+                      self.hbm_usage_known):
             gauge.clear()
         self.chips.labels(node=self.node_name).set(len(samples))
         for s in samples:
             lab = dict(chip=s.chip_id, node=self.node_name)
             self.duty_cycle.labels(**lab).set(s.duty_cycle_pct)
-            self.hbm_used.labels(**lab).set(s.hbm_used)
+            self.hbm_usage_known.labels(**lab).set(
+                1 if s.hbm_usage_known else 0)
+            if s.hbm_usage_known:
+                # an unobservable usage must not serve as a confident 0%
+                self.hbm_used.labels(**lab).set(s.hbm_used)
             self.hbm_total.labels(**lab).set(s.hbm_total)
             self.tc_util.labels(**lab).set(s.tensorcore_util_pct)
             if s.temperature_c is not None:
