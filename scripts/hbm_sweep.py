@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""One-shot HBM STREAM-triad tuning sweep on the live chip.
+
+Runs pallas_probe across a small (size_mb, iters) grid in ONE process
+(one backend init — chip-hygiene: never spawn parallel JAX clients at a
+tunneled chip) and prints a JSON report. Used to pick the bench's triad
+configuration; the round-3 matmul sweep (BENCH_LOCAL_r03.json) is the
+pattern.
+
+    python scripts/hbm_sweep.py            # defaults
+    python scripts/hbm_sweep.py --quick    # 3-point grid
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    sys.path.insert(0, ".")
+    from tpu_operator.workloads import backend, pallas_probe
+
+    # JAX_PLATFORMS must stay authoritative even under the axon plugin
+    # (a cpu-pinned smoke must never block on the remote tunnel)
+    backend.honor_jax_platforms_env()
+    devices = backend.init_devices(attempts=1)
+    if devices[0].platform != "tpu":
+        print(json.dumps({"error": f"platform={devices[0].platform}, "
+                                   f"not tpu"}))
+        return 1
+    grid = [(256.0, 24), (512.0, 24), (1024.0, 24)] if args.quick else [
+        (256.0, 24), (512.0, 16), (512.0, 24), (512.0, 48),
+        (1024.0, 24), (2048.0, 16), (2048.0, 24)]
+    results = {}
+    best = (None, 0.0)
+    for size_mb, iters in grid:
+        r = pallas_probe.run(size_mb=size_mb, iters=iters, repeats=2)
+        key = f"{size_mb:.0f}MBx{iters}"
+        results[key] = {
+            "bandwidth_gbps": round(r.bandwidth_gbps, 1),
+            "fraction_of_peak": (round(r.fraction_of_peak, 4)
+                                 if r.fraction_of_peak is not None else None),
+            "correct": r.correct,
+        }
+        print(f"# {key}: {results[key]}", file=sys.stderr)
+        frac = r.fraction_of_peak or 0.0
+        if r.correct and frac > best[1]:
+            best = (key, frac)
+    print(json.dumps({"device_kind": getattr(devices[0], "device_kind", ""),
+                      "results": results,
+                      "best": {"config": best[0],
+                               "fraction_of_peak": round(best[1], 4)}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

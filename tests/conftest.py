@@ -54,12 +54,12 @@ TIER_BY_MODULE = {
 }
 
 
-def pytest_configure(config):
-    for tier in ("unit", "e2e", "jax", "soak", "shell", "bench"):
-        config.addinivalue_line("markers", f"{tier}: {tier} test tier")
-
-
 TIERS = ("unit", "e2e", "jax", "soak", "shell", "bench")
+
+
+def pytest_configure(config):
+    for tier in TIERS:
+        config.addinivalue_line("markers", f"{tier}: {tier} test tier")
 
 
 def pytest_collection_modifyitems(config, items):
