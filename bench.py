@@ -110,6 +110,10 @@ def _scrape_telemetry(platform: str) -> dict | None:
             "chips": len(samples),
             "hbm_total_bytes": sum(s.hbm_total for s in samples),
             "hbm_used_bytes": sum(s.hbm_used for s in samples),
+            # False when the backend exposes no memory accounting (the
+            # used figure is then unobservable, not a measured zero)
+            "hbm_usage_known": all(
+                getattr(s, "hbm_usage_known", True) for s in samples),
             "exporter_scrape_series": series,
             "exporter_scrape_has_hbm_total":
                 "tpu_hbm_total_bytes" in text,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -23,13 +24,19 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
     from tpu_operator.workloads import backend, pallas_probe
 
     # JAX_PLATFORMS must stay authoritative even under the axon plugin
     # (a cpu-pinned smoke must never block on the remote tunnel)
     backend.honor_jax_platforms_env()
-    devices = backend.init_devices(attempts=1)
+    try:
+        devices = backend.init_devices(attempts=1)
+    except Exception as e:  # JSON contract holds even when init fails
+        print(json.dumps({"error": f"backend init failed: "
+                                   f"{type(e).__name__}: {e}"}))
+        return 1
     if devices[0].platform != "tpu":
         print(json.dumps({"error": f"platform={devices[0].platform}, "
                                    f"not tpu"}))
@@ -38,7 +45,8 @@ def main() -> int:
         (256.0, 24), (512.0, 16), (512.0, 24), (512.0, 48),
         (1024.0, 24), (2048.0, 16), (2048.0, 24)]
     results = {}
-    best = (None, 0.0)
+    best = (None, 0.0)  # compares on fraction when known, else GB/s —
+    # an unknown chip (no spec entry) still gets a usable best pick
     for size_mb, iters in grid:
         r = pallas_probe.run(size_mb=size_mb, iters=iters, repeats=2)
         key = f"{size_mb:.0f}MBx{iters}"
@@ -49,13 +57,14 @@ def main() -> int:
             "correct": r.correct,
         }
         print(f"# {key}: {results[key]}", file=sys.stderr)
-        frac = r.fraction_of_peak or 0.0
-        if r.correct and frac > best[1]:
-            best = (key, frac)
+        score = (r.fraction_of_peak if r.fraction_of_peak is not None
+                 else r.bandwidth_gbps)
+        if r.correct and score > best[1]:
+            best = (key, score)
     print(json.dumps({"device_kind": getattr(devices[0], "device_kind", ""),
                       "results": results,
                       "best": {"config": best[0],
-                               "fraction_of_peak": round(best[1], 4)}}))
+                               "score": round(best[1], 4)}}))
     return 0
 
 
