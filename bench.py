@@ -352,12 +352,8 @@ def _diagnose(note: str) -> list:
 
     print(f"# {note}", file=sys.stderr)
     holders = backend.diagnose_holders()  # one scan: log + return the same
-    for h in holders:
-        print(f"#   chip held by pid={h.pid} ({h.cmdline}) via {h.paths}",
-              file=sys.stderr)
-    if not holders:
-        print(f"#   no local holder found; env: "
-              f"{backend.describe_environment()}", file=sys.stderr)
+    backend.log_holders(lambda msg: print(msg, file=sys.stderr),
+                        holders=holders)
     return holders
 
 

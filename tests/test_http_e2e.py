@@ -93,20 +93,22 @@ def wait_for(ops, pred, desc, timeout=60.0):
     while the condition it waits for is already true.
     """
     end = time.time() + timeout * load_factor()
-    last_err = None
+    kubelet_err = None
+    pred_err = None
     while time.time() < end:
         try:
             simulate_kubelet(ops, ready=True)
         except Exception as e:  # transient races while converging
-            last_err = e
+            kubelet_err = e
         try:
             if pred():
                 return
         except Exception as e:
-            last_err = e
+            pred_err = e
         time.sleep(0.25)
     raise AssertionError(f"timed out waiting for {desc} "
-                         f"(last error: {last_err})")
+                         f"(kubelet error: {kubelet_err}; "
+                         f"pred error: {pred_err})")
 
 
 def cr_state(ops):

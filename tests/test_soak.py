@@ -53,22 +53,27 @@ def tpu_node(name):
 
 def wait_converged(ops, pred, desc, timeout=90.0):
     # pred evaluates every pass even when the kubelet tick loses a write
-    # race — sustained contention must not starve an already-true check
+    # race — sustained contention must not starve an already-true check.
+    # Kubelet and pred errors are tracked separately: a persistent
+    # kubelet failure often causes the pred error, and the root cause
+    # must not be masked by its downstream symptom.
     end = time.time() + timeout
-    last_err = None
+    kubelet_err = None
+    pred_err = None
     while time.time() < end:
         try:
             simulate_kubelet(ops, ready=True)
         except Exception as e:
-            last_err = e
+            kubelet_err = e
         try:
             if pred():
                 return
         except Exception as e:
-            last_err = e
+            pred_err = e
         time.sleep(0.25)
     raise AssertionError(f"soak: no convergence after {desc} "
-                         f"(last error: {last_err})")
+                         f"(kubelet error: {kubelet_err}; "
+                         f"pred error: {pred_err})")
 
 
 def cr_state(ops):

@@ -95,9 +95,11 @@ def describe_environment() -> str:
     return " ".join(bits)
 
 
-def log_holders(log) -> None:
-    """Report chip holders (or the absence of any) through ``log``."""
-    holders = diagnose_holders()
+def log_holders(log, holders: Optional[list] = None) -> None:
+    """Report chip holders (or the absence of any) through ``log``.
+    Pass ``holders`` to reuse an existing ``diagnose_holders()`` scan."""
+    if holders is None:
+        holders = diagnose_holders()
     for h in holders:
         log(f"#   chip held by pid={h.pid} ({h.cmdline}) via {h.paths}")
     if not holders:
