@@ -1,6 +1,7 @@
 """tpuop-cfg CLI: offline validation + manifest generation
 (cmd/gpuop-cfg tier)."""
 
+import pytest
 import yaml
 
 from tpu_operator.cli.tpuop_cfg import main, validate_cr
@@ -116,8 +117,80 @@ class TestValues:
         assert kinds == ["CustomResourceDefinition",
                          "CustomResourceDefinition", "Namespace",
                          "ServiceAccount", "ClusterRole",
-                         "ClusterRoleBinding", "Deployment",
-                         "TPUClusterPolicy"]
+                         "ClusterRoleBinding", "Role", "RoleBinding",
+                         "Deployment", "TPUClusterPolicy"]
+
+    def test_rbac_split_cluster_read_namespaced_write(self):
+        """The chart's clusterrole/role split (templates/role.yaml):
+        writes on namespaced operand kinds live in the Role; the
+        ClusterRole keeps cluster-wide READ (the stale/uninstall sweeps
+        list across namespaces) plus the genuinely cluster-scoped
+        kinds."""
+        from tpu_operator.deploy.packaging import (
+            cluster_role,
+            namespaced_role,
+        )
+
+        def verbs(role, resource):
+            out = set()
+            for rule in role["rules"]:
+                if resource in rule["resources"]:
+                    out |= set(rule["verbs"])
+            return out
+
+        cr, role = cluster_role(), namespaced_role("tpu-operator")
+        for res in ("daemonsets", "configmaps", "services",
+                    "servicemonitors"):
+            assert verbs(cr, res) == {"get", "list", "watch"}, res
+            assert "create" in verbs(role, res) and \
+                "delete" in verbs(role, res), res
+        # drain evicts workload pods anywhere; driver rollout cordons
+        assert "create" in verbs(cr, "pods/eviction")
+        assert "patch" in verbs(cr, "nodes")
+        # leader-election leases are namespace-confined
+        assert "create" in verbs(role, "leases")
+        assert verbs(cr, "leases") == set()
+        # cluster-scoped operand kinds stay writable cluster-wide
+        assert "create" in verbs(cr, "clusterroles")
+        assert "create" in verbs(cr, "runtimeclasses")
+
+    def test_csv_carries_namespaced_permissions(self, capsys):
+        assert main(["generate", "bundle"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        spec = docs[0]["spec"]["install"]["spec"]
+        assert spec["permissions"][0]["serviceAccountName"] == "tpu-operator"
+        assert any("leases" in r.get("resources", [])
+                   for r in spec["permissions"][0]["rules"])
+
+    def test_tpu_drivers_render_from_values(self, tmp_path):
+        """The chart's nvidiadriver.yaml slot: tpuDrivers entries render
+        per-pool TPUDriver CRs, validated at render time."""
+        from tpu_operator.deploy.values import load_values, render_bundle
+
+        f = tmp_path / "v.yaml"
+        f.write_text(yaml.safe_dump({"tpuDrivers": [
+            {"name": "v5e-pool", "spec": {
+                "channel": "stable",
+                "nodeSelector": {"cloud.google.com/gke-tpu-accelerator":
+                                 "tpu-v5e-slice"}}},
+            {"name": "v5p-pool", "spec": {"channel": "nightly"}},
+        ]}))
+        docs = render_bundle(load_values(str(f)))
+        drivers = [d for d in docs if d["kind"] == "TPUDriver"]
+        assert [d["metadata"]["name"] for d in drivers] == \
+            ["v5e-pool", "v5p-pool"]
+
+    def test_invalid_tpu_driver_fails_at_render(self, tmp_path):
+        from tpu_operator.deploy.values import load_values, render_bundle
+
+        f = tmp_path / "v.yaml"
+        f.write_text(yaml.safe_dump({"tpuDrivers": [
+            {"name": "bad", "spec": {"channel": "custom"}}]}))  # no version
+        with pytest.raises(ValueError, match="requires an explicit version"):
+            render_bundle(load_values(str(f)))
+        f.write_text(yaml.safe_dump({"tpuDrivers": [{"spec": {}}]}))
+        with pytest.raises(ValueError, match="needs a name"):
+            render_bundle(load_values(str(f)))
 
     def test_operator_image_digest_form(self):
         from tpu_operator.deploy.values import operator_image
@@ -371,7 +444,7 @@ class TestGenerate:
     def test_cli_emits_parseable_yaml(self, capsys):
         assert main(["generate", "all", "-n", "custom-ns"]) == 0
         docs = list(yaml.safe_load_all(capsys.readouterr().out))
-        assert len(docs) == 8
+        assert len(docs) == 10
         ns = [d for d in docs if d["kind"] == "Namespace"][0]
         assert ns["metadata"]["name"] == "custom-ns"
 

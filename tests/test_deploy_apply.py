@@ -123,6 +123,10 @@ class TestDeleteDocs:
         assert apply_mod.delete_docs(c, [doc("ConfigMap", "a")]) == 0
 
 
+def wait_policy_ready_short(c):
+    return apply_mod.wait_policy_ready(c, timeout_s=0.3, poll_s=0.05)
+
+
 class TestWaitPolicyReady:
     def test_ready_cr_returns_true(self):
         from tpu_operator.api.clusterpolicy import new_cluster_policy
@@ -131,6 +135,25 @@ class TestWaitPolicyReady:
         cr = new_cluster_policy()
         cr["status"] = {"state": "ready"}
         c.create(cr)
+        assert apply_mod.wait_policy_ready(c, timeout_s=2.0,
+                                           poll_s=0.05) is True
+
+    def test_pending_tpudriver_blocks_wait(self):
+        """A ready policy with TPUDriver CRs still rolling must NOT count
+        as installed: the drivers stood the policy's libtpu state down,
+        so only their own status proves rollout."""
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+        from tpu_operator.api.tpudriver import new_tpu_driver
+
+        c = FakeClient()
+        cr = new_cluster_policy()
+        cr["status"] = {"state": "ready"}
+        c.create(cr)
+        c.create(new_tpu_driver("pool-a"))  # no status yet
+        assert wait_policy_ready_short(c) is False
+        live = c.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-a")
+        live["status"] = {"state": "ready"}
+        c.update(live)
         assert apply_mod.wait_policy_ready(c, timeout_s=2.0,
                                            poll_s=0.05) is True
 

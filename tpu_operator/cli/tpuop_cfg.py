@@ -124,7 +124,9 @@ def _lifecycle_verbs(args, client, docs, log) -> int:
             print("uninstall incomplete: CRs still present",
                   file=sys.stderr)
             return 1
-        swept = apply_mod.sweep_operands(client, log)
+        ns = next((d["metadata"]["name"] for d in docs
+                   if d.get("kind") == "Namespace"), "tpu-operator")
+        swept = apply_mod.sweep_operands(client, log, namespace=ns)
         keep = ("Namespace", "CustomResourceDefinition") \
             if not args.purge_crds else ("Namespace",)
         n = apply_mod.delete_docs(client, docs, log=log, keep_kinds=keep)

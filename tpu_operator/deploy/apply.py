@@ -115,7 +115,8 @@ def delete_docs(client: Client, docs: List[dict], log: Log = lambda s: None,
 
 
 def sweep_operands(client: Client, log: Log = lambda s: None,
-                   settle_s: float = 0.5, max_s: float = 30.0) -> int:
+                   settle_s: float = 0.5, max_s: float = 30.0,
+                   namespace: str = "") -> int:
     """Delete any operand object still carrying the state label after CR
     teardown. Owner GC removes almost everything, but a reconcile pass
     that fetched the CR just before deletion keeps applying states for
@@ -125,17 +126,23 @@ def sweep_operands(client: Client, log: Log = lambda s: None,
     consecutive passes find nothing, so the in-flight pass has drained."""
     from ..api.labels import STATE_LABEL
     from ..runtime.client import ListOptions
-    from ..runtime.objects import labels_of
+    from ..runtime.objects import is_namespaced, labels_of
     from ..state.skel import SWEEPABLE_KINDS
 
-    exists = ListOptions(label_selector={"matchExpressions": [
-        {"key": STATE_LABEL, "operator": "Exists"}]})
+    selector = {"matchExpressions": [
+        {"key": STATE_LABEL, "operator": "Exists"}]}
 
     def one_pass() -> int:
         n = 0
         for av, kind in SWEEPABLE_KINDS:
+            # namespaced kinds sweep within the install namespace (the
+            # operator's RBAC write scope); cluster kinds cluster-wide
+            opts = ListOptions(label_selector=selector,
+                               namespace=namespace
+                               if namespace and is_namespaced(kind)
+                               else None)
             try:
-                objs = client.list(av, kind, exists)
+                objs = client.list(av, kind, opts)
             except NotFoundError:
                 continue
             for obj in objs:
@@ -165,19 +172,31 @@ def sweep_operands(client: Client, log: Log = lambda s: None,
 def wait_policy_ready(client: Client, timeout_s: float = 300.0,
                       poll_s: float = 2.0,
                       log: Log = lambda s: None) -> bool:
-    """Block until every TPUClusterPolicy reports status.state == ready —
-    the `helm install --wait` contract, with the reference e2e's 5-minute
-    default budget (tests/e2e/gpu_operator_test.go:83-88)."""
+    """Block until every TPUClusterPolicy AND every TPUDriver reports
+    status.state == ready — the `helm install --wait` contract, with the
+    reference e2e's 5-minute default budget
+    (tests/e2e/gpu_operator_test.go:83-88). TPUDrivers matter because
+    their presence stands the policy's built-in libtpu state down: a
+    policy can be 'ready' while per-pool driver rollout is still
+    pending."""
+    from ..api.tpudriver import KIND_TPU_DRIVER, V1ALPHA1
+
     deadline = time.monotonic() + timeout_s
     last = "no TPUClusterPolicy observed yet"
     while time.monotonic() < deadline:
-        try:
-            crs = client.list(V1, KIND_CLUSTER_POLICY)
-        except NotFoundError:
-            crs = []
-        if crs:
-            states = {name_of(c): ((c.get("status") or {}).get("state")
-                                   or "unset") for c in crs}
+        states = {}
+        any_policy = False
+        for av, kind in ((V1, KIND_CLUSTER_POLICY),
+                         (V1ALPHA1, KIND_TPU_DRIVER)):
+            try:
+                crs = client.list(av, kind)
+            except NotFoundError:
+                crs = []
+            for c in crs:
+                any_policy = any_policy or kind == KIND_CLUSTER_POLICY
+                states[f"{kind}/{name_of(c)}"] = (
+                    (c.get("status") or {}).get("state") or "unset")
+        if any_policy:
             if all(s == "ready" for s in states.values()):
                 log(f"ready: {states}")
                 return True

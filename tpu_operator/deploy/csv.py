@@ -22,7 +22,12 @@ from typing import Any, Dict, List
 from .. import __version__
 from ..api import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER
 from ..api.crd import all_crds
-from .packaging import cluster_role, operator_deployment, sample_cluster_policy
+from .packaging import (
+    cluster_role,
+    namespaced_role,
+    operator_deployment,
+    sample_cluster_policy,
+)
 
 PACKAGE_NAME = "tpu-operator"
 DEFAULT_CHANNEL = "stable"
@@ -165,6 +170,12 @@ def render_csv(values: Dict[str, Any]) -> dict:
                     "clusterPermissions": [{
                         "serviceAccountName": "tpu-operator",
                         "rules": cluster_role()["rules"],
+                    }],
+                    # OLM's native namespaced-permission slot carries the
+                    # Role rules (the chart's role.yaml split)
+                    "permissions": [{
+                        "serviceAccountName": "tpu-operator",
+                        "rules": namespaced_role("tpu-operator")["rules"],
                     }],
                     "deployments": [{
                         "name": "tpu-operator",

@@ -28,6 +28,19 @@ def service_account(namespace: str) -> dict:
             "metadata": {"name": "tpu-operator", "namespace": namespace}}
 
 
+# the reference's chart splits RBAC into a ClusterRole for what is
+# genuinely cluster-scoped and a namespaced Role for the write-heavy
+# operand management (deployments/gpu-operator/templates/clusterrole.yaml
+# + role.yaml); same shape here. The stale/uninstall sweeps scope their
+# namespaced-kind passes to the operator namespace to match (skel.py
+# _delete_stale, deploy/apply.py sweep_operands); the ClusterRole keeps
+# cluster-wide READ on those kinds for observability and drift checks,
+# WRITES on them are namespace-scoped.
+
+_RW = ["get", "list", "watch", "create", "update", "patch", "delete"]
+_RO = ["get", "list", "watch"]
+
+
 def cluster_role() -> dict:
     return {
         "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -41,33 +54,81 @@ def cluster_role() -> dict:
             {"apiGroups": [""],
              "resources": ["nodes"],
              "verbs": ["get", "list", "watch", "patch"]},
+            # drain evicts TPU workload pods from ANY namespace
             {"apiGroups": [""],
-             "resources": ["pods", "pods/eviction", "services",
-                           "serviceaccounts", "configmaps", "namespaces",
-                           "endpoints", "events"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch",
-                       "delete"]},
+             "resources": ["pods", "pods/eviction"],
+             "verbs": list(_RW)},
+            # PSA enforcement labels on the operator namespace
+            {"apiGroups": [""],
+             "resources": ["namespaces"],
+             "verbs": ["get", "list", "watch", "patch"]},
+            # cluster-wide read for the stale/uninstall sweeps; writes on
+            # these kinds live in the namespaced Role below
+            {"apiGroups": [""],
+             "resources": ["services", "serviceaccounts", "configmaps",
+                           "endpoints"],
+             "verbs": list(_RO)},
             {"apiGroups": ["apps"],
-             "resources": ["daemonsets", "deployments", "controllerrevisions"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch",
-                       "delete"]},
+             "resources": ["daemonsets", "deployments",
+                           "controllerrevisions"],
+             "verbs": list(_RO)},
             {"apiGroups": ["rbac.authorization.k8s.io"],
-             "resources": ["roles", "rolebindings", "clusterroles",
-                           "clusterrolebindings"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch",
-                       "delete"]},
-            {"apiGroups": ["node.k8s.io"],
-             "resources": ["runtimeclasses"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch",
-                       "delete"]},
-            {"apiGroups": ["coordination.k8s.io"],
-             "resources": ["leases"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch"]},
+             "resources": ["roles", "rolebindings"],
+             "verbs": list(_RO)},
             {"apiGroups": ["monitoring.coreos.com"],
              "resources": ["servicemonitors", "prometheusrules"],
-             "verbs": ["get", "list", "watch", "create", "update", "patch",
-                       "delete"]},
+             "verbs": list(_RO)},
+            # genuinely cluster-scoped operand kinds
+            {"apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["clusterroles", "clusterrolebindings"],
+             "verbs": list(_RW)},
+            {"apiGroups": ["node.k8s.io"],
+             "resources": ["runtimeclasses"],
+             "verbs": list(_RW)},
         ],
+    }
+
+
+def namespaced_role(namespace: str) -> dict:
+    """Write grants for operand management, confined to the operator
+    namespace (templates/role.yaml analog: the operator renders every
+    namespaced operand object into its own namespace)."""
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": {"name": "tpu-operator", "namespace": namespace},
+        "rules": [
+            {"apiGroups": [""],
+             "resources": ["pods", "services", "serviceaccounts",
+                           "configmaps", "endpoints", "events"],
+             "verbs": list(_RW)},
+            {"apiGroups": ["apps"],
+             "resources": ["daemonsets", "deployments",
+                           "controllerrevisions"],
+             "verbs": list(_RW)},
+            {"apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["roles", "rolebindings"],
+             "verbs": list(_RW)},
+            {"apiGroups": ["coordination.k8s.io"],
+             "resources": ["leases"],
+             "verbs": ["get", "list", "watch", "create", "update",
+                       "patch"]},
+            {"apiGroups": ["monitoring.coreos.com"],
+             "resources": ["servicemonitors", "prometheusrules"],
+             "verbs": list(_RW)},
+        ],
+    }
+
+
+def role_binding(namespace: str) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {"name": "tpu-operator", "namespace": namespace},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "Role", "name": "tpu-operator"},
+        "subjects": [{"kind": "ServiceAccount", "name": "tpu-operator",
+                      "namespace": namespace}],
     }
 
 
@@ -124,8 +185,12 @@ def operator_deployment(namespace: str, image: str,
     # spec.selector/template agreement (same protection operand renders
     # give their selector labels)
     labels = {**(op.get("labels") or {}), "app": "tpu-operator"}
-    meta = {"name": "tpu-operator", "namespace": namespace, "labels": labels}
-    pod_meta: dict = {"labels": labels}
+    meta = {"name": "tpu-operator", "namespace": namespace,
+            "labels": dict(labels)}
+    # fresh dict: sharing one labels object across metadata and the pod
+    # template makes yaml.safe_dump emit anchors/aliases, which strict
+    # consumers and text-diff GitOps pipelines choke on
+    pod_meta: dict = {"labels": dict(labels)}
     if op.get("annotations"):
         meta["annotations"] = dict(op["annotations"])
         pod_meta["annotations"] = dict(op["annotations"])
@@ -283,6 +348,8 @@ def generate(what: str, namespace: str = "tpu-operator",
         service_account(namespace),
         cluster_role(),
         cluster_role_binding(namespace),
+        namespaced_role(namespace),
+        role_binding(namespace),
         operator_deployment(namespace, image),
         sample_cluster_policy(),
     ]

@@ -31,7 +31,9 @@ from .packaging import (
     cluster_role,
     cluster_role_binding,
     namespace_manifest,
+    namespaced_role,
     operator_deployment,
+    role_binding,
     service_account,
     upgrade_crd_hook,
 )
@@ -40,7 +42,8 @@ from .packaging import (
 # [tool.setuptools.package-data])
 VALUES_FILE = pathlib.Path(__file__).resolve().parent / "values.yaml"
 
-TOP_LEVEL_KEYS = {"namespace", "operator", "clusterPolicy", "pluginConfig"}
+TOP_LEVEL_KEYS = {"namespace", "operator", "clusterPolicy", "pluginConfig",
+                  "tpuDrivers"}
 
 
 def default_values() -> Dict[str, Any]:
@@ -113,6 +116,35 @@ def render_cluster_policy(values: Dict[str, Any]) -> Optional[dict]:
     return cr
 
 
+def render_tpu_drivers(values: Dict[str, Any]) -> List[dict]:
+    """Per-pool TPUDriver CRs from values (the chart's nvidiadriver.yaml
+    slot: `driver.nvidiaDriverCRD` renders an NVIDIADriver CR alongside
+    the ClusterPolicy). Each entry is {name, spec}; every rendered CR is
+    schema+CEL validated at render time like the ClusterPolicy."""
+    from ..api.tpudriver import new_tpu_driver
+    from ..api.validate import validate_cr
+
+    out: List[dict] = []
+    seen: set = set()
+    for i, entry in enumerate(values.get("tpuDrivers") or []):
+        if not isinstance(entry, dict) or not entry.get("name"):
+            raise ValueError(f"tpuDrivers[{i}]: each entry needs a name "
+                             f"(and optionally a spec mapping)")
+        if entry["name"] in seen:
+            raise ValueError(
+                f"tpuDrivers[{i}]: duplicate name {entry['name']!r} — the "
+                f"later spec would silently overwrite the earlier one")
+        seen.add(entry["name"])
+        cr = new_tpu_driver(entry["name"], spec=entry.get("spec") or {})
+        errs, _ = validate_cr(cr)
+        if errs:
+            raise ValueError(
+                f"values render an invalid TPUDriver {entry['name']!r}:"
+                "\n  " + "\n  ".join(errs))
+        out.append(cr)
+    return out
+
+
 def render_plugin_config_map(values: Dict[str, Any]) -> Optional[dict]:
     """Ship the per-node plugin-config ConfigMap from values
     (devicePlugin.config.create/data slot, templates/plugin_config.yaml).
@@ -168,6 +200,8 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
         service_account(ns),
         cluster_role(),
         cluster_role_binding(ns),
+        namespaced_role(ns),
+        role_binding(ns),
         operator_deployment(ns, operator_image(values),
                             values.get("operator") or {}),
     ])
@@ -187,6 +221,7 @@ def render_bundle(values: Dict[str, Any], include_crds: bool = True) -> List[dic
     cr = render_cluster_policy(values)
     if cr is not None:
         docs.append(cr)
+    docs.extend(render_tpu_drivers(values))
     return docs
 
 

@@ -328,7 +328,7 @@ class OperandState(State):
 
     def sync(self, ctx: SyncContext) -> SyncResult:
         if not self.enabled(ctx):
-            delete_state_objects(ctx.client, self.name)
+            delete_state_objects(ctx.client, self.name, ctx.namespace)
             return SyncResult(SyncStatus.DISABLED, "disabled by spec")
         objects = self.render(ctx)
         applied = apply_objects(ctx.client, ctx.policy, self.name, objects,

@@ -124,13 +124,24 @@ def _delete_stale(client: Client, state_name: str, desired_keys: set,
     (state_skel.go:313-342 handleStateObjectsDeletion analog). The sweep
     is bounded to ``sweep_kinds`` when the caller knows which kinds its
     templates can emit — listing all nine known kinds for every state on
-    every reconcile would be steady wasted apiserver load."""
+    every reconcile would be steady wasted apiserver load.
+
+    Namespaced kinds are swept within ``namespace`` only: the operator
+    renders every namespaced operand into its own namespace, and its
+    RBAC write grants are namespace-scoped to match (packaging.py
+    namespaced_role) — a cross-namespace delete would 403 on a real
+    cluster. Cluster-scoped kinds sweep cluster-wide."""
+    from ..runtime.objects import is_namespaced
+
     for api_version, kind in SWEEPABLE_KINDS:
         if sweep_kinds is not None and (api_version, kind) not in sweep_kinds:
             continue
+        opts = ListOptions(label_selector={STATE_LABEL: state_name})
+        if namespace and is_namespaced(kind):
+            opts = ListOptions(label_selector={STATE_LABEL: state_name},
+                               namespace=namespace)
         try:
-            stale = client.list(api_version, kind, ListOptions(
-                label_selector={STATE_LABEL: state_name}))
+            stale = client.list(api_version, kind, opts)
         except NotFoundError:
             continue
         for obj in stale:
@@ -146,11 +157,13 @@ def _delete_stale(client: Client, state_name: str, desired_keys: set,
                 pass
 
 
-def delete_state_objects(client: Client, state_name: str) -> None:
+def delete_state_objects(client: Client, state_name: str,
+                         namespace: str = "") -> None:
     """Remove everything a state ever applied (used when a state flips to
     disabled — the reference deletes on disable too,
-    object_controls.go:4167-4174)."""
-    _delete_stale(client, state_name, set(), "")
+    object_controls.go:4167-4174). Pass the operator namespace so the
+    sweep stays inside the RBAC write scope."""
+    _delete_stale(client, state_name, set(), namespace)
 
 
 def daemonset_ready(ds: dict) -> Tuple[bool, str]:
