@@ -191,6 +191,12 @@ class TestValues:
         f.write_text(yaml.safe_dump({"tpuDrivers": [{"spec": {}}]}))
         with pytest.raises(ValueError, match="needs a name"):
             render_bundle(load_values(str(f)))
+        # two selector-less entries both match every TPU node — rejected
+        # at render instead of sitting NotReady on the cluster
+        f.write_text(yaml.safe_dump({"tpuDrivers": [
+            {"name": "a"}, {"name": "b"}]}))
+        with pytest.raises(ValueError, match="omit nodeSelector"):
+            render_bundle(load_values(str(f)))
 
     def test_operator_image_digest_form(self):
         from tpu_operator.deploy.values import operator_image

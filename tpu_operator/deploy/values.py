@@ -142,6 +142,17 @@ def render_tpu_drivers(values: Dict[str, Any]) -> List[dict]:
                 f"values render an invalid TPUDriver {entry['name']!r}:"
                 "\n  " + "\n  ".join(errs))
         out.append(cr)
+    # an empty nodeSelector selects ALL TPU nodes, so two selector-less
+    # entries can never be valid — catch it at render time instead of
+    # leaving both CRs NotReady (controllers/validation.py enforces the
+    # full per-node disjointness at reconcile, which needs the cluster)
+    selectorless = [d["metadata"]["name"] for d in out
+                    if not (d.get("spec") or {}).get("nodeSelector")]
+    if len(selectorless) > 1:
+        raise ValueError(
+            f"tpuDrivers: entries {selectorless} all omit nodeSelector; "
+            f"an empty selector matches every TPU node, so at most one "
+            f"entry may omit it")
     return out
 
 
