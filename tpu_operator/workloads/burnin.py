@@ -71,27 +71,36 @@ def init_params(cfg: BurninConfig, key) -> Dict:
     return p
 
 
-def param_specs(cfg: BurninConfig) -> Dict:
+def param_specs(cfg: BurninConfig, fsdp: bool = False) -> Dict:
     """Megatron-style tensor-parallel layout: column-parallel first matmul,
-    row-parallel second, so each block needs one psum on its output."""
+    row-parallel second, so each block needs one psum on its output.
+
+    ``fsdp=True`` additionally shards every parameter's non-tensor-
+    parallel dimension across the ``data`` axis — the ZeRO-3/FSDP
+    layout: parameters (and, through optax's tree mapping, the optimizer
+    moments) live fully sharded, XLA's SPMD partitioner inserts the
+    all-gather before each use and the reduce-scatter on the gradients.
+    Composes with tp: weights end up 2D-sharded (data x model)."""
+    d = "data" if fsdp else None
     layer = {
-        "norm1": P(None),
-        "qkv": P(None, "model"),
-        "attn_out": P("model", None),
-        "norm2": P(None),
-        "ff_in": P(None, "model"),
-        "ff_out": P("model", None),
+        "norm1": P(d),
+        "qkv": P(d, "model"),
+        "attn_out": P("model", d),
+        "norm2": P(d),
+        "ff_in": P(d, "model"),
+        "ff_out": P("model", d),
     }
     return {
-        "embed": P(None, "model"),
-        "unembed": P("model", None),
-        "final_norm": P(None),
+        "embed": P(d, "model"),
+        "unembed": P("model", d),
+        "final_norm": P(d),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
 
 
-def shard_params(params: Dict, mesh: Mesh, cfg: BurninConfig) -> Dict:
-    specs = param_specs(cfg)
+def shard_params(params: Dict, mesh: Mesh, cfg: BurninConfig,
+                 fsdp: bool = False) -> Dict:
+    specs = param_specs(cfg, fsdp=fsdp)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs,
@@ -157,13 +166,16 @@ def loss_fn(params: Dict, batch: Dict, cfg: BurninConfig,
 # --- training step ---------------------------------------------------------
 
 
-def make_train_step(mesh: Mesh, cfg: BurninConfig, optimizer=None):
+def make_train_step(mesh: Mesh, cfg: BurninConfig, optimizer=None,
+                    fsdp: bool = False):
     """Returns (step_fn, init_state): jitted full training step with dp
-    gradient reduction + tp/sp sharding, all via GSPMD."""
+    gradient reduction + tp/sp sharding, all via GSPMD. ``fsdp=True``
+    fully shards parameters and optimizer state across the data axis
+    (ZeRO-3 layout; see param_specs)."""
     optimizer = optimizer or optax.adamw(cfg.learning_rate)
 
     def init_state(key):
-        params = shard_params(init_params(cfg, key), mesh, cfg)
+        params = shard_params(init_params(cfg, key), mesh, cfg, fsdp=fsdp)
         opt_state = optimizer.init(params)
         state = {"params": params, "opt": opt_state,
                  "step": jnp.zeros((), jnp.int32)}
