@@ -79,7 +79,8 @@ def _scrape_telemetry(platform: str) -> dict | None:
         # guarantee non-synthetic inputs for this scrape (incl. the
         # native scraper's binary/root overrides the tests use)
         for var in ("TPU_FAKE_CHIPS", "TPU_HEALTH_ENGINE_INFO",
-                    "TPU_TELEMETRY_BIN", "TPU_SYSFS_ROOT"):
+                    "TPU_TELEMETRY_BIN", "TPU_TELEMETRY_WATCH",
+                    "TPU_SYSFS_ROOT"):
             os.environ.pop(var, None)
         samples = libtpu_exporter.collect_native()
         source = "native"

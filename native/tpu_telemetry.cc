@@ -14,9 +14,16 @@
 // Exit code: 0 when at least one chip directory exists, 1 otherwise
 // (the Python exporter falls back to its own collectors on nonzero).
 //
+// --watch N runs as a long-lived engine (the DCGM host-engine mode):
+// one JSON array per line every N seconds, flushed, until the
+// supervisor terminates it. Chips may appear/disappear between ticks
+// (driver install/fencing); an empty tick emits [] and keeps running
+// rather than exiting, so the exporter never flaps on startup order.
+//
 // Build: make -C native   (g++ -O2; no dependencies)
 
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdio>
@@ -58,13 +65,9 @@ long long ReadCounter(const std::string& path) {
 
 }  // namespace
 
-int main(int argc, char** argv) {
-  std::string root = "/sys/class/accel";
-  if (const char* env = getenv("TPU_SYSFS_ROOT")) root = env;
-  for (int i = 1; i < argc; ++i) {
-    if (strcmp(argv[i], "--root") == 0 && i + 1 < argc) root = argv[++i];
-  }
-
+// one scan of the sysfs tree, printed as a JSON array on one line;
+// returns the number of chips seen
+size_t ScanOnce(const std::string& root) {
   std::vector<std::string> chips = ListChipDirs(root);
   printf("[");
   bool first = true;
@@ -77,11 +80,14 @@ int main(int argc, char** argv) {
     long long millic = ReadCounter(base + "temp_millic");
     if (!first) printf(", ");
     first = false;
+    // usage is "known" only when the kernel actually exposes the
+    // counter — a missing file must not read as a confident 0
     printf("{\"chip_id\": \"%s\", \"duty_cycle_pct\": %lld, "
            "\"hbm_used_bytes\": %lld, \"hbm_total_bytes\": %lld, "
-           "\"tensorcore_util_pct\": %lld, ",
+           "\"hbm_usage_known\": %s, \"tensorcore_util_pct\": %lld, ",
            chip.c_str(), duty < 0 ? 0 : duty, used < 0 ? 0 : used,
-           total < 0 ? 0 : total, tc < 0 ? 0 : tc);
+           total < 0 ? 0 : total, used >= 0 ? "true" : "false",
+           tc < 0 ? 0 : tc);
     if (millic > 0) {
       printf("\"temperature_c\": %.3f}", static_cast<double>(millic) / 1000.0);
     } else {
@@ -89,5 +95,28 @@ int main(int argc, char** argv) {
     }
   }
   printf("]\n");
-  return chips.empty() ? 1 : 0;
+  fflush(stdout);
+  return chips.size();
+}
+
+int main(int argc, char** argv) {
+  std::string root = "/sys/class/accel";
+  if (const char* env = getenv("TPU_SYSFS_ROOT")) root = env;
+  long watch_s = 0;
+  for (int i = 1; i < argc; ++i) {
+    if (strcmp(argv[i], "--root") == 0 && i + 1 < argc) root = argv[++i];
+    if (strcmp(argv[i], "--watch") == 0 && i + 1 < argc) {
+      watch_s = strtol(argv[++i], nullptr, 10);
+    }
+  }
+
+  if (watch_s <= 0) {
+    return ScanOnce(root) == 0 ? 1 : 0;  // one-shot contract unchanged
+  }
+  // host-engine mode: scan forever on a fixed cadence; the DaemonSet
+  // supervisor owns the process lifetime
+  for (;;) {
+    ScanOnce(root);
+    sleep(static_cast<unsigned>(watch_s));
+  }
 }

@@ -70,6 +70,134 @@ class TestBinary:
         assert rows[0]["temperature_c"] is None
 
 
+class TestWatchMode:
+    def test_watch_streams_fresh_scans(self, telemetry_bin, tmp_path):
+        """--watch N is the host-engine mode: one JSON line per tick,
+        flushed, reflecting sysfs changes between ticks."""
+        fake_sysfs(tmp_path, chips=1)
+        proc = subprocess.Popen(
+            [telemetry_bin, "--root", str(tmp_path), "--watch", "1"],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            first = json.loads(proc.stdout.readline())
+            assert first[0]["duty_cycle_pct"] == 40
+            (tmp_path / "accel0" / "duty_cycle_pct").write_text("77\n")
+            # within a couple of ticks the new value must appear
+            for _ in range(4):
+                rows = json.loads(proc.stdout.readline())
+                if rows and rows[0]["duty_cycle_pct"] == 77:
+                    break
+            else:
+                pytest.fail("watch ticks never picked up the new counter")
+            assert proc.poll() is None  # still serving
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_watch_survives_empty_tree(self, telemetry_bin, tmp_path):
+        """No chips yet (driver still installing) emits [] and keeps
+        running instead of exiting like the one-shot contract."""
+        proc = subprocess.Popen(
+            [telemetry_bin, "--root", str(tmp_path), "--watch", "1"],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert json.loads(proc.stdout.readline()) == []
+            fake_sysfs(tmp_path, chips=1)
+            for _ in range(4):
+                rows = json.loads(proc.stdout.readline())
+                if rows:
+                    break
+            else:
+                pytest.fail("chips appearing mid-watch never surfaced")
+            assert proc.poll() is None
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_engine_backend_serves_latest_tick(self, telemetry_bin,
+                                               tmp_path, monkeypatch):
+        """TPU_TELEMETRY_WATCH switches collect_native to the persistent
+        engine: no fork per scrape, newest tick wins, and a dead engine
+        falls through instead of wedging collection."""
+        import time
+
+        from tpu_operator.metrics import libtpu_exporter as le
+
+        fake_sysfs(tmp_path, chips=2)
+        monkeypatch.setenv("TPU_TELEMETRY_BIN", telemetry_bin)
+        monkeypatch.setenv("TPU_TELEMETRY_WATCH", "1")
+        monkeypatch.setenv("TPU_SYSFS_ROOT", str(tmp_path))
+        monkeypatch.setattr(le, "_engine", None)
+        try:
+            deadline = time.monotonic() + 10
+            samples = []
+            while time.monotonic() < deadline:
+                samples = le.collect_native()
+                if len(samples) == 2 and le._engine is not None and \
+                        le._engine.latest_samples():
+                    break
+                time.sleep(0.2)
+            assert len(samples) == 2
+            engine = le._watch_engine()
+            assert engine is not None and engine.alive()
+            # the same engine instance is reused across scrapes
+            assert le._watch_engine() is engine
+            # counter changes arrive through ticks, no new fork needed
+            (tmp_path / "accel0" / "duty_cycle_pct").write_text("99\n")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = le.collect_native()
+                if s and s[0].duty_cycle_pct == 99:
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail("engine ticks never surfaced the new value")
+        finally:
+            if le._engine is not None:
+                le._engine.stop()
+                le._engine = None
+
+
+class TestUsageKnown:
+    def test_missing_used_counter_marks_usage_unknown(self, telemetry_bin,
+                                                      tmp_path,
+                                                      monkeypatch):
+        """A kernel tree without hbm_used_bytes must not produce a
+        confident used=0 through the native path."""
+        from tpu_operator.metrics import libtpu_exporter as le
+
+        d = tmp_path / "accel0"
+        d.mkdir()
+        (d / "hbm_total_bytes").write_text(str(16 << 30))
+        out = subprocess.run([telemetry_bin, "--root", str(tmp_path)],
+                             capture_output=True, text=True)
+        rows = json.loads(out.stdout)
+        assert rows[0]["hbm_usage_known"] is False
+        monkeypatch.setenv("TPU_TELEMETRY_BIN", telemetry_bin)
+        monkeypatch.delenv("TPU_TELEMETRY_WATCH", raising=False)
+        monkeypatch.setenv("TPU_SYSFS_ROOT", str(tmp_path))
+        [sample] = le.collect_native()
+        assert sample.hbm_usage_known is False
+        # the pure-sysfs collector agrees
+        [s2] = le.collect_sysfs()
+        assert s2.hbm_usage_known is False
+
+    def test_present_counter_is_known(self, telemetry_bin, tmp_path):
+        fake_sysfs(tmp_path, chips=1)
+        out = subprocess.run([telemetry_bin, "--root", str(tmp_path)],
+                             capture_output=True, text=True)
+        assert json.loads(out.stdout)[0]["hbm_usage_known"] is True
+
+
+def test_watch_zero_disables_engine(monkeypatch):
+    from tpu_operator.metrics import libtpu_exporter as le
+
+    monkeypatch.setattr(le, "_engine", None)
+    for off in ("", "0", "-5", "bogus"):
+        monkeypatch.setenv("TPU_TELEMETRY_WATCH", off)
+        assert le._watch_engine() is None, repr(off)
+
+
 class TestExporterIntegration:
     def test_native_backend_preferred(self, telemetry_bin, tmp_path,
                                       monkeypatch):

@@ -58,13 +58,132 @@ def collect_fake() -> List[ChipSample]:
             for i in range(n)]
 
 
+def _rows_to_samples(rows) -> List[ChipSample]:
+    return [ChipSample(
+        r.get("chip_id", f"accel{i}"),
+        duty_cycle_pct=float(r.get("duty_cycle_pct") or 0),
+        hbm_used=int(r.get("hbm_used_bytes") or 0),
+        hbm_total=int(r.get("hbm_total_bytes") or 0),
+        tensorcore_util_pct=float(r.get("tensorcore_util_pct") or 0),
+        temperature_c=(float(r["temperature_c"])
+                       if r.get("temperature_c") is not None else None),
+        # the scraper says whether the kernel exposed the used-bytes
+        # counter; for older binaries without the field, a nonzero total
+        # is the best available signal
+        hbm_usage_known=bool(r.get(
+            "hbm_usage_known",
+            int(r.get("hbm_total_bytes") or 0) > 0)))
+        for i, r in enumerate(rows)]
+
+
+class NativeEngine:
+    """Long-lived native scraper (``tpu-telemetry --watch N``) — the
+    DCGM-host-engine process model: one persistent C++ process owns the
+    sysfs session and streams a JSON array per tick; a reader thread
+    keeps the newest line so scrapes never fork or block on the scan.
+    Enabled with TPU_TELEMETRY_WATCH=<seconds>."""
+
+    def __init__(self, binary: str, interval_s: int):
+        import subprocess
+
+        self._interval = max(1, int(interval_s))
+        self._proc = subprocess.Popen(
+            [binary, "--watch", str(self._interval)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        self._latest: Optional[str] = None
+        self._latest_at = 0.0
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._reader, daemon=True,
+                             name="tpu-telemetry-engine")
+        t.start()
+
+    def _reader(self):
+        assert self._proc.stdout is not None
+        for line in self._proc.stdout:
+            with self._lock:
+                self._latest = line
+                self._latest_at = time.monotonic()
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def latest_samples(self) -> Optional[List[ChipSample]]:
+        """Newest tick's samples ([] is an authoritative empty scan);
+        None when nothing parseable arrived yet OR the last tick is
+        stale — an alive-but-silent engine (scraper blocked in a D-state
+        sysfs read on fenced hardware) must not serve frozen values
+        forever, which is the exact failure the exporter's series-clear
+        discipline exists to surface."""
+        import json
+
+        with self._lock:
+            line, at = self._latest, self._latest_at
+        if not line:
+            return None
+        if time.monotonic() - at > max(3.0 * self._interval, 10.0):
+            return None  # stale: fall through to the bounded one-shot
+        try:
+            return _rows_to_samples(json.loads(line))
+        except (json.JSONDecodeError, TypeError, ValueError,
+                AttributeError):
+            return None
+
+    def stop(self):
+        try:
+            self._proc.terminate()
+            self._proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+_engine: Optional[NativeEngine] = None
+_engine_lock = threading.Lock()
+
+
+def _watch_engine() -> Optional[NativeEngine]:
+    """The process-wide engine singleton, started lazily when
+    TPU_TELEMETRY_WATCH is set. A dead engine (binary missing, crashed)
+    is dropped so collection falls through to fork-per-scrape / sysfs."""
+    global _engine
+    secs = os.environ.get("TPU_TELEMETRY_WATCH", "")
+    try:
+        interval = int(float(secs)) if secs else 0
+    except ValueError:
+        return None
+    if interval <= 0:  # unset, "0", or negative all mean: engine off
+        return None
+    with _engine_lock:
+        if _engine is not None and _engine.alive():
+            return _engine
+        try:
+            _engine = NativeEngine(
+                os.environ.get("TPU_TELEMETRY_BIN", "tpu-telemetry"),
+                interval)
+        except OSError:
+            _engine = None
+        return _engine
+
+
 def collect_native() -> List[ChipSample]:
     """Preferred on-node backend: the C++ tpu-telemetry scraper
     (native/tpu_telemetry.cc — the native slot DCGM's host engine fills
-    in the reference). Empty list when the binary is absent or finds no
-    chips; callers fall through to the Python collectors."""
+    in the reference). With TPU_TELEMETRY_WATCH set, reads the newest
+    tick from the persistent --watch engine; otherwise one fork per
+    scrape. Empty list when the binary is absent or finds no chips;
+    callers fall through to the Python collectors."""
     import json
     import subprocess
+
+    engine = _watch_engine()
+    if engine is not None:
+        samples = engine.latest_samples()
+        if samples is not None:
+            # [] is an authoritative empty scan: return it rather than
+            # forking the one-shot binary every scrape on a chipless
+            # node (collect_local still tries sysfs/jax next)
+            return samples
+        # no fresh tick yet (startup, or a stale/wedged engine): fall
+        # through to the bounded one-shot path
 
     binary = os.environ.get("TPU_TELEMETRY_BIN", "tpu-telemetry")
     try:
@@ -75,16 +194,7 @@ def collect_native() -> List[ChipSample]:
     if out.returncode != 0 or not out.stdout.strip():
         return []
     try:
-        rows = json.loads(out.stdout)
-        return [ChipSample(
-            r.get("chip_id", f"accel{i}"),
-            duty_cycle_pct=float(r.get("duty_cycle_pct") or 0),
-            hbm_used=int(r.get("hbm_used_bytes") or 0),
-            hbm_total=int(r.get("hbm_total_bytes") or 0),
-            tensorcore_util_pct=float(r.get("tensorcore_util_pct") or 0),
-            temperature_c=(float(r["temperature_c"])
-                           if r.get("temperature_c") is not None else None))
-            for i, r in enumerate(rows)]
+        return _rows_to_samples(json.loads(out.stdout))
     except (json.JSONDecodeError, TypeError, ValueError, AttributeError):
         # any unexpected shape (binary version skew, PATH shadowing) must
         # fall through to the Python collectors, not crash the engine
@@ -112,7 +222,10 @@ def collect_sysfs() -> List[ChipSample]:
             duty_cycle_pct=read_int("duty_cycle_pct"),
             hbm_used=read_int("hbm_used_bytes"),
             hbm_total=read_int("hbm_total_bytes"),
-            temperature_c=read_int("temp_millic", 0) / 1000.0 or None))
+            temperature_c=read_int("temp_millic", 0) / 1000.0 or None,
+            # an absent counter file must not read as a confident 0
+            hbm_usage_known=os.path.exists(
+                os.path.join(path, "hbm_used_bytes"))))
     return out
 
 
