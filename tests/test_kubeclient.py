@@ -43,9 +43,11 @@ class _State:
     def __init__(self):
         self.objects = {}           # resource path -> object dict
         self.requests = []          # (method, path, query, headers, body)
-        self.watch_batches = queue.Queue()  # each item: list of event dicts
+        self.watch_batches = queue.Queue()  # each item: list of event
+        # dicts, or the "hang" sentinel (idle stream, no bytes)
         self.watch_connections = 0
         self.rv = 100
+        self.hang_s = 5.0           # idle-stream duration for "hang"
         self.fail_next_writes = 0   # inject N 409s on PUT (conflict tests)
 
 
@@ -128,6 +130,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Connection", "close")
         self.end_headers()
+        if events == "hang":
+            # a quiet collection: stream stays open, no bytes arrive —
+            # the client's read timeout must fire and resume from rv
+            time.sleep(self.state.hang_s)
+            self.close_connection = True
+            return
         for evt in events:
             self.wfile.write((json.dumps(evt) + "\n").encode())
             self.wfile.flush()
@@ -525,6 +533,43 @@ class TestWatch:
         assert apiserver.watch_connections >= 2
         # the drop did NOT trigger a second list: exactly one ADDED
         assert [e for e in got if e[0] == "ADDED"] == [("ADDED", "w1")]
+
+    def test_idle_read_timeout_resumes_from_rv_without_relist(
+            self, apiserver, client, monkeypatch):
+        """A quiet collection hits the client read timeout before the
+        server recycles the stream; the watch must resume from the last
+        resourceVersion — NO second list, no ADDED replay (the ADVICE r3
+        finding: nulling rv here re-listed the world every ~5min per
+        idle watcher)."""
+        monkeypatch.setattr(HTTPClient, "WATCH_READ_TIMEOUT_S", 1.0)
+        apiserver.objects["/api/v1/namespaces/tpu-operator/pods/w9"] = \
+            pod("w9")
+        got = []
+        done = threading.Event()
+
+        def handler(evt):
+            got.append((evt.type, evt.obj["metadata"]["name"]))
+            if evt.type == "MODIFIED":
+                done.set()
+
+        apiserver.watch_batches.put("hang")  # stream 1: idle, no bytes
+        apiserver.watch_batches.put([
+            {"type": "MODIFIED", "object": pod("w9")}])  # resumed stream
+        unsub = client.watch("v1", "Pod", handler)
+        try:
+            assert done.wait(20), f"events: {got}"
+        finally:
+            unsub()
+        # resumed, not re-listed: exactly one ADDED ever
+        assert [e for e in got if e[0] == "ADDED"] == [("ADDED", "w9")]
+        lists = [r for r in apiserver.requests
+                 if r[0] == "GET" and r[2].get("watch") != ["true"]]
+        assert len(lists) == 1, [r[1] for r in lists]
+        watches = [r for r in apiserver.requests
+                   if r[2].get("watch") == ["true"]]
+        assert len(watches) >= 2
+        # the resumed stream carried the last seen resourceVersion
+        assert "resourceVersion" in watches[1][2]
 
     def test_read_timeout_detection_through_requests_wrappers(self):
         """The idle-watch 300s read timeout does NOT arrive as

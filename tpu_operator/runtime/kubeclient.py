@@ -160,6 +160,11 @@ class _FileTokenAuth(requests.auth.AuthBase):
 
 
 class HTTPClient(Client):
+    # idle-watch read timeout: real apiservers recycle streams every few
+    # minutes anyway; a quiet stream past this resumes from the last rv
+    # (no re-list). Class attribute so tests can shrink it.
+    WATCH_READ_TIMEOUT_S = 300.0
+
     def __init__(self, config: Optional[KubeConfig] = None):
         self.config = config or KubeConfig.load()
         self.session = requests.Session()
@@ -387,8 +392,9 @@ class HTTPClient(Client):
                               "allowWatchBookmarks": "true"}
                     if rv:
                         params["resourceVersion"] = rv
-                    with self.session.get(url, params=params, stream=True,
-                                          timeout=(10, 300)) as resp:
+                    with self.session.get(
+                            url, params=params, stream=True,
+                            timeout=(10, self.WATCH_READ_TIMEOUT_S)) as resp:
                         self._raise_for(resp, f"watch {kind}")
                         for line in resp.iter_lines():
                             if stop.is_set():
