@@ -189,7 +189,9 @@ def test_chaos_soak_converges_after_every_disruption():
         ops.create(new_cluster_policy())
         wait_converged(ops, ready, "initial install")
 
-        for step in range(10):
+        # default 10 disruptions; TPU_SOAK_STEPS=200 turns this into a
+        # long-soak tier for release qualification
+        for step in range(int(os.environ.get("TPU_SOAK_STEPS", "10"))):
             move = rng.choice(moves)
             desc, pred = move()
             wait_converged(ops, pred, f"step {step}: {desc}")
