@@ -1,0 +1,72 @@
+"""ClusterInfo facts provider (controllers/clusterinfo/clusterinfo.go
+analog): the per-getter parity surface and the single-pass facts() the
+reconcile loop consumes (and publishes on status.clusterInfo)."""
+
+from tpu_operator.api import labels as L
+from tpu_operator.controllers.clusterinfo import ClusterInfo
+from tpu_operator.runtime.fake import FakeClient
+
+
+def node(name, accel=None, topo=None, runtime="containerd://1.7.0",
+         kubelet="v1.29.1-gke.100", kernel="6.1.58+"):
+    labels = {}
+    if accel:
+        labels[L.GKE_TPU_ACCELERATOR] = accel
+        labels[L.GKE_TPU_TOPOLOGY] = topo or "2x2"
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels},
+            "status": {"nodeInfo": {
+                "containerRuntimeVersion": runtime,
+                "kubeletVersion": kubelet,
+                "kernelVersion": kernel}}}
+
+
+def seeded_client():
+    c = FakeClient()
+    c.create(node("cpu-0"))
+    c.create(node("tpu-0", accel="tpu-v5e-slice", topo="2x2"))
+    c.create(node("tpu-1", accel="tpu-v5e-slice", topo="2x2",
+                  kernel="6.1.99+"))
+    c.create(node("tpu-2", accel="tpu-v5p-slice", topo="2x2x1"))
+    return c
+
+
+class TestGetters:
+    def test_parity_surface(self):
+        info = ClusterInfo(seeded_client())
+        assert info.get_kubernetes_version() == "v1.29.1-gke.100"
+        assert info.get_container_runtime() == "containerd"
+        assert info.get_kernel_versions() == ["6.1.58+", "6.1.99+"]
+        assert info.get_tpu_topologies() == {"2x2": 2, "2x2x1": 1}
+        gens = info.get_tpu_generations()
+        assert gens.get("v5e") == 2 and gens.get("v5p") == 1
+
+
+class TestFacts:
+    def test_single_pass_matches_getters(self):
+        info = ClusterInfo(seeded_client())
+        facts = info.facts()
+        assert facts["kubernetesVersion"] == info.get_kubernetes_version()
+        assert facts["containerRuntime"] == info.get_container_runtime()
+        assert facts["kernelVersions"] == info.get_kernel_versions()
+        assert facts["tpuTopologies"] == info.get_tpu_topologies()
+        assert facts["tpuGenerations"] == info.get_tpu_generations()
+
+    def test_empty_cluster_defaults(self):
+        facts = ClusterInfo(FakeClient()).facts()
+        assert facts["kubernetesVersion"] == "unknown"
+        assert facts["containerRuntime"] == "containerd"
+        assert facts["tpuTopologies"] == {}
+
+    def test_facts_is_one_list_call(self):
+        c = seeded_client()
+        calls = []
+        orig = c.list
+
+        def counting(av, kind, opts=None):
+            calls.append(kind)
+            return orig(av, kind, opts)
+
+        c.list = counting
+        ClusterInfo(c).facts()
+        assert calls == ["Node"]

@@ -153,6 +153,12 @@ class TestHTTPLifecycle:
         install(ops)
         wait_for(ops, lambda: cr_state(ops) == "ready",
                  "ClusterPolicy ready over HTTP")
+        # cluster facts surfaced on the CR (clusterinfo.go's role)
+        cr = ops.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        facts = (cr.get("status") or {}).get("clusterInfo") or {}
+        assert facts.get("containerRuntime") == "containerd"
+        assert facts.get("tpuTopologies") == {"2x2x1": 2}
+        assert "v5p" in facts.get("tpuGenerations", {})
         # BASELINE target #1: the reference's e2e budget is 5 minutes
         # from install to all-operands-Ready (gpu_operator_test.go:83-88)
         elapsed = time.time() - t_install

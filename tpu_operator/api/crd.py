@@ -23,6 +23,8 @@ def _status_schema() -> dict:
             "conditions": {"type": "array",
                            "items": {"type": "object",
                                      "x-kubernetes-preserve-unknown-fields": True}},
+            "clusterInfo": {"type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True},
         },
     }
 

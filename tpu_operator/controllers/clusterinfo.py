@@ -1,8 +1,13 @@
 """Cluster facts provider (controllers/clusterinfo/clusterinfo.go:42-454
 analog). The OpenShift-specific getters (RHCOS versions, DTK images, proxy)
 have no TPU/GKE analog and are dropped per SURVEY.md section 7; the TPU
-additions are topology/generation summaries used by the topology manager
-and the bench harness.
+additions are topology/generation summaries.
+
+``facts()`` computes everything in ONE node list (a 200-node cluster must
+not pay one list per fact, per reconcile); the per-getter API is the
+parity surface, each expressed over that single pass so the two can
+never drift. The reconcile loop publishes the dict on the CR's
+``status.clusterInfo`` and passes it to states via SyncContext.cluster.
 """
 
 from __future__ import annotations
@@ -10,58 +15,80 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List
 
-from ..api import labels as L
 from ..runtime.client import Client
-from ..runtime.objects import get_nested, labels_of
+from ..runtime.objects import get_nested
+from .nodeinfo import attributes_of
+
+import logging
+
+log = logging.getLogger("tpu_operator.clusterinfo")
 
 
 @dataclass
 class ClusterInfo:
     client: Client
 
-    def get_kubernetes_version(self) -> str:
+    def facts(self) -> Dict:
+        """One pass over the node list. ``containerRuntime`` follows the
+        reference's getRuntime discipline (state_manager.go:714-751):
+        TPU nodes decide by majority (mixed fleets are warned about);
+        non-TPU nodes only serve as a fallback."""
+        k8s = ""
+        kernels = set()
+        topologies: Dict[str, int] = {}
+        generations: Dict[str, int] = {}
+        rt_counts: Dict[str, int] = {}
+        rt_fallback = ""
         for node in self.client.list("v1", "Node"):
-            v = get_nested(node, "status", "nodeInfo", "kubeletVersion",
-                           default="")
-            if v:
-                return v
-        return "unknown"
+            info = get_nested(node, "status", "nodeInfo", default={}) or {}
+            k8s = k8s or info.get("kubeletVersion", "")
+            if info.get("kernelVersion"):
+                kernels.add(info["kernelVersion"])
+            attrs = attributes_of(node)
+            rt = (info.get("containerRuntimeVersion") or "").split(":")[0]
+            if rt:
+                if attrs.is_tpu:
+                    rt_counts[rt] = rt_counts.get(rt, 0) + 1
+                elif not rt_fallback:
+                    rt_fallback = rt
+            if not attrs.is_tpu:
+                continue
+            topo = attrs.topology or "unknown"
+            topologies[topo] = topologies.get(topo, 0) + 1
+            if attrs.generation:
+                generations[attrs.generation] = \
+                    generations.get(attrs.generation, 0) + 1
+        if rt_counts:
+            if len(rt_counts) > 1:
+                log.warning("mixed container runtimes across TPU nodes: "
+                            "%s; using the majority runtime", rt_counts)
+            # majority wins; name breaks ties deterministically
+            runtime = max(rt_counts.items(),
+                          key=lambda kv: (kv[1], kv[0]))[0]
+        else:
+            runtime = rt_fallback or "containerd"
+        return {
+            "kubernetesVersion": k8s or "unknown",
+            "containerRuntime": runtime,
+            "kernelVersions": sorted(kernels),
+            "tpuTopologies": topologies,
+            "tpuGenerations": generations,
+        }
+
+    # -- per-getter parity surface (clusterinfo.go getters) ---------------
+
+    def get_kubernetes_version(self) -> str:
+        return self.facts()["kubernetesVersion"]
 
     def get_container_runtime(self) -> str:
-        for node in self.client.list("v1", "Node"):
-            rt = get_nested(node, "status", "nodeInfo",
-                            "containerRuntimeVersion", default="")
-            if rt:
-                return rt.split(":")[0]
-        return "containerd"
+        return self.facts()["containerRuntime"]
 
     def get_kernel_versions(self) -> List[str]:
-        out = set()
-        for node in self.client.list("v1", "Node"):
-            kv = get_nested(node, "status", "nodeInfo", "kernelVersion",
-                            default="")
-            if kv:
-                out.add(kv)
-        return sorted(out)
+        return self.facts()["kernelVersions"]
 
     def get_tpu_topologies(self) -> Dict[str, int]:
         """topology string -> node count, across TPU nodes."""
-        out: Dict[str, int] = {}
-        for node in self.client.list("v1", "Node"):
-            nl = labels_of(node)
-            if L.GKE_TPU_ACCELERATOR not in nl:
-                continue
-            topo = nl.get(L.GKE_TPU_TOPOLOGY, "unknown")
-            out[topo] = out.get(topo, 0) + 1
-        return out
+        return self.facts()["tpuTopologies"]
 
     def get_tpu_generations(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
-        for node in self.client.list("v1", "Node"):
-            nl = labels_of(node)
-            accel = nl.get(L.GKE_TPU_ACCELERATOR)
-            if not accel:
-                continue
-            gen = L.accelerator_generation(accel)
-            out[gen] = out.get(gen, 0) + 1
-        return out
+        return self.facts()["tpuGenerations"]

@@ -160,6 +160,11 @@ class ClusterPolicyReconciler(Reconciler):
 
         extra = {"tpudriver_crd_mode": self._tpudriver_crd_mode()}
         results = self.state_manager.sync(cr, spec, extra)
+        # cluster facts ride the same status write the conditions make
+        # (clusterinfo.go's role: surfaced state, not just internal use)
+        if self.state_manager.last_cluster_facts:
+            set_nested(cr, self.state_manager.last_cluster_facts,
+                       "status", "clusterInfo")
 
         not_ready = {n: r for n, r in results.items() if not r.ready}
         errors = {n: r for n, r in results.items()

@@ -23,6 +23,7 @@ from ..runtime.client import Client
 from ..runtime.objects import get_nested, label_delta, labels_of, name_of
 from ..state.operands import build_states
 from ..state.state import State, SyncContext, SyncResult, SyncStatus
+from .clusterinfo import ClusterInfo
 
 log = logging.getLogger("tpu_operator.state_manager")
 
@@ -111,6 +112,9 @@ class StateManager:
     client: Client
     namespace: str
     states: List[State] = field(default_factory=build_states)
+    # clusterinfo facts captured by the last sync() pass; the controller
+    # publishes them on the CR's status.clusterInfo
+    last_cluster_facts: Dict = field(default_factory=dict)
 
     def label_tpu_nodes(self, default_config: str = "container",
                         sandbox_enabled: bool = True,
@@ -142,29 +146,9 @@ class StateManager:
 
     def detect_runtime(self) -> str:
         """Container runtime from TPU-node status only (getRuntime analog,
-        state_manager.go:714-751 — the reference records the runtime from
-        GPU nodes specifically). Mixed runtimes across TPU nodes are
-        surfaced with a warning and resolved by majority; non-TPU nodes
-        only serve as a fallback when no TPU node reports one."""
-        counts: Dict[str, int] = {}
-        fallback = ""
-        for node in self.client.list("v1", "Node"):
-            rt = get_nested(node, "status", "nodeInfo",
-                            "containerRuntimeVersion", default="")
-            if not rt:
-                continue
-            name = rt.split(":")[0]
-            if is_tpu_node(node):
-                counts[name] = counts.get(name, 0) + 1
-            elif not fallback:
-                fallback = name
-        if not counts:
-            return fallback or "containerd"
-        if len(counts) > 1:
-            log.warning("mixed container runtimes across TPU nodes: %s; "
-                        "using the majority runtime", counts)
-        # majority wins; name breaks ties deterministically
-        return max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        state_manager.go:714-751). The majority/fallback discipline lives
+        in ClusterInfo.facts(); this is the standalone accessor."""
+        return ClusterInfo(self.client).facts()["containerRuntime"]
 
     def ensure_namespace_psa(self, enabled: bool) -> None:
         """Stamp pod-security.kubernetes.io/{enforce,audit,warn}=privileged
@@ -202,9 +186,15 @@ class StateManager:
              extra: Optional[dict] = None) -> Dict[str, SyncResult]:
         """Drive every state once; returns per-state results (step() loop
         analog, clusterpolicy_controller.go:155-179)."""
+        # one facts() pass covers runtime detection too; the dict rides
+        # the context (states may template on it) and is kept for the
+        # controller's status.clusterInfo write
+        facts = ClusterInfo(self.client).facts()
+        self.last_cluster_facts = facts
         ctx = SyncContext(client=self.client, policy=policy, spec=spec,
                           namespace=self.namespace,
-                          cluster={"runtime": self.detect_runtime()},
+                          cluster={"runtime": facts["containerRuntime"],
+                                   **facts},
                           extra=extra or {})
         results: Dict[str, SyncResult] = {}
         for state in self.states:
