@@ -178,6 +178,36 @@ def test_install_rejects_invalid_values(cluster, capsys):
                     "CustomResourceDefinition") == []
 
 
+def test_status_verb_tracks_lifecycle(cluster, capsys):
+    """`tpuop-cfg status` is the helm-status slot: NOT READY right after
+    install (operator not yet reconciling), READY with per-operand and
+    cluster-facts detail once converged, rc 1 after uninstall."""
+    srv, ops = cluster
+    assert tpuop_cfg.main(["status"]) == 1
+    assert "no TPUClusterPolicy" in capsys.readouterr().out
+
+    assert tpuop_cfg.main(["install"]) == 0
+    capsys.readouterr()
+    assert tpuop_cfg.main(["status"]) == 1  # CR exists, nothing reconciles
+    assert "NOT READY" in capsys.readouterr().out
+
+    mgr, mgr_client = boot_manager(srv)
+    try:
+        wait_for(ops, lambda: cr_state(ops) == "ready", "ready")
+        assert tpuop_cfg.main(["status"]) == 0
+        out = capsys.readouterr().out
+        assert "TPUClusterPolicy/tpu-cluster-policy: ready" in out
+        assert "tpu-device-plugin-daemonset: 2/2 ready" in out
+        assert "generations {'v5p': 2}" in out
+        assert out.strip().splitlines()[-1] == "READY"
+    finally:
+        mgr.stop()
+        mgr_client._stop.set()
+    assert tpuop_cfg.main(["uninstall"]) == 0
+    capsys.readouterr()
+    assert tpuop_cfg.main(["status"]) == 1
+
+
 def test_diff_clean_after_install_then_flags_manual_edit(cluster, capsys):
     """The kubectl-diff/helm-diff slot composes with the install verb: a
     fresh install has zero drift; a manual kubectl-edit is flagged with

@@ -72,6 +72,86 @@ def _generate_docs(args):
     return generate(args.what, namespace=namespace, image=args.image)
 
 
+def _status(args) -> int:
+    """One-shot install health (kubectl-get rolled into the operator's
+    own vocabulary): CR states + ready conditions, per-operand DaemonSet
+    readiness, node upgrade-state histogram, cluster facts. Exit 0 only
+    when every CR reports ready — scriptable like `helm status`."""
+    from ..api import V1, V1ALPHA1
+    from ..api import labels as L
+    from ..runtime.client import ListOptions, NotFoundError
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+    from ..runtime.objects import get_nested, labels_of, name_of
+    from ..state.skel import daemonset_ready
+
+    try:
+        client = HTTPClient(KubeConfig.load())
+    except Exception as e:
+        print(f"cannot reach the cluster: {e}", file=sys.stderr)
+        return 1
+
+    try:
+        all_ready = True
+        any_cr = False
+        for av, kind in ((V1, KIND_CLUSTER_POLICY),
+                         (V1ALPHA1, KIND_TPU_DRIVER)):
+            try:
+                crs = client.list(av, kind)
+            except NotFoundError:
+                continue
+            for cr in crs:
+                any_cr = True
+                state = get_nested(cr, "status", "state",
+                                   default="unset")
+                all_ready = all_ready and state == "ready"
+                msg = next((c.get("message", "") for c in
+                            get_nested(cr, "status", "conditions",
+                                       default=[]) or []
+                            if c.get("type") == "Ready"), "")
+                print(f"{kind}/{name_of(cr)}: {state}"
+                      + (f" — {msg}" if msg else ""))
+                info = get_nested(cr, "status", "clusterInfo",
+                                  default=None)
+                if info:
+                    print(f"  cluster: k8s {info.get('kubernetesVersion')}"
+                          f", {info.get('containerRuntime')}, "
+                          f"topologies {info.get('tpuTopologies')}, "
+                          f"generations {info.get('tpuGenerations')}")
+        if not any_cr:
+            print("no TPUClusterPolicy/TPUDriver CRs found")
+            return 1
+
+        dss = client.list("apps/v1", "DaemonSet", ListOptions(
+            namespace=args.namespace,
+            label_selector={"matchExpressions": [
+                {"key": L.STATE_LABEL, "operator": "Exists"}]}))
+        for ds in sorted(dss, key=name_of):
+            ok, why = daemonset_ready(ds)
+            status = ds.get("status") or {}
+            print(f"  {name_of(ds)}: "
+                  f"{status.get('numberReady', 0)}/"
+                  f"{status.get('desiredNumberScheduled', 0)} ready"
+                  + ("" if ok else f" ({why})"))
+            all_ready = all_ready and ok
+
+        upgrade: dict = {}
+        tpu_nodes = 0
+        for node in client.list("v1", "Node"):
+            nl = labels_of(node)
+            if L.TPU_PRESENT in nl:
+                tpu_nodes += 1
+            s = nl.get(L.UPGRADE_STATE)
+            if s:
+                upgrade[s] = upgrade.get(s, 0) + 1
+        print(f"nodes: {tpu_nodes} TPU"
+              + (f", upgrade states {upgrade}" if upgrade else ""))
+        print("READY" if all_ready else "NOT READY")
+        return 0 if all_ready else 1
+    except Exception as e:
+        print(f"status failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+
+
 def _lifecycle(args) -> int:
     """install / upgrade / uninstall against the cluster KubeConfig.load()
     resolves (in-cluster SA or $KUBECONFIG) — the Helm-verb UX without
@@ -212,6 +292,12 @@ def main(argv=None) -> int:
         i.add_argument("--timeout", type=float, default=300.0,
                        help="--wait budget; default matches the "
                             "reference e2e's 5-minute install budget")
+    st = sub.add_parser(
+        "status", help="one-shot install health: CR states, per-operand "
+                       "readiness, node upgrade states, cluster facts; "
+                       "exit 1 unless everything is ready")
+    st.add_argument("-n", "--namespace", default="tpu-operator")
+
     u = sub.add_parser("uninstall",
                        help="delete CRs (waiting for operand teardown), "
                             "then the operator stream (pre-delete hook "
@@ -227,6 +313,8 @@ def main(argv=None) -> int:
 
     if args.cmd in ("install", "upgrade", "uninstall"):
         return _lifecycle(args)
+    if args.cmd == "status":
+        return _status(args)
 
     if args.cmd == "diff":
         docs = _generate_docs(args)
