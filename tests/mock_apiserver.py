@@ -87,6 +87,9 @@ class MockApiServer:
         # (group, version, plural) -> openAPIV3Schema for registered CRDs;
         # writes to matching CR collections run admission (CEL + types)
         self.crd_schemas: dict[tuple, dict] = {}
+        # (group, version, plural) CRDs declaring subresources.status —
+        # main-resource writes must preserve stored status for these
+        self.crd_status_sub: set[tuple] = set()
         self.watchers: list[tuple[str, queue.Queue, threading.Event]] = []
         # (rv, coll, alt_coll, event) log so a watch carrying
         # ?resourceVersion=X replays everything newer than X — real
@@ -147,19 +150,34 @@ class MockApiServer:
             for ver in spec.get("versions") or []:
                 schema = ((ver.get("schema") or {})
                           .get("openAPIV3Schema") or {})
-                self.crd_schemas[(group, ver.get("name", ""),
-                                  plural)] = schema
+                key = (group, ver.get("name", ""), plural)
+                self.crd_schemas[key] = schema
+                if "status" in (ver.get("subresources") or {}):
+                    self.crd_status_sub.add(key)
+                else:
+                    self.crd_status_sub.discard(key)
 
     def schema_for_collection(self, coll_path: str):
         """openAPIV3Schema for a CR collection path, else None. Handles
         cluster-scoped (/apis/g/v/plural) and namespaced
         (/apis/g/v/namespaces/ns/plural) shapes."""
+        key = self._crd_key(coll_path)
+        if key is None:
+            return None
+        with self.lock:
+            return self.crd_schemas.get(key)
+
+    def has_status_subresource(self, coll_path: str) -> bool:
+        key = self._crd_key(coll_path)
+        with self.lock:
+            return key in self.crd_status_sub
+
+    @staticmethod
+    def _crd_key(coll_path: str):
         segs = _segments(coll_path)
         if not segs or segs[0] != "apis" or len(segs) < 4:
             return None
-        group, version, plural = segs[1], segs[2], segs[-1]
-        with self.lock:
-            return self.crd_schemas.get((group, version, plural))
+        return (segs[1], segs[2], segs[-1])
 
     def publish(self, type_: str, obj_path: str, obj: dict):
         coll = collection_of(obj_path)
@@ -465,6 +483,15 @@ class _Handler(BaseHTTPRequestHandler):
             if errs:
                 return self._invalid(errs)
             merged = body
+            # CRDs with a status subresource: main-resource PUT cannot
+            # touch status on a real apiserver — stored status survives
+            # the replace (else `tpuop-cfg upgrade` would wipe CR status
+            # here while leaving it intact on a real cluster)
+            if self.st.has_status_subresource(collection_of(target)):
+                if "status" in current:
+                    merged["status"] = copy.deepcopy(current["status"])
+                else:
+                    merged.pop("status", None)
             meta = merged.setdefault("metadata", {})
             meta["uid"] = (current.get("metadata") or {}).get("uid")
             cur_gen = (current.get("metadata") or {}).get("generation", 1)
@@ -515,7 +542,18 @@ class _Handler(BaseHTTPRequestHandler):
                     out[k] = v
             return out
 
-        merged = merge(current, body)
+        # merge over a deep copy: merge() reuses subtrees the patch does
+        # not touch, and admission defaulting mutates the new object in
+        # place — without the copy a rejected or no-op PATCH would default
+        # the STORED object with no RV bump or watch event
+        merged = merge(copy.deepcopy(current), body)
+        # status subresource: a main-resource merge-patch cannot change
+        # status (same apiserver rule the PUT path enforces)
+        if self.st.has_status_subresource(collection_of(u.path)):
+            if "status" in current:
+                merged["status"] = copy.deepcopy(current["status"])
+            else:
+                merged.pop("status", None)
         # real apiservers run CEL/schema admission on every write verb —
         # a merge-patch must not slip past what PUT would bounce
         errs = self._admission(collection_of(u.path), merged, current)

@@ -55,6 +55,12 @@ class TestEvaluator:
         assert evaluate("'k' in self", {"k": 1})
         assert evaluate("size(self.xs) == 2", {"xs": [1, 2]})
 
+    def test_in_over_strings_is_not_cel(self):
+        # real CEL has no substring `in`; accepting it offline would let
+        # a rule pass here and fail to compile on a real apiserver
+        with pytest.raises(EvalError):
+            evaluate("'a' in 'abc'", None)
+
     def test_immutability_rule_shape(self):
         assert evaluate("self == oldSelf", "x", "x")
         assert not evaluate("self == oldSelf", "x", "y")
@@ -199,6 +205,43 @@ class TestApiserverAdmission:
         with pytest.raises(InvalidError):
             client.create(new_tpu_driver("pool-b", spec={
                 "imagePullPolicy": "Sometimes"}))
+
+    def test_defaulted_channel_still_immutable(self, cluster):
+        """The ADVICE r4 medium: a TPUDriver created WITHOUT channel must
+        not be flippable to nightly later — the schema default (stable)
+        is applied at write time, so oldSelf exists and the transition
+        rule fires. Without the default the rule is silently skipped."""
+        from tpu_operator.runtime.client import InvalidError
+
+        _, client = cluster
+        client.create(new_tpu_driver("pool-d", spec={}))
+        live = client.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-d")
+        # the apiserver persisted the defaulted spec
+        assert live["spec"]["channel"] == "stable"
+        assert live["spec"]["driverType"] == "libtpu"
+        live["spec"]["channel"] = "nightly"
+        with pytest.raises(InvalidError, match="channel is immutable"):
+            client.update(live)
+        with pytest.raises(InvalidError, match="channel is immutable"):
+            client.patch("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-d",
+                         {"spec": {"channel": "nightly"}})
+
+    def test_main_resource_put_preserves_status(self, cluster):
+        """CRDs declare a status subresource, so a main-resource PUT (the
+        tpuop-cfg upgrade path) must not wipe stored status — the real
+        apiserver preserves it (ADVICE r4 mock realism gap)."""
+        _, client = cluster
+        client.create(new_tpu_driver("pool-e", spec={}))
+        live = client.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-e")
+        live["status"] = {"state": "ready"}
+        client.update_status(live)
+        live = client.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-e")
+        live["spec"]["version"] = "2024.9"
+        live.pop("status", None)  # replace sends no status at all
+        client.update(live)
+        after = client.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-e")
+        assert after["status"] == {"state": "ready"}
+        assert after["spec"]["version"] == "2024.9"
 
     def test_merge_patch_cannot_slip_past_admission(self, cluster):
         """Real apiservers run CEL on every write verb; a PATCH mutating

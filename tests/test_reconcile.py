@@ -378,6 +378,72 @@ class TestStaleConditionalObjects:
             "stale ServiceMonitor survived knob flip"
 
 
+def test_first_start_sweep_is_per_client():
+    """ADVICE r4: the first-start widened-sweep marker must be keyed by
+    client, not process-global — a second manager/cluster in the same
+    process gets its own full first sweep (else its stale leftovers from
+    an older operator version survive forever)."""
+    from tpu_operator.api.labels import STATE_LABEL
+    from tpu_operator.state.skel import apply_objects
+
+    def stale_rolebinding(client):
+        # a kind the bounded sweep below would never look at
+        client.create({
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": "left-behind", "namespace": "tpu-operator",
+                         "labels": {STATE_LABEL: "state-x"}},
+        })
+
+    for _ in range(2):  # second client must behave exactly like the first
+        c = FakeClient()
+        stale_rolebinding(c)
+        bounded = {("v1", "ConfigMap")}
+        apply_objects(c, None, "state-x", [], "tpu-operator",
+                      sweep_kinds=bounded)
+        assert c.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                      ListOptions(namespace="tpu-operator")) == [], \
+            "first reconcile must widen the sweep for every new client"
+        # steady state: the bounded sweep leaves out-of-bound kinds alone
+        stale_rolebinding(c)
+        apply_objects(c, None, "state-x", [], "tpu-operator",
+                      sweep_kinds=bounded)
+        assert len(c.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                          ListOptions(namespace="tpu-operator"))) == 1
+
+
+def test_install_to_ready_not_rebased_by_restart():
+    """ADVICE r4: an operator restart observing a CR that already carries
+    status (mid-install or ready) must not record a restart->ready figure
+    over the genuine install figure."""
+    from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+    def drive_to_ready(client):
+        rec, _ = reconcile_once(client)
+        client.simulate_kubelet(ready=True)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        got = client.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        assert got["status"]["state"] == "ready"
+
+    gauge = lambda: OPERATOR_METRICS.install_to_ready.labels(  # noqa: E731
+        policy="tpu-cluster-policy")._value.get()
+
+    c = make_cluster()
+    cr = new_cluster_policy()
+    cr.setdefault("status", {})["state"] = "notReady"  # prior process wrote it
+    c.create(cr)
+    OPERATOR_METRICS.install_to_ready.clear()
+    drive_to_ready(c)
+    assert gauge() == 0, "restart->ready must not be recorded as install"
+
+    # a genuinely new CR (no status) still records the install figure
+    c2 = make_cluster()
+    c2.create(new_cluster_policy())
+    OPERATOR_METRICS.install_to_ready.clear()
+    drive_to_ready(c2)
+    assert gauge() > 0
+
+
 def test_template_kinds_scan_includes_conditional_docs():
     """The stale-sweep bound comes from a textual scan of each state dir,
     so kinds behind {{- if }} guards (the plugin-config ClusterRole, the

@@ -242,9 +242,10 @@ def _eval(node, env: dict) -> Any:
         return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[sym]
     if op == "in":
         item, coll = _eval(node[1], env), _eval(node[2], env)
-        if isinstance(coll, dict):
-            return item in coll
-        if isinstance(coll, (list, str)):
+        # real CEL defines `in` over lists and maps only — no substring
+        # test; accepting strings here would let a rule validate offline
+        # and then fail to compile on a real apiserver
+        if isinstance(coll, (dict, list)):
             return item in coll
         raise EvalError(f"'in' on non-collection {coll!r}")
     if op == "call":

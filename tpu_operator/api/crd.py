@@ -107,6 +107,13 @@ def tpu_driver_crd() -> dict:
     # enum tightening: catch typos at apply time, not reconcile time
     schema["properties"]["channel"]["enum"] = ["stable", "nightly", "custom"]
     schema["properties"]["driverType"]["enum"] = ["libtpu", "host"]
+    # defaults pair with the immutability rules above: without them a CR
+    # created without channel has no oldSelf at this node, so the
+    # transition rule is skipped and the build-stream flip it forbids
+    # slips through (the reference pairs +kubebuilder:default with every
+    # XValidation transition rule for exactly this reason)
+    schema["properties"]["channel"]["default"] = "stable"
+    schema["properties"]["driverType"]["default"] = "libtpu"
     schema["properties"]["imagePullPolicy"]["enum"] = [
         "Always", "IfNotPresent", "Never"]
     # a custom channel has no default build tag to resolve — it must pin

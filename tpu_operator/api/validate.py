@@ -8,6 +8,7 @@ CR must fail at render time).
 
 from __future__ import annotations
 
+import copy
 from typing import Any, List, Optional, Tuple
 
 from . import KIND_CLUSTER_POLICY, KIND_TPU_DRIVER, V1, V1ALPHA1
@@ -96,25 +97,63 @@ def validate_cr(cr: dict) -> Tuple[List[str], str]:
     if not (cr.get("metadata") or {}).get("name"):
         errs.append("metadata.name: required")
     schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
-    errs.extend(_schema_errors(cr.get("spec") or {},
-                               schema["properties"]["spec"], "/spec"))
-    errs.extend(cel.schema_cel_errors(cr.get("spec") or {}, None,
+    # validate what the apiserver would persist: the defaulted spec
+    spec = apply_schema_defaults(copy.deepcopy(cr.get("spec") or {}),
+                                 schema["properties"]["spec"])
+    errs.extend(_schema_errors(spec, schema["properties"]["spec"], "/spec"))
+    errs.extend(cel.schema_cel_errors(spec, None,
                                       schema["properties"]["spec"], "/spec"))
     errs.extend(_image_errors(cr))
     errs.extend(_semantic_errors(cr, kind))
     return errs, kind
 
 
+def apply_schema_defaults(obj: Any, schema: dict) -> Any:
+    """Structural-schema defaulting, the apiserver's write-time pass:
+    an absent (or null) field whose schema carries ``default:`` is filled
+    in before validation runs. Defaults apply only inside objects that
+    are present — an absent parent object is not conjured (matching the
+    apiserver, which defaults within existing structure only). Mutates
+    and returns ``obj``."""
+    if not isinstance(obj, dict) or schema.get("type") != "object":
+        return obj
+    for key, sub in (schema.get("properties") or {}).items():
+        if obj.get(key) is None and "default" in sub:
+            obj[key] = copy.deepcopy(sub["default"])
+        if isinstance(obj.get(key), dict):
+            apply_schema_defaults(obj[key], sub)
+        elif isinstance(obj.get(key), list) and \
+                (sub.get("items") or {}).get("type") == "object":
+            for item in obj[key]:
+                apply_schema_defaults(item, sub["items"])
+    return obj
+
+
 def admission_errors(new: dict, old: Optional[dict],
                      schema: dict) -> List[str]:
     """What a real apiserver checks on create/update of a CR whose CRD
-    carries this openAPIV3Schema: structural types + enums, then every
-    CEL x-kubernetes-validations rule (transition rules only on update).
-    Used by the mock apiserver so admission-time rejection is testable
-    `kubectl apply`-shaped (nvidiadriver_types.go:40-186 parity)."""
+    carries this openAPIV3Schema: structural defaulting first (mutates
+    ``new`` in place, so callers persist the defaulted object exactly as
+    the apiserver does), then structural types + enums, then every CEL
+    x-kubernetes-validations rule (transition rules only on update).
+    Defaulting before CEL is what makes transition rules on defaulted
+    fields sound: oldSelf always exists, so an in-place flip of e.g.
+    `channel` cannot slip past `self == oldSelf` by having been created
+    without the field. Used by the mock apiserver so admission-time
+    rejection is testable `kubectl apply`-shaped
+    (nvidiadriver_types.go:40-186 parity)."""
     spec_schema = (schema.get("properties") or {}).get("spec") or {}
-    new_spec = new.get("spec") or {}
+    new_spec = new.get("spec")
+    if isinstance(new_spec, dict):
+        apply_schema_defaults(new_spec, spec_schema)
+    new_spec = new_spec or {}
     old_spec = (old or {}).get("spec") if old is not None else None
+    if isinstance(old_spec, dict):
+        # stored objects were defaulted at their own write time on a real
+        # apiserver; fixture-injected mock objects may predate that, so
+        # default a copy rather than trusting the store
+        old_spec = apply_schema_defaults(copy.deepcopy(old_spec),
+                                         spec_schema)
     errs = _schema_errors(new_spec, spec_schema, "/spec")
     errs.extend(cel.schema_cel_errors(new_spec, old_spec, spec_schema,
                                       "/spec"))

@@ -108,10 +108,14 @@ class ClusterPolicyReconciler(Reconciler):
             return Result()
         if request.name not in self._first_seen:
             self._first_seen[request.name] = _time.monotonic()
-            if get_nested(cr, "status", "state") == STATE_READY:
-                # an operator restart observing an already-ready CR is
-                # not an install — recording it would overwrite the real
-                # install figure with near-zero
+            if get_nested(cr, "status", "state") is not None or \
+                    get_nested(cr, "status", "conditions"):
+                # any prior status means a previous operator process
+                # already observed this CR: this observation is a
+                # restart, not an install. Recording restart->ready
+                # (near-zero for an already-ready CR, or a rebased
+                # partial figure for a mid-install restart) would
+                # overwrite the genuine install figure.
                 self._ready_recorded.add(request.name)
 
         # singleton: the oldest CR by (creationTimestamp, name) wins

@@ -19,6 +19,8 @@ hard-won behaviors SURVEY.md section 7 calls out:
 from __future__ import annotations
 
 import logging
+import threading
+import weakref
 from typing import Iterable, List, Optional, Tuple
 
 from ..api.labels import LAST_APPLIED_HASH, STATE_LABEL
@@ -37,8 +39,12 @@ from ..utils.hash import object_hash
 log = logging.getLogger("tpu_operator.state")
 
 
-_fully_swept: set = set()  # state names that have had a full sweep since
-# process start — see the first-reconcile widening below
+# per-client state names that have had a full sweep since that client's
+# manager started — see the first-reconcile widening below. Keyed by
+# client identity (weakly, so test clients don't accumulate): a second
+# manager/cluster in the same process gets its own first-start sweep.
+_fully_swept: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_swept_lock = threading.Lock()
 
 
 def apply_objects(client: Client, owner: Optional[dict], state_name: str,
@@ -56,7 +62,8 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
     dropped entirely would otherwise never be swept — the 'stale grant
     survives forever' failure, reintroduced across operator upgrades.
     Steady-state reconciles keep the bounded (cheap) sweep."""
-    full_sweep = state_name not in _fully_swept
+    with _swept_lock:
+        full_sweep = state_name not in _fully_swept.setdefault(client, set())
     if full_sweep:
         sweep_kinds = None
     applied: List[dict] = []
@@ -96,7 +103,8 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
         # only after the widened sweep actually ran: an exception during
         # apply or sweep must leave the state unmarked so the reconcile
         # retry still performs the full first-start sweep
-        _fully_swept.add(state_name)
+        with _swept_lock:
+            _fully_swept.setdefault(client, set()).add(state_name)
     return applied
 
 
