@@ -14,6 +14,7 @@ required at build time.
 from __future__ import annotations
 
 import glob
+import json
 import logging
 import os
 import threading
@@ -26,6 +27,9 @@ import grpc
 from . import api_pb2 as pb
 
 log = logging.getLogger("tpu_device_plugin")
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
 
 KUBELET_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
 KUBELET_SOCKET = "kubelet.sock"
@@ -191,6 +195,44 @@ def device_host_path(device_id: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# per-device health (the NVML/XID slot behind object_controls.go:1310)
+# ---------------------------------------------------------------------------
+
+
+def health_engine_chip_status(timeout: float = 2.0) -> Dict[str, str]:
+    """chip_id -> ok|warn|fail from the node's health engine
+    (``TPU_HEALTH_ENGINE_INFO``, the DCGM_REMOTE_HOSTENGINE_INFO analog).
+    The reference plugin drives per-device health from NVML/XID events;
+    here the health engine owns the telemetry session and this plugin
+    consumes its verdicts. Unset env or an unreachable engine returns {}
+    — no verdicts, not all-unhealthy: a telemetry outage must not
+    deschedule a node's TPUs."""
+    info = os.environ.get("TPU_HEALTH_ENGINE_INFO")
+    if not info:
+        return {}
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{info}/v1/health"
+    try:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                doc = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # the engine answers 503 when any chip FAILs — that body IS
+            # the verdict payload, not an outage
+            doc = json.loads(e.read())
+    except Exception as e:
+        log.warning("health engine %s unreachable (%s); no verdicts", info, e)
+        return {}
+    return {c.get("chip_id", ""): c.get("status", "ok")
+            for c in doc.get("chips", [])}
+
+
+FAIL_STATUS = "fail"  # health_engine.FAIL without importing jax-adjacent code
+
+
+# ---------------------------------------------------------------------------
 # isolated pool (sandbox-device-plugin slot)
 # ---------------------------------------------------------------------------
 
@@ -258,8 +300,20 @@ class TPUDevicePlugin:
                  config_dir: Optional[str] = None,
                  default_config: Optional[str] = None,
                  config_selector: Optional[
-                     Callable[[], Optional[str]]] = None):
+                     Callable[[], Optional[str]]] = None,
+                 health_source: Optional[
+                     Callable[[], Dict[str, str]]] = None):
         self.resource_name = resource_name
+        # chip_id -> ok|warn|fail; default consults the node's health
+        # engine when TPU_HEALTH_ENGINE_INFO is set
+        self.health_source = health_source or health_engine_chip_status
+        # unit -> last advertised device IDs: a unit that vanishes from
+        # discovery without a legitimate reason (fenced away, slice
+        # regrouping) is re-advertised Unhealthy instead of silently
+        # shrinking the list — kubelet then drops allocatable and stops
+        # scheduling, and the operator can see WHY
+        self._seen_units: Dict[str, List[str]] = {}
+        self._group_sig: Optional[tuple] = None
         self.socket_dir = socket_dir
         self.plugin_socket = plugin_socket
         self.discover = discover or self._default_discover
@@ -413,9 +467,58 @@ class TPUDevicePlugin:
             return True
         return False
 
+    def _chip_status(self) -> Dict[str, str]:
+        try:
+            return self.health_source() or {}
+        except Exception as e:
+            log.warning("health source failed (%s); no verdicts", e)
+            return {}
+
+    def _apply_health(self, devices: List[pb.Device]) -> List[pb.Device]:
+        """Health-engine verdicts + vanished-unit tracking. A unit whose
+        member chip FAILs goes Unhealthy; a unit that disappears from
+        discovery stays advertised Unhealthy until it returns (or was
+        legitimately removed: fenced into the isolated pool, or the slice
+        grouping changed so its unit ID no longer exists)."""
+        from ..isolation.fencing import fenced_chips
+
+        status = self._chip_status()
+        groups = slice_groups() or {}
+        try:
+            fenced = set(fenced_chips())
+        except Exception:
+            fenced = set()
+        out: List[pb.Device] = []
+        seen_now: Dict[str, List[str]] = {}
+        for d in devices:
+            unit = d.ID.split(REPLICA_SEP, 1)[0]
+            members = groups.get(unit, [unit])
+            bad = any(status.get(m) == FAIL_STATUS for m in members)
+            out.append(pb.Device(
+                ID=d.ID, health=UNHEALTHY if bad else d.health))
+            seen_now.setdefault(unit, []).append(d.ID)
+        # a slice-regroup renames every unit; stale unit IDs are not
+        # vanished hardware — reset tracking instead of ghost-advertising
+        sig = tuple(sorted(groups)) if groups else None
+        if sig != self._group_sig:
+            self._group_sig = sig
+            self._seen_units = {}
+        for unit, ids in self._seen_units.items():
+            if unit in seen_now:
+                continue
+            if set(groups.get(unit, [unit])) & fenced:
+                continue  # moved to the isolated pool, not dead
+            for device_id in ids:
+                out.append(pb.Device(ID=device_id, health=UNHEALTHY))
+            seen_now[unit] = list(ids)
+            log.warning("unit %s vanished from discovery; advertising "
+                        "Unhealthy", unit)
+        self._seen_units = seen_now
+        return out
+
     def refresh_devices(self) -> None:
         self.reload_plugin_config()
-        devices = self.discover()
+        devices = self._apply_health(self.discover())
         with self._cond:
             if [(d.ID, d.health) for d in devices] != \
                     [(d.ID, d.health) for d in self._devices]:
@@ -559,6 +662,19 @@ class IsolatedTPUDevicePlugin(TPUDevicePlugin):
         # the isolated plugin runs where the fence BELONGS — never
         # withdraw it here
         pass
+
+    def _apply_health(self, devices: List[pb.Device]) -> List[pb.Device]:
+        # vTPU device IDs carry their backing chip's health; no
+        # vanished-unit tracking here — leaving this pool (unfencing,
+        # profile withdrawal) is the normal exit path, not a dead chip
+        status = self._chip_status()
+        vtpus = vtpu_lookup()
+        return [pb.Device(
+            ID=d.ID,
+            health=UNHEALTHY
+            if status.get((vtpus.get(d.ID) or {}).get("chip", d.ID))
+            == FAIL_STATUS else d.health)
+            for d in devices]
 
     def refresh_devices(self) -> None:
         # the advertised resource follows the pool's mode: flipping a node
