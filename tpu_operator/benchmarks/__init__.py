@@ -1,0 +1,10 @@
+"""Control-plane benchmarks — mock-cluster scale measurements.
+
+The reference's implicit performance contract is operational (SURVEY.md
+section 6: 5-minute install budget, 5s requeues); it publishes no
+scale numbers and its reconcile re-lists all nodes every pass
+(clusterpolicy_controller.go:155-179, state_manager.go:481-581). These
+harnesses measure this operator's reconcile loop at cluster scale on the
+fake apiserver so the numbers ride the official bench record and regress
+loudly in tests (tests/test_scale.py).
+"""

@@ -1,0 +1,111 @@
+"""Reconcile-loop scale benchmark on a mock cluster.
+
+Measures what the reference never did (its loop re-lists all nodes every
+reconcile, controllers/clusterpolicy_controller.go:155-179 +
+state_manager.go:481-581, and ships no numbers for it):
+
+- install -> all-operands-Ready wall time on an N-node cluster,
+- a steady-state reconcile pass's wall time,
+- apiserver requests per steady-state pass, split by verb — the number
+  that must be O(states), not O(states x nodes).
+
+Used by tests/test_scale.py (budget assertions) and bench.py (the scale
+lines on the official record). Everything runs on the in-memory fake
+apiserver: this benchmark is about the operator's own request/CPU
+behavior, which is identical against the mock and a real apiserver
+modulo wire latency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..api import labels as L
+from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, new_cluster_policy
+from ..runtime import FakeClient, Request
+
+# BASELINE.md: install -> all-operands-Ready under 5 minutes. The single
+# source for both the test budget and the official record's vs_baseline.
+INSTALL_BUDGET_S = 300.0
+
+# a realistic GKE mix: several TPU pools of different generation and
+# topology (distinct node pools in nodepool.py terms), multi-host v5p
+# slices, and plain CPU nodes the operator must skip
+POOL_MIX = (
+    # (accelerator label, topology, chips per host, hosts share of n)
+    ("tpu-v5p-slice", "2x2x1", 4, 0.40),
+    ("tpu-v5p-slice", "4x4x4", 4, 0.20),   # multi-host 16-host slices
+    ("tpu-v5e-slice", "2x4", 4, 0.25),
+    ("tpu-v4-podslice", "2x2x1", 4, 0.15),
+)
+CPU_FRACTION = 0.10  # on top of n_tpu
+
+
+def build_cluster(n_tpu: int = 500) -> FakeClient:
+    """N TPU nodes in the POOL_MIX, plus CPU nodes."""
+    c = FakeClient()
+    made = 0
+    for accel, topo, chips, share in POOL_MIX:
+        count = int(n_tpu * share)
+        for i in range(count):
+            labels = {
+                L.GKE_TPU_ACCELERATOR: accel,
+                L.GKE_TPU_TOPOLOGY: topo,
+                L.GKE_ACCELERATOR_COUNT: str(chips),
+            }
+            if topo == "4x4x4":  # multi-host slices carry a worker index
+                labels["cloud.google.com/gke-tpu-worker-id"] = str(i % 16)
+            c.add_node(f"{accel.split('-')[1]}-{topo}-{i}", labels=labels,
+                       allocatable={"google.com/tpu": str(chips)})
+            made += 1
+    for i in range(n_tpu - made):  # share rounding remainder
+        c.add_node(f"v5p-extra-{i}", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x1",
+            L.GKE_ACCELERATOR_COUNT: "4"},
+            allocatable={"google.com/tpu": "4"})
+    for i in range(int(n_tpu * CPU_FRACTION)):
+        c.add_node(f"cpu-{i}")
+    return c
+
+
+def run_scale_bench(n_tpu: int = 500,
+                    client: Optional[FakeClient] = None) -> Dict:
+    """Converge an n_tpu-node cluster, then measure one steady pass.
+
+    Returns install_to_ready_s, steady_pass_s, steady-state request
+    counts by verb, and the state count — the inputs for both the test
+    budgets and the bench record."""
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+
+    c = client or build_cluster(n_tpu)
+    c.create(new_cluster_policy())
+    rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    req = Request(name="tpu-cluster-policy")
+
+    t0 = time.perf_counter()
+    rec.reconcile(req)                 # apply all states
+    c.simulate_kubelet(ready=True)     # kubelet schedules + readies pods
+    rec.reconcile(req)                 # observe readiness -> CR ready
+    install_s = time.perf_counter() - t0
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    state = (cr.get("status") or {}).get("state")
+    n_states = len(rec.state_manager.states)
+
+    # steady state: hash-skip pass, nothing rewritten
+    c.reset_verb_counts()
+    t1 = time.perf_counter()
+    rec.reconcile(req)
+    steady_s = time.perf_counter() - t1
+    verbs = c.reset_verb_counts()
+
+    return {
+        "n_tpu_nodes": n_tpu,
+        "n_states": n_states,
+        "ready": state == "ready",
+        "install_to_ready_s": install_s,
+        "steady_pass_s": steady_s,
+        "steady_requests": sum(verbs.values()),
+        "steady_verbs": verbs,
+    }

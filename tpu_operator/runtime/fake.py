@@ -45,8 +45,23 @@ class FakeClient(Client):
         self._store: dict[tuple, dict] = {}
         self._rv = 0
         self.hub = WatchHub()
+        # apiserver request accounting for the scale tier: every verb a
+        # real apiserver would receive counts once. The reconcile loop's
+        # request complexity (O(states) vs O(states x nodes)) is asserted
+        # from these numbers, not guessed.
+        self.verb_counts: dict[str, int] = {}
 
     # -- internals ---------------------------------------------------------
+
+    def _count(self, verb: str) -> None:
+        with self._lock:
+            self.verb_counts[verb] = self.verb_counts.get(verb, 0) + 1
+
+    def reset_verb_counts(self) -> dict:
+        """Return the counts so far and start a fresh window."""
+        with self._lock:
+            out, self.verb_counts = self.verb_counts, {}
+            return out
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -63,6 +78,7 @@ class FakeClient(Client):
 
     def get(self, api_version, kind, name, namespace=None,
             metadata_only=False):
+        self._count("get")
         # metadata_only is a wire-size hint; the fake returns the full
         # object (permitted by the Client contract)
         with self._lock:
@@ -72,6 +88,7 @@ class FakeClient(Client):
             return deepcopy_obj(obj)
 
     def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        self._count("list")
         opts = opts or ListOptions()
         out = []
         with self._lock:
@@ -94,6 +111,7 @@ class FakeClient(Client):
         return out
 
     def create(self, obj):
+        self._count("create")
         obj = deepcopy_obj(obj)
         if not name_of(obj):
             raise ValueError("object has no metadata.name")
@@ -129,6 +147,7 @@ class FakeClient(Client):
         return deepcopy_obj(obj)
 
     def update(self, obj):
+        self._count("update")
         obj = deepcopy_obj(obj)
         key = self._key(obj.get("apiVersion", ""), obj.get("kind", ""),
                         name_of(obj), namespace_of(obj) or None)
@@ -160,6 +179,7 @@ class FakeClient(Client):
         return deepcopy_obj(obj)
 
     def update_status(self, obj):
+        self._count("update_status")
         key = self._key(obj.get("apiVersion", ""), obj.get("kind", ""),
                         name_of(obj), namespace_of(obj) or None)
         with self._lock:
@@ -177,6 +197,7 @@ class FakeClient(Client):
         return deepcopy_obj(cur)
 
     def patch(self, api_version, kind, name, patch, namespace=None):
+        self._count("patch")
         key = self._key(api_version, kind, name, namespace)
         with self._lock:
             cur = self._store.get(key)
@@ -194,6 +215,7 @@ class FakeClient(Client):
         return deepcopy_obj(merged)
 
     def delete(self, api_version, kind, name, namespace=None):
+        self._count("delete")
         key = self._key(api_version, kind, name, namespace)
         with self._lock:
             obj = self._store.pop(key, None)
