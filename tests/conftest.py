@@ -25,8 +25,9 @@ import pytest  # noqa: E402
 
 # ---------------------------------------------------------------------------
 # Test tiers (VERDICT r3 #8): modules are auto-marked by what they cost,
-# so `pytest -m unit` is the CI-fast path (<60s) and the expensive tiers
-# run on demand:
+# so `pytest -m unit` is the CI-fast path (~70s serial — ~15s of that is
+# the one-time JAX import — and well under 30s with -n 8) and the
+# expensive tiers run on demand:
 #
 #   pytest -m unit          # fast control-plane/unit tier
 #   pytest -m e2e           # HTTP apiserver e2e (operator lifecycle)
@@ -68,3 +69,12 @@ def pytest_collection_modifyitems(config, items):
             continue  # an explicit per-test tier marker wins
         tier = TIER_BY_MODULE.get(item.module.__name__, "unit")
         item.add_marker(getattr(pytest.mark, tier))
+
+
+def load_factor():
+    """Deadline scale for convergence waits (VERDICT r3 #2): under
+    parallel CI the box is oversubscribed roughly by the xdist worker
+    count, so fixed wall-clock budgets that pass serially cry wolf at
+    -n 8. Scale them by the advertised contention."""
+    workers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1") or 1)
+    return max(1.0, workers / 2.0)

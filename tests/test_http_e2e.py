@@ -30,7 +30,6 @@ from tpu_operator.runtime.objects import get_nested, labels_of
 
 from mock_apiserver import MockApiServer
 
-import os
 import time
 
 NS = "tpu-operator"
@@ -75,13 +74,7 @@ def cluster():
         srv.stop()
 
 
-def load_factor():
-    """Deadline scale for convergence waits (VERDICT r3 #2): under
-    parallel CI the box is oversubscribed roughly by the xdist worker
-    count, so fixed wall-clock budgets that pass serially cry wolf at
-    -n 8. Scale them by the advertised contention."""
-    workers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1") or 1)
-    return max(1.0, workers / 2.0)
+from conftest import load_factor  # noqa: E402
 
 
 def wait_for(ops, pred, desc, timeout=60.0):

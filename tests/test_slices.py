@@ -1,0 +1,161 @@
+"""status.slices[] — grouped multi-host readiness on the CR (VERDICT r4
+#4): a v5p-style slice is one readable row, validated only when every
+host's validator pod is Ready."""
+
+from tpu_operator.api import KIND_CLUSTER_POLICY, V1, new_cluster_policy
+from tpu_operator.api import labels as L
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.runtime import FakeClient, ListOptions, Request
+
+# 2x2x2 = 8 chips at 4 chips/host = a 2-host v5p slice
+SLICE_LABELS = {
+    L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+    L.GKE_TPU_TOPOLOGY: "2x2x2",
+    L.GKE_ACCELERATOR_COUNT: "4",
+    L.GKE_NODEPOOL: "pool-slice-a",
+}
+SINGLE_LABELS = {
+    L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+    L.GKE_TPU_TOPOLOGY: "2x2x1",
+    L.GKE_ACCELERATOR_COUNT: "4",
+}
+
+
+def make_sliced_cluster():
+    c = FakeClient()
+    for i in range(2):
+        c.add_node(f"slice-a-{i}", labels=dict(SLICE_LABELS),
+                   allocatable={"google.com/tpu": "4"})
+    c.add_node("single-0", labels=dict(SINGLE_LABELS),
+               allocatable={"google.com/tpu": "4"})
+    c.create(new_cluster_policy())
+    rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    return c, rec
+
+
+def cr_slices(c):
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    return (cr.get("status") or {}).get("slices")
+
+
+def set_validator_pod_ready(c, node, ready):
+    pod = c.get("v1", "Pod", f"tpu-operator-validator-{node}",
+                "tpu-operator")
+    pod["status"]["conditions"] = [
+        {"type": "Ready", "status": "True" if ready else "False"}]
+    c.update_status(pod)
+
+
+def test_two_host_slice_requires_both_hosts():
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    # pods exist but are not ready yet
+    [row] = cr_slices(c)
+    assert row["id"] == "pool-slice-a"
+    assert row["hosts"] == 2
+    assert row["hostsValidated"] == 0 and row["validated"] is False
+    assert row["topology"] == "2x2x2"
+
+    # one host validates: still not a validated slice
+    c.simulate_kubelet(ready=True)
+    set_validator_pod_ready(c, "slice-a-1", False)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["hostsValidated"] == 1 and row["validated"] is False
+
+    # both hosts validate: the slice flips
+    set_validator_pod_ready(c, "slice-a-1", True)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["hostsValidated"] == 2 and row["validated"] is True
+
+    # a host regressing un-validates the whole slice
+    set_validator_pod_ready(c, "slice-a-0", False)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["validated"] is False
+
+
+def test_single_host_pools_get_no_rows():
+    """Single-host readiness is the per-state status; rows are only for
+    the grouped multi-host problem."""
+    c, rec = make_sliced_cluster()
+    rec.reconcile(Request(name="tpu-cluster-policy"))
+    rows = cr_slices(c)
+    assert [r["id"] for r in rows] == ["pool-slice-a"]
+
+
+def test_slice_row_carries_upgrade_state():
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["upgradeState"] == ""
+    # the worst member state dominates the row
+    for node, state in (("slice-a-0", "done"), ("slice-a-1", "failed")):
+        n = c.get("v1", "Node", node)
+        n["metadata"]["labels"][L.UPGRADE_STATE] = state
+        c.update(n)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["upgradeState"] == "failed"
+
+
+def test_separate_nodepools_are_separate_slices():
+    c = FakeClient()
+    for pool in ("pool-a", "pool-b"):
+        for i in range(2):
+            labels = dict(SLICE_LABELS, **{L.GKE_NODEPOOL: pool})
+            c.add_node(f"{pool}-{i}", labels=labels,
+                       allocatable={"google.com/tpu": "4"})
+    c.create(new_cluster_policy())
+    rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    rec.reconcile(Request(name="tpu-cluster-policy"))
+    rows = cr_slices(c)
+    assert [r["id"] for r in rows] == ["pool-a", "pool-b"]
+    assert all(r["hosts"] == 2 for r in rows)
+
+
+def test_terminating_validator_pod_does_not_validate():
+    """A dying validator's Ready=True is the OLD proof (same rule as the
+    upgrade controller's validation gate)."""
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)  # create the DaemonSets first
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["validated"] is True
+    pod = c.get("v1", "Pod", "tpu-operator-validator-slice-a-0",
+                "tpu-operator")
+    pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    c.update(pod)
+    rec.reconcile(req)
+    [row] = cr_slices(c)
+    assert row["hostsValidated"] == 1 and row["validated"] is False
+
+
+def test_isolated_validator_pods_count(monkeypatch):
+    """Isolated/virtual nodes are gated by tpu-isolated-validator; their
+    Ready pods must validate slices too."""
+    from tpu_operator.controllers.slices import slice_status
+
+    c = FakeClient()
+    for i in range(2):
+        c.add_node(f"slice-b-{i}",
+                   labels=dict(SLICE_LABELS, **{L.GKE_NODEPOOL: "pool-b"}),
+                   allocatable={"google.com/tpu": "4"})
+        c.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": f"iso-val-{i}",
+                               "namespace": "tpu-operator",
+                               "labels": {"app": "tpu-isolated-validator"}},
+                  "spec": {"nodeName": f"slice-b-{i}"},
+                  "status": {"phase": "Running",
+                             "conditions": [{"type": "Ready",
+                                             "status": "True"}]}})
+    [row] = slice_status(c, "tpu-operator")
+    assert row["validated"] is True and row["hostsValidated"] == 2

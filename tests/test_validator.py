@@ -206,7 +206,7 @@ class TestWorkloadPods:
             done["ok"] = True
 
         threading.Thread(target=kubelet).start()
-        phase = spawn_and_wait(c, pod)
+        phase = spawn_and_wait(c, pod, interval=0.02)
         assert phase == "Succeeded" and done["ok"]
         # pod cleaned up afterwards
         assert c.get_or_none("v1", "Pod", "wl", "default") is None
@@ -219,7 +219,7 @@ class TestWorkloadPods:
         threading.Timer(
             0.05, c.simulate_pod_phase, args=("wl", "default", "Failed")).start()
         with pytest.raises(ValidationFailed):
-            spawn_and_wait(c, pod)
+            spawn_and_wait(c, pod, interval=0.02)
 
     def test_validate_plugin_full_flow(self, valdir):
         c = self._client()

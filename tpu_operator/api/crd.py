@@ -25,6 +25,18 @@ def _status_schema() -> dict:
                                      "x-kubernetes-preserve-unknown-fields": True}},
             "clusterInfo": {"type": "object",
                             "x-kubernetes-preserve-unknown-fields": True},
+            "slices": {"type": "array",
+                       "items": {
+                           "type": "object",
+                           "properties": {
+                               "id": {"type": "string"},
+                               "accelerator": {"type": "string"},
+                               "topology": {"type": "string"},
+                               "hosts": {"type": "integer"},
+                               "hostsValidated": {"type": "integer"},
+                               "validated": {"type": "boolean"},
+                               "upgradeState": {"type": "string"},
+                           }}},
         },
     }
 

@@ -170,6 +170,17 @@ class ClusterPolicyReconciler(Reconciler):
             set_nested(cr, self.state_manager.last_cluster_facts,
                        "status", "clusterInfo")
 
+        # per-slice readiness rows (grouped multi-host readiness, SURVEY
+        # section 7): one row per v5p-style slice, validated only when
+        # every host's validator pod is Ready. One node LIST serves this,
+        # the pool gauge, and the chip totals below.
+        from .slices import slice_status
+
+        nodes = self.client.list("v1", "Node")
+        set_nested(cr, slice_status(self.client, self.namespace,
+                                    nodes=nodes),
+                   "status", "slices")
+
         not_ready = {n: r for n, r in results.items() if not r.ready}
         errors = {n: r for n, r in results.items()
                   if r.status == SyncStatus.ERROR}
@@ -204,7 +215,6 @@ class ClusterPolicyReconciler(Reconciler):
         OPERATOR_METRICS.reconcile_status.set(1)
         OPERATOR_METRICS.reconcile_last_success.set(_time.time())
         OPERATOR_METRICS.policy_state.labels(policy=request.name).set(0)
-        nodes = self.client.list("v1", "Node")
         pools = get_node_pools(nodes)
         OPERATOR_METRICS.node_pools.set(len(pools))
         from .nodeinfo import attributes_of

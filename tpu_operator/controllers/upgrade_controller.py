@@ -212,9 +212,9 @@ class UpgradeReconciler(Reconciler):
 
     @staticmethod
     def _pod_ready(pod: dict) -> bool:
-        return any(c.get("type") == "Ready" and c.get("status") == "True"
-                   for c in get_nested(pod, "status", "conditions",
-                                       default=[]) or [])
+        from ..runtime.objects import pod_ready
+
+        return pod_ready(pod)
 
     def _tpu_workload_pods_by_node(
             self, resource_names: Optional[tuple] = None,
@@ -305,15 +305,13 @@ class UpgradeReconciler(Reconciler):
         topology x gke-nodepool, the same grouping topology/manager.py
         uses for grouped slice-config agreement); single-host nodes are
         their own unit."""
+        from ..state.nodepool import slices_of
+
         units: List[List[str]] = []
         grouped = set()
         for pool in get_node_pools(list(nodes.values())):
             if pool.multi_host:
-                by_slice: Dict[str, List[str]] = {}
-                for node_name in pool.nodes:
-                    slice_id = labels_of(nodes[node_name]).get(
-                        L.GKE_NODEPOOL, pool.name)
-                    by_slice.setdefault(slice_id, []).append(node_name)
+                by_slice = slices_of(pool, nodes)
                 for _, members in sorted(by_slice.items()):
                     units.append(sorted(members))
             else:

@@ -221,3 +221,11 @@ def match_node_selector_terms(labels: Mapping[str, str], terms: Iterable[Mapping
         if match_labels(labels, {"matchExpressions": exprs}):
             return True
     return False
+
+
+def pod_ready(pod: Mapping) -> bool:
+    """kubectl's Ready-condition test (shared by the upgrade controller's
+    validation gate and status.slices grouped readiness)."""
+    return any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in get_nested(pod, "status", "conditions",
+                                   default=[]) or [])

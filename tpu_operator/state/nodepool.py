@@ -85,3 +85,19 @@ def get_node_pools(nodes: List[dict],
     for p in out:
         p.nodes.sort()
     return out
+
+
+def slices_of(pool: NodePool,
+              nodes_by_name: Dict[str, dict]) -> Dict[str, List[str]]:
+    """slice id -> member node names for one pool. Slice identity =
+    accelerator x topology x gke-nodepool — the single grouping key the
+    topology manager (grouped slice-config agreement), the upgrade
+    controller (slice-unit rollouts) and status.slices all share; keep
+    them keyed identically or a slice could validate under one identity
+    and upgrade under another."""
+    by_slice: Dict[str, List[str]] = {}
+    for node_name in pool.nodes:
+        slice_id = labels_of(nodes_by_name[node_name]).get(
+            L.GKE_NODEPOOL, pool.name)
+        by_slice.setdefault(slice_id, []).append(node_name)
+    return by_slice

@@ -71,7 +71,9 @@ def wait_for_pod_phase(client: Client, name: str, namespace: str,
         f"{attempts * interval:.0f}s")
 
 
-def spawn_and_wait(client: Client, pod: dict) -> str:
+def spawn_and_wait(client: Client, pod: dict,
+                   attempts: int = POD_WAIT_ATTEMPTS,
+                   interval: float = POD_WAIT_INTERVAL_S) -> str:
     name = pod["metadata"]["name"]
     ns = pod["metadata"]["namespace"]
     try:
@@ -80,7 +82,8 @@ def spawn_and_wait(client: Client, pod: dict) -> str:
         pass
     client.create(pod)
     try:
-        return wait_for_pod_phase(client, name, ns)
+        return wait_for_pod_phase(client, name, ns, attempts=attempts,
+                                  interval=interval)
     finally:
         try:
             client.delete("v1", "Pod", name, ns)
@@ -108,7 +111,7 @@ def validate_plugin(client: Client, node_name: str, namespace: str,
 
     pod = jax_workload_pod(namespace, node_name, image, request_tpu=True)
     pod["metadata"]["name"] = "tpu-plugin-validator"
-    phase = spawn_and_wait(client, pod)
+    phase = spawn_and_wait(client, pod, interval=interval)
     info = {"ALLOCATABLE": allocatable, "WORKLOAD_PHASE": phase}
     barrier.write_status("plugin-ready", info)
     return info
