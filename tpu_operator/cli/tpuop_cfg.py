@@ -250,7 +250,8 @@ def main(argv=None) -> int:
 
     g = sub.add_parser("generate", help="emit deployment manifests")
     g.add_argument("what",
-                   choices=["crds", "operator", "all", "bundle", "cleanup"])
+                   choices=["crds", "operator", "all", "bundle", "cleanup",
+                            "helm-chart"])
     g.add_argument("-n", "--namespace", default=None,
                    help="default tpu-operator; with --values, an explicit "
                         "flag overrides the values file")
@@ -336,9 +337,26 @@ def main(argv=None) -> int:
         return 0 if clean else 1
 
     if args.cmd == "generate":
+        if args.what == "helm-chart":
+            if args.values or args.namespace is not None or args.image:
+                # the chart always embeds the canonical defaults; values
+                # belong at `helm install -f` time — silently accepting
+                # these flags would let users believe they were baked in
+                print("--values/-n/--image do not apply to `generate "
+                      "helm-chart` (pass values to helm install -f; "
+                      "-n is helm's namespace flag)", file=sys.stderr)
+                return 2
+            from ..deploy.helmchart import write_chart
+
+            target = write_chart(args.dir or None)
+            for rel in sorted(p.relative_to(target).as_posix()
+                              for p in target.rglob("*") if p.is_file()):
+                print(rel)
+            return 0
         if args.dir:
             if args.what != "bundle":
-                print("--dir is only meaningful with `generate bundle`",
+                print("--dir is only meaningful with `generate bundle` "
+                      "or `generate helm-chart`",
                       file=sys.stderr)
                 return 2
             from ..deploy import values as values_mod

@@ -231,6 +231,25 @@ BUILTINS: dict[str, Callable] = {
     "toString": lambda v: str(v),
     "printf": lambda fmt, *a: str(fmt) % tuple(a),
     "ternary": lambda t, f, c: t if _truthy(c) else f,
+    # sprig parity for the helm chart templates (deploy/helmchart.py):
+    # the upgrade-hook Job name is versioned by an image digest prefix
+    "sha256sum": lambda v: __import__("hashlib").sha256(
+        str(v).encode()).hexdigest(),
+    "trunc": lambda n, s: str(s)[:int(n)] if int(n) >= 0
+    else str(s)[int(n):],
+    # sprig's safe map access — the escape from missingkey=error for
+    # genuinely-optional keys (user-supplied list entries, nulled maps)
+    "get": lambda d, k: d.get(k, "") if isinstance(d, dict) else "",
+    "dict": lambda *kv: dict(zip(kv[::2], kv[1::2])),
+    "kindIs": lambda kind, v: {
+        "string": isinstance(v, str),
+        "map": isinstance(v, dict),
+        "slice": isinstance(v, list),
+        "bool": isinstance(v, bool),
+        "int": isinstance(v, int) and not isinstance(v, bool),
+        "float64": isinstance(v, float),
+        "invalid": v is None,
+    }.get(str(kind), False),
 }
 
 
