@@ -86,6 +86,25 @@ class TestComponents:
         assert info["DEVICE_COUNT"] == "4"
         assert barrier.is_ready("runtime-ready")
 
+    def test_runtime_records_belief_vs_reality(self, valdir, fake_chips,
+                                               monkeypatch, tmp_path):
+        """clusterinfo-for-decisions: the operator renders its detected
+        runtime into the runtime-validation initContainer env; the proof
+        probes the runtime socket under the HOST_ROOT mount and records
+        both, so belief/reality drift is visible in the barrier file."""
+        validate_driver()
+        sock = tmp_path / "run" / "containerd" / "containerd.sock"
+        sock.parent.mkdir(parents=True)
+        sock.touch()
+        monkeypatch.setenv("HOST_ROOT", str(tmp_path))
+        monkeypatch.setenv("EXPECTED_CONTAINER_RUNTIME", "docker")
+        info = validate_runtime()  # drift logs a warning, never fails
+        assert info["EXPECTED_CONTAINER_RUNTIME"] == "docker"
+        assert info["CONTAINER_RUNTIME"] == "containerd"
+        status = barrier.read_status("runtime-ready")
+        assert status["EXPECTED_CONTAINER_RUNTIME"] == "docker"
+        assert status["CONTAINER_RUNTIME"] == "containerd"
+
     def test_jax_matmul_proof(self, valdir):
         info = validate_jax(matmul_size=64, allow_cpu=True)
         assert float(info["TFLOPS"]) > 0

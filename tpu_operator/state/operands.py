@@ -180,6 +180,12 @@ def common_data(ctx: SyncContext, comp: Optional[ComponentSpec],
         "Args": (comp.args if comp else None) or [],
         "Resources": comp.resources if comp else None,
         "RuntimeClass": ctx.spec.operator.runtime_class or "tpu",
+        # clusterinfo facts for template decisions (the reference's
+        # clusterinfo-picks-manifests role, clusterinfo.go:42-55): e.g.
+        # the runtime state records the control-plane-detected container
+        # runtime so the node-side proof can compare belief vs reality
+        "Cluster": {"containerRuntime": "containerd",
+                    **(ctx.cluster or {})},
         "ValidatorImage": resolve_image("operator-validation",
                                         validator, "tpu-validator"),
         "HostPaths": {

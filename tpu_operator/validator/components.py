@@ -167,8 +167,36 @@ def validate_runtime() -> Dict[str, str]:
     if chips["count"] and broken and chips["source"] != "fake":
         raise ValidationFailed(f"device nodes not usable: {broken}")
     info = {"DEVICE_COUNT": str(chips["count"])}
+    # control-plane belief vs node reality (clusterinfo-for-decisions):
+    # the operator renders its detected runtime into the DS env; the
+    # node records what it actually runs next to it, so belief/reality
+    # drift is visible in the barrier file and the node-status metrics
+    expected = os.environ.get("EXPECTED_CONTAINER_RUNTIME")
+    if expected:
+        info["EXPECTED_CONTAINER_RUNTIME"] = expected
+        actual = _node_container_runtime()
+        if actual:
+            info["CONTAINER_RUNTIME"] = actual
+            if not actual.startswith(expected):
+                log.warning(
+                    "container runtime drift: operator detected %r, "
+                    "node reports %r", expected, actual)
     barrier.write_status("runtime-ready", info)
     return info
+
+
+def _node_container_runtime() -> str:
+    """The runtime actually serving this node: its socket under the
+    host rootfs (the runtime-validation initContainer mounts it at
+    HOST_ROOT, like driver-validation's /host) is the ground truth —
+    probing the container's own filesystem would always come up empty."""
+    host = os.environ.get("HOST_ROOT", "/host").rstrip("/")
+    for sock, name in (("/run/containerd/containerd.sock", "containerd"),
+                       ("/var/run/docker.sock", "docker"),
+                       ("/var/run/crio/crio.sock", "cri-o")):
+        if os.path.exists(host + sock):
+            return name
+    return ""
 
 
 def validate_jax(matmul_size: Optional[int] = None,
