@@ -15,3 +15,8 @@ from .tpudriver import (  # noqa: F401
     TPUDriverSpec,
     new_tpu_driver,
 )
+from .versioned import (  # noqa: F401
+    Clientset,
+    new_clientset,
+    new_simple_clientset,
+)
