@@ -72,9 +72,17 @@ def pytest_collection_modifyitems(config, items):
 
 
 def load_factor():
-    """Deadline scale for convergence waits (VERDICT r3 #2): under
-    parallel CI the box is oversubscribed roughly by the xdist worker
-    count, so fixed wall-clock budgets that pass serially cry wolf at
-    -n 8. Scale them by the advertised contention."""
+    """Deadline scale for convergence waits (VERDICT r3 #2): fixed
+    wall-clock budgets that pass serially cry wolf under contention.
+    Contention here is real, not guessed: xdist workers per CPU (this CI
+    box has ONE core, so -n 8 is 8x oversubscribed) and the 1-minute
+    load average (which also sees non-pytest load, e.g. a concurrent
+    bench run). Deadlines scale by whichever is worse; on an idle
+    serial box the factor is 1.0 so budgets stay tight."""
     workers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1") or 1)
-    return max(1.0, workers / 2.0)
+    ncpu = os.cpu_count() or 1
+    try:
+        external = os.getloadavg()[0] / ncpu
+    except (OSError, AttributeError):  # platform without getloadavg
+        external = 0.0
+    return max(1.0, workers / ncpu, external)

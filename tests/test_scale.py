@@ -10,6 +10,7 @@ is O(states), independent of node count.
 """
 
 import pytest
+from conftest import load_factor
 
 from tpu_operator.benchmarks.controlplane import (
     INSTALL_BUDGET_S,
@@ -21,7 +22,10 @@ pytestmark = pytest.mark.soak  # ~40s at 500 nodes: scale tier, not unit
 
 # budgets — deliberately generous vs. measured (0.2s steady pass, 146
 # requests, ~19s install at 500 nodes) so load jitter doesn't flake, but
-# tight enough that an O(nodes) regression in the steady pass trips them
+# tight enough that an O(nodes) regression in the steady pass trips them.
+# Wall-time budgets scale with measured CI contention (conftest
+# load_factor: 1.0 on an idle serial box, where the regression guard is
+# tightest); request budgets are load-independent and never scale.
 STEADY_PASS_BUDGET_S = 2.0
 STEADY_REQUEST_BUDGET = 25 * 15      # ~25 requests per state
 NODE_INDEPENDENCE_SLACK = 10        # requests allowed to vary with nodes
@@ -43,10 +47,12 @@ class TestScale500:
         assert r500["n_states"] == 15
 
     def test_install_to_ready_budget(self, r500):
-        assert r500["install_to_ready_s"] < INSTALL_BUDGET_S, r500
+        assert r500["install_to_ready_s"] < \
+            INSTALL_BUDGET_S * load_factor(), r500
 
     def test_steady_pass_wall_time(self, r500):
-        assert r500["steady_pass_s"] < STEADY_PASS_BUDGET_S, r500
+        assert r500["steady_pass_s"] < \
+            STEADY_PASS_BUDGET_S * load_factor(), r500
 
     def test_steady_pass_request_budget(self, r500):
         assert r500["steady_requests"] < STEADY_REQUEST_BUDGET, \

@@ -93,12 +93,17 @@ def run_scale_bench(n_tpu: int = 500,
     state = (cr.get("status") or {}).get("state")
     n_states = len(rec.state_manager.states)
 
-    # steady state: hash-skip pass, nothing rewritten
-    c.reset_verb_counts()
-    t1 = time.perf_counter()
-    rec.reconcile(req)
-    steady_s = time.perf_counter() - t1
-    verbs = c.reset_verb_counts()
+    # steady state: hash-skip pass, nothing rewritten. Wall time is the
+    # min of three passes — a scheduler hiccup on a loaded CI box should
+    # not define the steady-state figure. Request counts come from the
+    # last pass (every steady pass issues the identical request set).
+    steady_s = float("inf")
+    for _ in range(3):
+        c.reset_verb_counts()
+        t1 = time.perf_counter()
+        rec.reconcile(req)
+        steady_s = min(steady_s, time.perf_counter() - t1)
+        verbs = c.reset_verb_counts()
 
     return {
         "n_tpu_nodes": n_tpu,
