@@ -1,0 +1,211 @@
+"""Property/fuzz tier for the two from-scratch engines.
+
+The renderer (render/engine.py, the go-template subset) and the
+mini-CEL evaluator (api/cel.py) are the riskiest original code in the
+repo: both parse untrusted-ish text (operand templates, CRD admission
+rules) and both claim a well-defined failure contract (TemplateError /
+EvalError — never a raw Python crash). Example-based tests pin the
+happy paths; these Hypothesis properties pin the CONTRACT:
+
+- token-soup inputs either succeed or raise the engine's own error
+  type (fail closed — a raw KeyError/IndexError here would be an
+  admission bypass or a render crash inside the reconcile loop);
+- differential oracles where one exists: CEL boolean precedence vs
+  Python's, CEL integer comparisons vs Python's, toYaml round-trip
+  through yaml.safe_load;
+- the documented trim-marker and missingkey=error semantics hold for
+  arbitrary whitespace/identifiers, not just the examples.
+
+Deterministic (derandomize=True): CI failures reproduce exactly.
+"""
+
+import os
+import string
+
+import pytest
+import yaml
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from tpu_operator.api.cel import EvalError, evaluate
+from tpu_operator.render.engine import (
+    MissingKeyError,
+    TemplateError,
+    render_string,
+)
+
+# 60 deterministic examples per property keeps the whole module ~7s so
+# it can stay in the unit tier; raise TPU_FUZZ_EXAMPLES for deep runs.
+FUZZ = settings(
+    max_examples=int(os.environ.get("TPU_FUZZ_EXAMPLES", "60")),
+    deadline=None, derandomize=True,
+    suppress_health_check=[HealthCheck.too_slow])
+
+# ---------------------------------------------------------------------------
+# renderer
+# ---------------------------------------------------------------------------
+
+_TEMPLATE_TOKENS = st.sampled_from([
+    "text ", "\n", "  ", "{{ .a }}", "{{ .b.c }}", "{{- .a }}",
+    "{{ .a -}}", "{{ if .a }}", "{{ else }}", "{{ end }}",
+    "{{ range .list }}", "{{ . }}", '{{ .a | default "x" }}',
+    "{{ .a | quote }}", "{{ toYaml .b }}", "{{ .missing }}",
+    "{{", "}}", "{{ | }}", "{{ .a | bogusfunc }}", "{{ end }}{{ end }}",
+    "{{ if }}", "{{ range }}", '{{ printf "%d" .a }}', "{{ .list }}",
+])
+
+_RENDER_DATA = {"a": 1, "b": {"c": "y"}, "list": [1, 2]}
+
+
+class TestRendererFuzz:
+    @FUZZ
+    @given(st.lists(_TEMPLATE_TOKENS, min_size=0, max_size=12))
+    def test_token_soup_fails_closed(self, parts):
+        """Any template assembled from plausible fragments either renders
+        to a string or raises TemplateError — never a raw Python error."""
+        src = "".join(parts)
+        try:
+            out = render_string(src, _RENDER_DATA)
+        except TemplateError:
+            return
+        assert isinstance(out, str)
+
+    @FUZZ
+    @given(st.recursive(
+        st.one_of(st.integers(-10**6, 10**6), st.booleans(),
+                  st.text(string.ascii_letters + string.digits + " _-",
+                          max_size=20)),
+        lambda inner: st.one_of(
+            st.lists(inner, max_size=4),
+            st.dictionaries(st.text(string.ascii_lowercase, min_size=1,
+                                    max_size=8), inner, max_size=4)),
+        max_leaves=12))
+    def test_to_yaml_roundtrip(self, value):
+        """{{ toYaml .v }} output must parse back to the same value —
+        operand manifests embed rendered YAML inside YAML, so a quoting
+        bug here corrupts DaemonSets silently."""
+        out = render_string("{{ toYaml .v }}", {"v": value})
+        assert yaml.safe_load(out) == value
+
+    @FUZZ
+    @given(st.text(string.ascii_lowercase, min_size=1, max_size=10))
+    def test_missingkey_always_errors(self, key):
+        """missingkey=error semantics (render.go parity) for arbitrary
+        identifiers, not just the examples."""
+        data = {"present": 1}
+        if key == "present":
+            assert render_string("{{ .%s }}" % key, data) == "1"
+            return
+        with pytest.raises(MissingKeyError):
+            render_string("{{ .%s }}" % key, data)
+
+    @FUZZ
+    @given(st.text(" \t\n", max_size=6),
+           st.text(string.ascii_letters, min_size=1, max_size=8))
+    def test_trim_markers(self, ws, val):
+        """`{{-` eats ALL preceding whitespace; `-}}` eats following."""
+        assert render_string("A" + ws + "{{- .v }}", {"v": val}) == "A" + val
+        assert render_string("{{ .v -}}" + ws + "B", {"v": val}) == val + "B"
+
+    @FUZZ
+    @given(st.lists(_TEMPLATE_TOKENS, min_size=1, max_size=8))
+    def test_deterministic(self, parts):
+        src = "".join(parts)
+        try:
+            first = render_string(src, _RENDER_DATA)
+        except TemplateError:
+            return
+        assert render_string(src, _RENDER_DATA) == first
+
+
+# ---------------------------------------------------------------------------
+# mini-CEL
+# ---------------------------------------------------------------------------
+
+_CEL_TOKENS = st.sampled_from([
+    "self", "oldSelf", "self.x", "has(self.x)", "size(self)", "==", "!=",
+    "<", "<=", "&&", "||", "!", "(", ")", "'s'", "3", "1.5", "in",
+    "[1, 2]", "[]", ".", ",", "true", "null", "size(", "has(self",
+])
+
+
+class TestCelFuzz:
+    @FUZZ
+    @given(st.lists(_CEL_TOKENS, min_size=0, max_size=10),
+           st.sampled_from([{"x": 1}, {}, "abc", [1, 2], 3, None]))
+    def test_token_soup_fails_closed(self, parts, self_val):
+        """Admission rules must fail closed: garbage evaluates to a bool
+        or raises EvalError. A raw exception would escape the mock
+        apiserver's rejection path — an admission bypass."""
+        src = " ".join(parts)
+        try:
+            out = evaluate(src, self_val, {"x": 2})
+        except EvalError:
+            return
+        assert isinstance(out, bool)
+
+    @FUZZ
+    @given(st.lists(st.booleans(), min_size=1, max_size=6),
+           st.lists(st.sampled_from(["&&", "||"]), min_size=5, max_size=5),
+           st.lists(st.booleans(), min_size=6, max_size=6))
+    def test_boolean_precedence_matches_python(self, lits, ops, negs):
+        """Differential oracle: mixed &&/||/! chains must bind the way
+        CEL (and Python's and/or/not) binds — && over ||."""
+        n = len(lits)
+        cel_parts, py_parts = [], []
+        for i, lit in enumerate(lits):
+            neg_c = "!" if negs[i] else ""
+            neg_p = "not " if negs[i] else ""
+            cel_parts.append(f"{neg_c}{str(lit).lower()}")
+            py_parts.append(f"{neg_p}{lit}")
+            if i < n - 1:
+                cel_parts.append(ops[i])
+                py_parts.append("and" if ops[i] == "&&" else "or")
+        expected = bool(eval(" ".join(py_parts)))  # noqa: S307 - literals only
+        assert evaluate(" ".join(cel_parts), None) is expected
+
+    @FUZZ
+    @given(st.integers(-1000, 1000), st.integers(-1000, 1000),
+           st.sampled_from(["==", "!=", "<", "<=", ">", ">="]))
+    def test_int_comparisons_match_python(self, a, b, op):
+        expected = eval(f"{a} {op} {b}")  # noqa: S307 - int literals only
+        assert evaluate(f"{a} {op} {b}", None) is expected
+
+    @FUZZ
+    @given(st.integers(0, 9), st.lists(st.integers(0, 9), max_size=6))
+    def test_in_over_lists_is_membership(self, needle, hay):
+        src = f"{needle} in [{', '.join(map(str, hay))}]"
+        assert evaluate(src, None) is (needle in hay)
+
+    @FUZZ
+    @given(st.text(string.ascii_lowercase, min_size=1, max_size=6),
+           st.text(string.ascii_lowercase, min_size=1, max_size=12))
+    def test_in_over_strings_rejected(self, needle, hay):
+        """Real CEL defines `in` over lists/maps only; the substring
+        reading must stay an error so rules that would fail to compile
+        on a real apiserver fail offline too (ADVICE r4)."""
+        with pytest.raises(EvalError):
+            evaluate(f"'{needle}' in '{hay}'", None)
+
+    @FUZZ
+    @given(st.dictionaries(st.sampled_from(["x", "y", "z"]),
+                           st.integers(0, 5), max_size=3))
+    def test_has_vs_member_access(self, obj):
+        """has() is the presence probe; bare member access on an absent
+        field is an EvalError (the CEL distinction the admission rules
+        rely on)."""
+        for key in ("x", "y"):
+            assert evaluate(f"has(self.{key})", obj) is (key in obj)
+            if key in obj:
+                assert evaluate(f"self.{key} >= 0", obj) is True
+            else:
+                with pytest.raises(EvalError):
+                    evaluate(f"self.{key} >= 0", obj)
+
+    @FUZZ
+    @given(st.one_of(
+        st.text(string.ascii_letters, max_size=12),
+        st.lists(st.integers(), max_size=6),
+        st.dictionaries(st.text(string.ascii_lowercase, min_size=1,
+                                max_size=4), st.integers(), max_size=4)))
+    def test_size_matches_len(self, val):
+        assert evaluate(f"size(self) == {len(val)}", val) is True

@@ -9,9 +9,9 @@ admission-time evaluator for this framework's CRDs — used by the offline
 rule text is enforced in both places.
 
 Supported subset (everything the operator's CRDs emit, plus the common
-admission shapes): ``||  &&  !  ==  !=  <  <=  >  >=  in``, member
-access, ``has(...)``, ``size(...)``, string/int/float/bool/null
-literals, and parentheses. CEL semantics that matter for admission are
+admission shapes): ``||  &&  !  ==  !=  <  <=  >  >=  in``, unary
+minus, member access, ``has(...)``, ``size(...)``,
+string/int/float/bool/null literals, and parentheses. CEL semantics that matter for admission are
 kept: accessing an absent field is an evaluation error, ``has()`` is the
 presence test, transition rules (any rule mentioning ``oldSelf``) apply
 only to UPDATE, and a rule that errors at runtime REJECTS the write
@@ -36,7 +36,7 @@ _TOKEN_RE = re.compile(r"""
       (?P<num>\d+\.\d+|\d+)
     | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
     | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-    | (?P<op>\|\||&&|==|!=|<=|>=|[!<>().,\[\]])
+    | (?P<op>\|\||&&|==|!=|<=|>=|[!<>().,\[\]-])
     )""", re.VERBOSE)
 
 _ABSENT = object()
@@ -122,6 +122,9 @@ class _Parser:
         if self.peek() == ("op", "!"):
             self.take()
             return ("not", self.parse_unary())
+        if self.peek() == ("op", "-"):  # CEL unary minus (negative literals)
+            self.take()
+            return ("neg", self.parse_unary())
         return self.parse_postfix()
 
     def parse_postfix(self):
@@ -199,6 +202,11 @@ def _eval(node, env: dict) -> Any:
         return base[node[2]]
     if op == "not":
         return not _truthy(_eval(node[1], env))
+    if op == "neg":
+        val = _eval(node[1], env)
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            raise EvalError(f"unary - on non-numeric {val!r}")
+        return -val
     if op == "or":  # CEL logical-or is commutative over errors: true wins
         lhs_err = None
         try:
