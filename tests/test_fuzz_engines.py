@@ -177,6 +177,18 @@ class TestCelFuzz:
         assert evaluate(src, None) is (needle in hay)
 
     @FUZZ
+    @given(st.integers(-9, 9), st.integers(1, 9))
+    def test_list_commas_mandatory(self, a, b):
+        """Real CEL evaluates [1-2] as the one-element list [-1] (binary
+        minus); this evaluator has no binary minus, so the expression
+        must ERROR — parsing it as the two-element [1, -2] would make a
+        rule pass offline with different semantics than the apiserver."""
+        with pytest.raises(EvalError):
+            evaluate(f"{a} in [{a}-{b}]", None)
+        with pytest.raises(EvalError):
+            evaluate(f"[{a} {b}] == [{a} {b}]", None)
+
+    @FUZZ
     @given(st.text(string.ascii_lowercase, min_size=1, max_size=6),
            st.text(string.ascii_lowercase, min_size=1, max_size=12))
     def test_in_over_strings_rejected(self, needle, hay):

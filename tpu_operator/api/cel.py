@@ -158,6 +158,12 @@ class _Parser:
                 items.append(self.parse_or())
                 if self.peek() == ("op", ","):
                     self.take()
+                elif self.peek() != ("op", "]"):
+                    # commas are mandatory: without this, "[1-2]" (binary
+                    # minus, unsupported here) silently parses as the
+                    # two-element list [1, -2] instead of failing closed
+                    raise EvalError(
+                        f"expected ',' or ']' in list, got {self.peek()!r}")
             self.take("op", "]")
             return ("list", items)
         if tok[0] == "ident":

@@ -87,6 +87,12 @@ def initialize(config: Optional[DistributedConfig] = None) -> DistributedConfig:
     every chip on the host); ``auto`` configs delegate topology discovery
     to jax/libtpu (argument-less initialize)."""
     global _initialized
+    # JAX_PLATFORMS must win even under out-of-tree PJRT plugins (the
+    # axon tunnel ignores the env var alone); every training workload
+    # funnels through here, so this is the shared choke point.
+    from ..workloads.backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     cfg = config or DistributedConfig.from_env()
     if not cfg.multi_process or _initialized:
         return cfg

@@ -33,6 +33,9 @@ def run(size_mb: float = 256.0, iters: int = 10, repeats: int = 5,
         devices=None) -> "CollectiveResult":
     """The gating psum measurement — one timing harness and one result
     type for the whole suite (run_collective)."""
+    from .backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     return run_collective("all_reduce", size_mb=size_mb, iters=iters,
                           repeats=repeats, devices=devices)
 

@@ -1,0 +1,218 @@
+"""Conv burn-in: the vision/conv model family of the fleet-exercise set.
+
+The transformer burn-in (burnin.py) exercises the MXU through matmuls;
+this workload exercises the OTHER MXU FLOP family — convolutions — which
+hit different XLA lowering paths (conv_general_dilated tiling, im2col /
+spatial partitioning) and different HBM access patterns (activation
+feature maps instead of attention caches). A fleet that only ever ran
+matmuls can still have a chip that faults on convs; the reference's
+burn-in slot (the CUDA workload pod, validator/cuda-workload-validation.yaml,
+and dcgmproftester practice) covers both; so does this pair.
+
+TPU-first choices:
+- NHWC activations with HWIO filters — the layout XLA's TPU conv
+  emitter is native in (no transposes in the lowered HLO);
+- bf16 compute, fp32 loss/norm statistics;
+- channel tensor parallelism via GSPMD: each residual block's first
+  conv is output-channel sharded (column-parallel), the second is
+  input-channel sharded (row-parallel), so XLA inserts exactly one
+  psum per block — the Megatron pattern applied to HWIO filters;
+- data parallelism over batch; the same [data, model] mesh contract as
+  the transformer burn-in, so it runs unchanged on multi-slice meshes
+  through parallel.multihost.training_mesh.
+
+Correctness oracle: loss must fall over a few steps (grads flowed
+through every shard), same contract as burnin.run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ConvBurninConfig:
+    image_size: int = 32
+    in_channels: int = 3
+    width: int = 32          # channel width; divisible by the model axis
+    n_blocks: int = 2
+    n_classes: int = 16
+    batch: int = 8
+    learning_rate: float = 1e-3
+    dtype: Any = jnp.bfloat16
+
+
+# --- parameters + shardings ------------------------------------------------
+
+
+def init_params(cfg: ConvBurninConfig, key) -> Dict:
+    k = iter(jax.random.split(key, 2 + 2 * cfg.n_blocks))
+
+    def he(shape):  # Kaiming init over the conv fan-in (H*W*I)
+        fan_in = shape[0] * shape[1] * shape[2]
+        return jax.random.normal(next(k), shape) * jnp.sqrt(2.0 / fan_in)
+
+    p: Dict[str, Any] = {
+        # stem: 3x3, in_channels -> width
+        "stem": he((3, 3, cfg.in_channels, cfg.width)),
+        "head": jax.random.normal(next(k), (cfg.width, cfg.n_classes))
+        * (1.0 / jnp.sqrt(cfg.width)),
+        "blocks": [],
+    }
+    for _ in range(cfg.n_blocks):
+        p["blocks"].append({
+            "conv1": he((3, 3, cfg.width, cfg.width)),
+            "conv2": he((3, 3, cfg.width, cfg.width)),
+            "scale1": jnp.ones((cfg.width,)),
+            "scale2": jnp.ones((cfg.width,)),
+        })
+    return p
+
+
+def param_specs(cfg: ConvBurninConfig) -> Dict:
+    """Column-parallel conv1 (output channels on `model`), row-parallel
+    conv2 (input channels on `model`): one psum per block, inserted by
+    the SPMD partitioner."""
+    block = {
+        "conv1": P(None, None, None, "model"),   # HWIO: O sharded
+        "conv2": P(None, None, "model", None),   # HWIO: I sharded
+        "scale1": P("model"),                     # follows conv1 output
+        "scale2": P(None),
+    }
+    return {
+        "stem": P(),
+        "head": P(None, "model"),                 # column-parallel head
+        "blocks": [dict(block) for _ in range(cfg.n_blocks)],
+    }
+
+
+def shard_params(params: Dict, mesh: Mesh, cfg: ConvBurninConfig) -> Dict:
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array, P)))
+
+
+# --- model -----------------------------------------------------------------
+
+
+def _conv(x, w, stride: int = 1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _norm(x, scale):
+    """Channel RMS norm with fp32 statistics (batch-size independent,
+    no running stats to shard)."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(1, 2),
+                   keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
+
+
+def forward(params: Dict, images: jnp.ndarray, cfg: ConvBurninConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """images [B, H, W, C_in] -> logits [B, n_classes]. With a mesh,
+    activation constraints pin the dp/channel-tp layout; without one the
+    same code is the single-chip proof path (burnin.forward contract)."""
+    if mesh is not None:
+        csc = lambda t, spec: jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, spec))
+    else:
+        csc = lambda t, spec: t
+    x = _conv(images.astype(cfg.dtype), params["stem"].astype(cfg.dtype))
+    x = csc(x, P("data", None, None, None))
+    for bp in params["blocks"]:
+        h = _conv(x, bp["conv1"].astype(cfg.dtype))
+        h = csc(h, P("data", None, None, "model"))  # column-parallel out
+        h = jax.nn.relu(_norm(h, bp["scale1"].astype(cfg.dtype)))
+        h = _conv(h, bp["conv2"].astype(cfg.dtype))
+        h = csc(h, P("data", None, None, None))     # psum happened here
+        x = jax.nn.relu(x + _norm(h, bp["scale2"].astype(cfg.dtype)))
+    pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # [B, width]
+    logits = pooled @ params["head"].astype(jnp.float32)
+    return csc(logits, P("data", None))
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: ConvBurninConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    logits = forward(params, batch["images"], cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+# --- training step ---------------------------------------------------------
+
+
+def make_train_step(mesh: Mesh, cfg: ConvBurninConfig, optimizer=None):
+    optimizer = optimizer or optax.adamw(cfg.learning_rate)
+
+    def init_state(key):
+        params = shard_params(init_params(cfg, key), mesh, cfg)
+        return {"params": params, "opt": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch,
+                                                  cfg, mesh)
+        updates, new_opt = optimizer.update(grads, state["opt"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    return jax.jit(train_step, donate_argnums=0), init_state
+
+
+def make_batch(cfg: ConvBurninConfig, mesh: Mesh, key) -> Dict:
+    k1, k2 = jax.random.split(key)
+    images = jax.random.normal(
+        k1, (cfg.batch, cfg.image_size, cfg.image_size, cfg.in_channels))
+    labels = jax.random.randint(k2, (cfg.batch,), 0, cfg.n_classes)
+    return {
+        "images": jax.device_put(
+            images, NamedSharding(mesh, P("data", None, None, None))),
+        "labels": jax.device_put(labels, NamedSharding(mesh, P("data"))),
+    }
+
+
+def run(cfg: Optional[ConvBurninConfig] = None, steps: int = 5,
+        model_parallel: Optional[int] = None) -> Tuple[float, float]:
+    """Run the conv burn-in; returns (first_loss, last_loss); loss must
+    fall (the grads-flowed-through-every-shard proof)."""
+    from ..parallel.multihost import initialize, training_mesh
+
+    cfg = cfg or ConvBurninConfig()
+    initialize()
+    mesh = training_mesh(model_parallel=model_parallel)
+    step, init_state = make_train_step(mesh, cfg)
+    key = jax.random.PRNGKey(0)
+    state = init_state(key)
+    first = last = None
+    for i in range(steps):
+        batch = make_batch(cfg, mesh, jax.random.fold_in(key, i))
+        state, loss = step(state, batch)
+        last = float(loss)
+        first = last if first is None else first
+    return first, last
+
+
+def main() -> int:
+    import json
+
+    first, last = run()
+    ok = last < first
+    print(json.dumps({"workload": "convburn", "first_loss": first,
+                      "last_loss": last, "loss_fell": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,6 +48,9 @@ class MatmulResult:
 
 def run(size: int = 8192, iters: int = 32, calls: int = 8, repeats: int = 3,
         device: Optional[jax.Device] = None) -> MatmulResult:
+    from .backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     device = device or jax.devices()[0]
     dtype = jnp.bfloat16
     key = jax.random.PRNGKey(0)

@@ -142,6 +142,9 @@ def run(mesh: Mesh = None, axis_name: str = "expert",
         tokens_per_expert: int = 16, d_model: int = 32, d_ff: int = 64,
         seed: int = 0) -> MoEResult:
     """Expert-parallel MoE over the mesh, diffed against the oracle."""
+    from .backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     from ..parallel.mesh import ring_mesh
 
     if mesh is None:

@@ -140,6 +140,9 @@ def run(mesh: Mesh = None, axis_name: str = "pipe", batch: int = 8,
         n_microbatches: int = 4, seed: int = 0) -> PipelineResult:
     """Build an S-stage pipeline over the mesh, stream microbatches
     through it, and diff against the sequential oracle."""
+    from .backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     from ..parallel.mesh import ring_mesh
 
     if mesh is None:

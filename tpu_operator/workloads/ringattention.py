@@ -217,6 +217,9 @@ def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
         mesh: Optional[Mesh] = None) -> ContextParallelResult:
     """Run context-parallel attention over all devices and check it
     against the single-device oracle."""
+    from .backend import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import time
 
     devices = jax.devices()
