@@ -139,6 +139,21 @@ def honor_jax_platforms_env() -> None:
         pass  # no jax / unknown platform: the caller will surface it
 
 
+def pins_platform(fn):
+    """Decorator for workload ``run()`` entry points that touch
+    ``jax.devices()`` directly (no multihost.initialize in their path):
+    applies honor_jax_platforms_env before the body runs, so every
+    current and future entry point gets the pin from one place."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        honor_jax_platforms_env()
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
 def init_devices(attempts: int = 3, backoff_s: float = 5.0,
                  platform: Optional[str] = None, log=None) -> "list":
     """jax.devices() with retry/backoff on backend-init failure.

@@ -101,10 +101,11 @@ def param_specs(cfg: BurninConfig, fsdp: bool = False) -> Dict:
 def shard_params(params: Dict, mesh: Mesh, cfg: BurninConfig,
                  fsdp: bool = False) -> Dict:
     specs = param_specs(cfg, fsdp=fsdp)
+    # tree.map flattens by the FIRST tree (params); each PartitionSpec in
+    # the specs tree is taken whole at the matching leaf position
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs,
-        is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array, P)))
+        params, specs)
 
 
 # --- model -----------------------------------------------------------------

@@ -26,16 +26,15 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import ring_mesh, shard_map
+from .backend import pins_platform
 from .hardware import chip_spec_for
 
 
+@pins_platform
 def run(size_mb: float = 256.0, iters: int = 10, repeats: int = 5,
         devices=None) -> "CollectiveResult":
     """The gating psum measurement — one timing harness and one result
     type for the whole suite (run_collective)."""
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     return run_collective("all_reduce", size_mb=size_mb, iters=iters,
                           repeats=repeats, devices=devices)
 
@@ -177,6 +176,7 @@ def _oracle_ok(op: str, mesh, n: int) -> bool:
     return bool(np.allclose(got, want, rtol=1e-4))
 
 
+@pins_platform
 def run_collective(op: str, size_mb: float = 64.0, iters: int = 10,
                    repeats: int = 5, devices=None) -> CollectiveResult:
     """Measure one collective primitive over the ICI ring (NCCL-tests

@@ -93,10 +93,11 @@ def param_specs(cfg: ConvBurninConfig) -> Dict:
 
 
 def shard_params(params: Dict, mesh: Mesh, cfg: ConvBurninConfig) -> Dict:
+    # tree.map flattens by the FIRST tree (params); each PartitionSpec in
+    # the specs tree is taken whole at the matching leaf position
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, param_specs(cfg),
-        is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array, P)))
+        params, param_specs(cfg))
 
 
 # --- model -----------------------------------------------------------------

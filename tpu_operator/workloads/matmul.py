@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .backend import pins_platform
 from .hardware import chip_spec_for
 
 
@@ -46,11 +47,9 @@ class MatmulResult:
     checksum_ok: bool
 
 
+@pins_platform
 def run(size: int = 8192, iters: int = 32, calls: int = 8, repeats: int = 3,
         device: Optional[jax.Device] = None) -> MatmulResult:
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     device = device or jax.devices()[0]
     dtype = jnp.bfloat16
     key = jax.random.PRNGKey(0)

@@ -28,6 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import shard_map
 
+from .backend import pins_platform
+
 
 def init_moe_params(key, n_experts: int, d_model: int, d_ff: int) -> dict:
     """Router (replicated) + stacked per-expert FFN weights (leading axis
@@ -138,13 +140,11 @@ class MoEResult:
     device_kind: str
 
 
+@pins_platform
 def run(mesh: Mesh = None, axis_name: str = "expert",
         tokens_per_expert: int = 16, d_model: int = 32, d_ff: int = 64,
         seed: int = 0) -> MoEResult:
     """Expert-parallel MoE over the mesh, diffed against the oracle."""
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     from ..parallel.mesh import ring_mesh
 
     if mesh is None:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .backend import pins_platform
 from .hardware import chip_spec_for
 
 
@@ -71,15 +72,13 @@ class TriadResult:
     correct: bool
 
 
+@pins_platform
 def run(size_mb: float = 512.0, iters: int = 24, repeats: int = 3,
         interpret: Optional[bool] = None) -> TriadResult:
     """Two-point measurement: time ``lo`` and ``lo+iters`` triad loops and
     take the marginal rate, cancelling fixed dispatch/transfer latency
     (essential through tunneled PJRT runtimes, where a host round-trip
     costs tens of ms)."""
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     device = jax.devices()[0]
     cols = 4096
     rows_total = max(128, int(size_mb * 1e6 / 4 / cols) // 128 * 128)

@@ -29,6 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import make_varying, shard_map
 
+from .backend import pins_platform
+
 
 def init_stage_params(key, n_stages: int, d_model: int, d_ff: int) -> dict:
     """Stacked per-stage FFN-block weights, leading axis = stage."""
@@ -135,14 +137,12 @@ class PipelineResult:
     device_kind: str
 
 
+@pins_platform
 def run(mesh: Mesh = None, axis_name: str = "pipe", batch: int = 8,
         seq_len: int = 16, d_model: int = 32, d_ff: int = 64,
         n_microbatches: int = 4, seed: int = 0) -> PipelineResult:
     """Build an S-stage pipeline over the mesh, stream microbatches
     through it, and diff against the sequential oracle."""
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     from ..parallel.mesh import ring_mesh
 
     if mesh is None:

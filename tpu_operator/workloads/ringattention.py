@@ -38,6 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import shard_map, shard_map_unchecked
 
+from .backend import pins_platform
+
 NEG_INF = -1e30
 
 
@@ -211,15 +213,13 @@ class ContextParallelResult:
     correct: bool
 
 
+@pins_platform
 def run(seq_len: int = 2048, n_heads: int = 8, head_dim: int = 64,
         batch: int = 1, causal: bool = True,
         strategy: str = "ring",
         mesh: Optional[Mesh] = None) -> ContextParallelResult:
     """Run context-parallel attention over all devices and check it
     against the single-device oracle."""
-    from .backend import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
     import time
 
     devices = jax.devices()
