@@ -532,12 +532,16 @@ class _Handler(BaseHTTPRequestHandler):
             return self._not_found()
 
         def merge(base, patch):
+            # RFC 7386: a dict patch value recurses against the existing
+            # member or an EMPTY object, so nulls inside a new section
+            # are delete markers, never stored as literal None
             out = dict(base)
             for k, v in patch.items():
                 if v is None:
                     out.pop(k, None)
-                elif isinstance(v, dict) and isinstance(out.get(k), dict):
-                    out[k] = merge(out[k], v)
+                elif isinstance(v, dict):
+                    cur = out.get(k)
+                    out[k] = merge(cur if isinstance(cur, dict) else {}, v)
                 else:
                     out[k] = v
             return out

@@ -1,0 +1,147 @@
+"""Property tier for the runtime's merge-patch and FakeClient.patch.
+
+Every reconciler write that isn't a full replace rides
+``merge_patch`` (runtime/client.py, RFC 7386 semantics) and the fake
+apiserver's patch verb. Example tests pin known shapes; these
+properties pin the algebra:
+
+- diff/merge inversion: for any two None-free JSON objects a, b, the
+  canonical RFC 7386 diff (implemented independently here) applied to
+  ``a`` yields exactly ``b`` — a true inverse oracle, not the same
+  algorithm run twice;
+- idempotence and identity laws;
+- FakeClient.patch bookkeeping: resourceVersion bumps only on
+  effective change, generation bumps only on spec change, no-op
+  patches publish no watch event (rules the hash-skip steady-state
+  and the scale tier's write-free property depend on).
+"""
+
+import string
+
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from tpu_operator.runtime import FakeClient
+from tpu_operator.runtime.client import merge_patch
+
+FUZZ = settings(max_examples=80, deadline=None, derandomize=True,
+                suppress_health_check=[HealthCheck.too_slow])
+
+_KEYS = st.text(string.ascii_lowercase, min_size=1, max_size=5)
+
+# RFC 7386 cannot represent storing a literal null, so model documents
+# are None-free; patches MAY contain None (it means delete).
+_VALUES = st.recursive(
+    st.one_of(st.integers(-100, 100), st.booleans(),
+              st.text(string.ascii_letters, max_size=8),
+              st.lists(st.integers(0, 9), max_size=3)),
+    lambda inner: st.dictionaries(_KEYS, inner, max_size=4),
+    max_leaves=10)
+
+_DOCS = st.dictionaries(_KEYS, _VALUES, max_size=5)
+
+_PATCH_VALUES = st.recursive(
+    st.one_of(st.none(), st.integers(-100, 100), st.booleans(),
+              st.text(string.ascii_letters, max_size=8),
+              st.lists(st.integers(0, 9), max_size=3)),
+    lambda inner: st.dictionaries(_KEYS, inner, max_size=4),
+    max_leaves=10)
+
+_PATCHES = st.dictionaries(_KEYS, _PATCH_VALUES, max_size=5)
+
+
+def rfc7386_diff(a, b):
+    """Independent oracle: the canonical merge-patch turning a into b."""
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return b
+    patch = {}
+    for k in a:
+        if k not in b:
+            patch[k] = None
+        elif a[k] != b[k]:
+            patch[k] = rfc7386_diff(a[k], b[k])
+    for k in b:
+        if k not in a:
+            patch[k] = b[k]
+    return patch
+
+
+class TestMergePatchAlgebra:
+    @FUZZ
+    @given(_DOCS, _DOCS)
+    def test_diff_then_merge_is_identity(self, a, b):
+        assert merge_patch(a, rfc7386_diff(a, b)) == b
+
+    @FUZZ
+    @given(_DOCS, _PATCHES)
+    def test_idempotent(self, base, patch):
+        once = merge_patch(base, patch)
+        assert merge_patch(once, patch) == once
+
+    @FUZZ
+    @given(_DOCS)
+    def test_empty_patch_is_identity(self, base):
+        assert merge_patch(base, {}) == base
+
+    @FUZZ
+    @given(_DOCS, _PATCHES)
+    def test_no_nulls_survive(self, base, patch):
+        """A merged document never contains None anywhere — null is the
+        delete marker, not a storable value."""
+        def no_none(v):
+            if isinstance(v, dict):
+                return all(no_none(x) for x in v.values())
+            return v is not None
+
+        assert no_none(merge_patch(base, patch))
+
+    @FUZZ
+    @given(_DOCS, _PATCHES)
+    def test_base_not_mutated(self, base, patch):
+        import copy
+
+        snapshot = copy.deepcopy(base)
+        merge_patch(base, patch)
+        assert base == snapshot
+
+
+class TestFakeClientPatchBookkeeping:
+    def _seed(self, spec):
+        c = FakeClient()
+        c.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "x", "namespace": "default"},
+                  "data": {"k": "v"}, "spec": spec})
+        return c
+
+    @FUZZ
+    @given(_DOCS, _PATCHES)
+    def test_patch_matches_merge_model(self, spec, patch):
+        """The stored result equals the RFC 7386 model applied to the
+        stored object (metadata bookkeeping aside)."""
+        c = self._seed(spec)
+        before = c.get("v1", "ConfigMap", "x", "default")
+        after = c.patch("v1", "ConfigMap", "x", {"spec": patch}, "default")
+        expect = merge_patch(before.get("spec", {}), patch)
+        assert after.get("spec", {}) == expect
+
+    @FUZZ
+    @given(_DOCS, _PATCHES)
+    def test_rv_and_generation_rules(self, spec, patch):
+        c = self._seed(spec)
+        events = []
+        c.watch("v1", "ConfigMap", events.append)
+        del events[:]  # drop the initial ADDED replay
+        before = c.get("v1", "ConfigMap", "x", "default")
+        after = c.patch("v1", "ConfigMap", "x", {"spec": patch}, "default")
+        changed = after.get("spec") != before.get("spec")
+        rv_bumped = (after["metadata"]["resourceVersion"]
+                     != before["metadata"]["resourceVersion"])
+        gen_before = before["metadata"].get("generation", 1)
+        gen_after = after["metadata"].get("generation", 1)
+        if changed:
+            assert rv_bumped, "spec changed but resourceVersion did not"
+            assert gen_after == gen_before + 1
+            assert [e.type for e in events] == ["MODIFIED"]
+        else:
+            assert not rv_bumped, "no-op patch bumped resourceVersion"
+            assert gen_after == gen_before
+            assert events == [], "no-op patch published a watch event"

@@ -239,19 +239,10 @@ class _Handler(BaseHTTPRequestHandler):
         if current is None:
             return self._not_found()
 
-        def merge(base, patch):
-            out = dict(base)
-            for k, v in patch.items():
-                if v is None:
-                    out.pop(k, None)
-                elif isinstance(v, dict) and isinstance(out.get(k), dict):
-                    out[k] = merge(out[k], v)
-                else:
-                    out[k] = v
-            return out
+        from tpu_operator.runtime.client import merge_patch
 
         self.state.rv += 1
-        merged = merge(current, body)
+        merged = merge_patch(current, body)
         merged.setdefault("metadata", {})["resourceVersion"] = str(self.state.rv)
         self.state.objects[u.path] = merged
         self._send(200, merged)

@@ -225,13 +225,22 @@ def _blocking_pdb(client: "Client", pod: dict) -> Optional[str]:
 
 
 def merge_patch(base: dict, patch: Mapping) -> dict:
-    """RFC7386-style merge used by Client.patch implementations."""
+    """RFC 7386 merge-patch used by Client.patch implementations.
+
+    A Mapping patch value always recurses — against the existing member
+    when it is a Mapping, else against an empty object — so nulls inside
+    a freshly-introduced section are STRIPPED (delete markers), never
+    stored as literal None. A real apiserver behaves this way; storing
+    the None would be a mock/real divergence (fuzz-pinned in
+    tests/test_fuzz_runtime.py)."""
     out = dict(base)
     for k, v in patch.items():
         if v is None:
             out.pop(k, None)
-        elif isinstance(v, Mapping) and isinstance(out.get(k), Mapping):
-            out[k] = merge_patch(dict(out[k]), v)
+        elif isinstance(v, Mapping):
+            cur = out.get(k)
+            out[k] = merge_patch(dict(cur) if isinstance(cur, Mapping)
+                                 else {}, v)
         else:
             out[k] = v
     return out
