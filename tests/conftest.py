@@ -38,6 +38,7 @@ import pytest  # noqa: E402
 
 TIER_BY_MODULE = {
     "test_soak": "soak",
+    "test_fuzz_operands": "soak",  # ~120 full 15-state renders
     "test_http_e2e": "e2e",
     "test_install_e2e": "e2e",
     "test_e2e": "e2e",

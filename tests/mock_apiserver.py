@@ -531,26 +531,13 @@ class _Handler(BaseHTTPRequestHandler):
         if current is None:
             return self._not_found()
 
-        def merge(base, patch):
-            # RFC 7386: a dict patch value recurses against the existing
-            # member or an EMPTY object, so nulls inside a new section
-            # are delete markers, never stored as literal None
-            out = dict(base)
-            for k, v in patch.items():
-                if v is None:
-                    out.pop(k, None)
-                elif isinstance(v, dict):
-                    cur = out.get(k)
-                    out[k] = merge(cur if isinstance(cur, dict) else {}, v)
-                else:
-                    out[k] = v
-            return out
+        from tpu_operator.runtime.client import merge_patch
 
-        # merge over a deep copy: merge() reuses subtrees the patch does
-        # not touch, and admission defaulting mutates the new object in
-        # place — without the copy a rejected or no-op PATCH would default
-        # the STORED object with no RV bump or watch event
-        merged = merge(copy.deepcopy(current), body)
+        # merge over a deep copy: merge_patch reuses subtrees the patch
+        # does not touch, and admission defaulting mutates the new object
+        # in place — without the copy a rejected or no-op PATCH would
+        # default the STORED object with no RV bump or watch event
+        merged = merge_patch(copy.deepcopy(current), body)
         # status subresource: a main-resource merge-patch cannot change
         # status (same apiserver rule the PUT path enforces)
         if self.st.has_status_subresource(collection_of(u.path)):
