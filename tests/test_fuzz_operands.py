@@ -78,6 +78,17 @@ _SPEC = st.fixed_dictionaries({}, optional={
     "topologyManager": _COMPONENT,
     "libtpu": _COMPONENT,
     "validator": _COMPONENT,
+    # the isolated/virtual plane + health engine default OFF; generating
+    # their enable flags keeps all 15 states inside the fuzzed surface
+    "tpuHealth": _COMPONENT,
+    "sandboxWorkloads": st.fixed_dictionaries({}, optional={
+        "enabled": st.booleans(),
+        "defaultWorkload": st.sampled_from(
+            ["container", "isolated", "virtual"]),
+    }),
+    "chipFencing": _COMPONENT,
+    "vtpuDeviceManager": _COMPONENT,
+    "isolatedDevicePlugin": _COMPONENT,
     "daemonsets": st.fixed_dictionaries({}, optional={
         "updateStrategy": st.sampled_from(["RollingUpdate", "OnDelete"]),
         "priorityClassName": _LABEL_VAL,
@@ -108,16 +119,18 @@ class TestOperandRenderFuzz:
             assert d.get("apiVersion"), d
             assert d.get("kind"), d
             assert d.get("metadata", {}).get("name"), d
-        # dump/load/dump fixpoint: quoting survived
-        again = yaml.safe_dump_all(docs, sort_keys=True)
-        assert yaml.safe_dump_all(
-            [x for x in yaml.safe_load_all(again) if x is not None],
-            sort_keys=True) == again
-
     @FUZZ
-    @given(_ENV)
-    def test_env_lands_verbatim_on_container(self, env):
-        stream = _render({"devicePlugin": {"env": env}})
+    @given(_ENV, st.lists(_HOSTILE, max_size=2),
+           st.dictionaries(st.sampled_from(["note", "contact"]), _HOSTILE,
+                           max_size=2))
+    def test_hostile_values_roundtrip_verbatim(self, env, args, annotations):
+        """THE quoting proof: hostile env values, args, and annotations
+        set on an operand must come back byte-identical after the
+        rendered stream is parsed — not merely leave the stream
+        loadable. A value like 'a: b' emitted unquoted would re-parse as
+        a mapping and fail these comparisons."""
+        stream = _render({"devicePlugin": {
+            "env": env, "args": args, "annotations": annotations}})
         docs = [d for d in yaml.safe_load_all(stream) if d]
         ds = next(d for d in docs
                   if d["kind"] == "DaemonSet"
@@ -129,6 +142,11 @@ class TestOperandRenderFuzz:
             expected = {x["name"]: x["value"] for x in env}[e["name"]]
             assert got.get(e["name"]) == expected, (
                 f"env {e['name']!r}: {got.get(e['name'])!r} != {expected!r}")
+        if args:
+            assert ctr.get("args") == args, (ctr.get("args"), args)
+        meta_ann = ds["metadata"].get("annotations") or {}
+        for k, v in annotations.items():
+            assert meta_ann.get(k) == v, (k, meta_ann.get(k), v)
 
     @FUZZ
     @given(_SPEC)

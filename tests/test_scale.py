@@ -9,6 +9,8 @@ strictly better contract: a steady-state pass's apiserver request count
 is O(states), independent of node count.
 """
 
+import os
+
 import pytest
 from conftest import load_factor
 
@@ -91,3 +93,16 @@ def test_pool_mix_is_realistic():
     assert len(nodes) - len(tpu) == 50  # CPU nodes present
     pools = get_node_pools(nodes)
     assert len(pools) >= 4, [p.name for p in pools]
+
+
+@pytest.mark.skipif(not os.environ.get("TPU_SCALE_NODES"),
+                    reason="opt-in deep-scale run: TPU_SCALE_NODES=2000")
+def test_scale_env_override(r500):
+    """Opt-in deeper datapoint (TPU_SCALE_NODES=N): convergence and the
+    node-independence property must hold at N, not just 100/500."""
+    n = int(os.environ["TPU_SCALE_NODES"])
+    r = run_scale_bench(n)
+    assert r["ready"], r
+    assert abs(r["steady_requests"] - r500["steady_requests"]) \
+        <= NODE_INDEPENDENCE_SLACK, (r["steady_verbs"],
+                                     r500["steady_verbs"])
