@@ -385,3 +385,80 @@ class TestWriteWire:
                            "kind": "TPUDriver",
                            "metadata": {"name": "d"},
                            "spec": {"channel": "weekly"}})
+
+
+def throttled(retry_after: str = "0") -> bytes:
+    """API priority-and-fairness rejection: 429 + Retry-After header,
+    v1.Status body with reason TooManyRequests — the shape the apiserver
+    emits when a flow-schema queue is full (request NOT executed)."""
+    body = json.dumps({
+        "kind": "Status", "apiVersion": "v1", "metadata": {},
+        "status": "Failure",
+        "message": "this request has been rejected by the API "
+                   "priority and fairness filter",
+        "reason": "TooManyRequests", "code": 429}).encode()
+    return (f"HTTP/1.1 429 Too Many Requests\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Retry-After: {retry_after}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+class TestThrottleWire:
+    def test_429_retry_after_then_success(self, wire):
+        """A priority-and-fairness 429 is retried transparently after
+        Retry-After; the caller sees only the eventual object. client-go
+        behaves the same; a client that surfaces the first 429 turns
+        apiserver load spikes into reconcile errors."""
+        srv, client = wire
+        srv.script("GET", "any",
+                   Exchange(throttled("0")),
+                   Exchange(plain(200, "OK", pod("a", "7"))))
+        obj = client.get("v1", "Pod", "a", "default")
+        assert obj["metadata"]["resourceVersion"] == "7"
+        assert [m for m, _, _ in srv.requests] == ["GET", "GET"]
+
+    def test_429_exhausts_retries_surfaces_apierror(self, wire):
+        from tpu_operator.runtime.client import ApiError
+
+        srv, client = wire
+        srv.script("GET", "any", Exchange(throttled("0")),
+                   Exchange(throttled("0")), Exchange(throttled("0")))
+        with pytest.raises(ApiError) as ei:
+            client.get("v1", "Pod", "a", "default")
+        assert ei.value.code == 429
+        assert len(srv.requests) == 3  # bounded: initial + 2 retries
+
+    def test_eviction_429_never_retried(self, wire):
+        """The eviction subresource's 429 means PDB-blocked, NOT
+        throttled: exactly ONE request may hit the wire (a retrying
+        client would hammer a protected pod)."""
+        srv, client = wire
+        srv.script("POST", "any", Exchange(plain(
+            429, "Too Many Requests", {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure",
+                "message": "Cannot evict pod as it would violate the "
+                           "pod's disruption budget.",
+                "reason": "TooManyRequests", "code": 429})))
+        with pytest.raises(EvictionBlockedError):
+            client.evict("a", "default")
+        assert len(srv.requests) == 1
+
+    def test_lease_429_never_retried(self, wire):
+        """Lease operations are exempt from throttle retries: a leader
+        sleeping through Retry-After inside a renew would outlive its
+        own lease (client-go runs leader election on a non-retrying
+        client). Exactly one request may hit the wire, and the 429
+        surfaces immediately."""
+        from tpu_operator.runtime.client import ApiError
+
+        srv, client = wire
+        srv.script("GET", "any", Exchange(throttled("30")))
+        t0 = time.monotonic()
+        with pytest.raises(ApiError) as ei:
+            client.get("coordination.k8s.io/v1", "Lease", "tpu-operator",
+                       "tpu-operator")
+        assert ei.value.code == 429
+        assert time.monotonic() - t0 < 5, "lease 429 slept on Retry-After"
+        assert len(srv.requests) == 1
+        assert "/leases/" in srv.requests[0][1]

@@ -15,6 +15,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from typing import Callable, Optional
 
 import requests
@@ -198,6 +199,34 @@ class HTTPClient(Client):
             parts.append(subresource)
         return "/".join(parts)
 
+    def _send(self, method: str, url: str, **kw) -> requests.Response:
+        """Issue one API request, honoring apiserver throttling the way
+        client-go does: a 429 from API priority-and-fairness means the
+        request was REJECTED BEFORE EXECUTION (so every verb is safe to
+        re-issue) and carries Retry-After. Bounded: two retries, sleep
+        capped at 10s, then the 429 surfaces as a plain ApiError for the
+        reconcile loop's own backoff. The sleep is interruptible: a
+        stopping client (watch cancel, shutdown) gives up immediately.
+
+        Exemptions: the pods/eviction subresource never comes through
+        here (its 429 means PDB-blocked, not throttled), and Lease
+        operations are NOT retried — a leader blocking tens of seconds
+        inside a renew during an apiserver load spike would outlive its
+        own lease and churn leadership; client-go deliberately runs
+        leader election on a non-retrying client for the same reason."""
+        retriable = "/leases/" not in url and not url.endswith("/leases")
+        for attempt in range(3):
+            resp = getattr(self.session, method)(url, **kw)
+            if resp.status_code != 429 or attempt == 2 or not retriable:
+                return resp
+            try:
+                delay = float(resp.headers.get("Retry-After", 1))
+            except (TypeError, ValueError):
+                delay = 1.0
+            if self._stop.wait(min(max(delay, 0.0), 10.0)):
+                return resp  # client is shutting down: surface the 429
+        return resp  # pragma: no cover - loop always returns
+
     @staticmethod
     def _raise_for(resp: requests.Response, what: str):
         if resp.status_code < 400:
@@ -229,8 +258,9 @@ class HTTPClient(Client):
     def get(self, api_version, kind, name, namespace=None,
             metadata_only=False):
         headers = {"Accept": self.METADATA_ACCEPT} if metadata_only else None
-        resp = self.session.get(
-            self._url(api_version, kind, name, namespace), headers=headers)
+        resp = self._send(
+            "get", self._url(api_version, kind, name, namespace),
+            headers=headers)
         self._raise_for(resp, f"get {kind}/{name}")
         return resp.json()
 
@@ -272,7 +302,7 @@ class HTTPClient(Client):
         if not opts.namespace and is_namespaced(kind):
             # all-namespaces list
             url = f"{self._base(api_version)}/{plural_of(kind)}"
-        resp = self.session.get(url, params=params)
+        resp = self._send("get", url, params=params)
         self._raise_for(resp, f"list {kind}")
         body = resp.json()
         items = body.get("items", [])
@@ -287,14 +317,15 @@ class HTTPClient(Client):
     def create(self, obj):
         av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
         ns = obj.get("metadata", {}).get("namespace")
-        resp = self.session.post(self._url(av, kind, None, ns), json=obj)
+        resp = self._send("post", self._url(av, kind, None, ns), json=obj)
         self._raise_for(resp, f"create {kind}")
         return resp.json()
 
     def update(self, obj):
         av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
         meta = obj.get("metadata", {})
-        resp = self.session.put(
+        resp = self._send(
+            "put",
             self._url(av, kind, meta.get("name"), meta.get("namespace")), json=obj)
         self._raise_for(resp, f"update {kind}/{meta.get('name')}")
         return resp.json()
@@ -302,22 +333,23 @@ class HTTPClient(Client):
     def update_status(self, obj):
         av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
         meta = obj.get("metadata", {})
-        resp = self.session.put(
+        resp = self._send(
+            "put",
             self._url(av, kind, meta.get("name"), meta.get("namespace"), "status"),
             json=obj)
         self._raise_for(resp, f"update status {kind}/{meta.get('name')}")
         return resp.json()
 
     def patch(self, api_version, kind, name, patch, namespace=None):
-        resp = self.session.patch(
-            self._url(api_version, kind, name, namespace),
+        resp = self._send(
+            "patch", self._url(api_version, kind, name, namespace),
             data=json.dumps(patch),
             headers={"Content-Type": "application/merge-patch+json"})
         self._raise_for(resp, f"patch {kind}/{name}")
         return resp.json()
 
     def delete(self, api_version, kind, name, namespace=None):
-        resp = self.session.delete(self._url(api_version, kind, name, namespace))
+        resp = self._send("delete", self._url(api_version, kind, name, namespace))
         self._raise_for(resp, f"delete {kind}/{name}")
 
     def evict(self, name, namespace=None):
