@@ -182,6 +182,13 @@ class HTTPClient(Client):
 
     # -- path construction -------------------------------------------------
 
+    def close(self) -> None:
+        """Shut the client down: wakes any throttle-retry sleep (the 429
+        surfaces immediately), stops watch threads at their next loop
+        check, and closes the pooled connections."""
+        self._stop.set()
+        self.session.close()
+
     def _base(self, api_version: str) -> str:
         if "/" in api_version:
             return f"{self.config.server}/apis/{api_version}"

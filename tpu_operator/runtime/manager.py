@@ -295,6 +295,10 @@ class Manager:
         if self._http:
             self._http.shutdown()
             self._http.server_close()
+        # wake throttle-retry sleeps and stop watch threads (real
+        # apiserver client only; the fake has no connections to close)
+        if hasattr(self.client, "close"):
+            self.client.close()
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         return all(c.wait_idle(timeout) for c in self.controllers)
