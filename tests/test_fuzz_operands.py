@@ -11,14 +11,14 @@ labels, args, and versions. Invariants:
   (TemplateError / ValueError) — never a raw crash;
 - every rendered object is a well-formed Kubernetes object
   (apiVersion/kind/metadata.name);
-- the rendered stream survives a YAML dump/load round-trip unchanged —
-  the quoting proof: a hostile env value must come back byte-identical,
-  neither corrupting the document nor re-parsing as structure;
-- user env vars land verbatim on the operand container; DaemonSet
-  selectors always match their pod-template labels (kubelet would
-  reject the object otherwise).
+- hostile env values, args, and annotations come back byte-identical
+  from the parsed stream — the quoting proof: a value emitted unquoted
+  would re-parse as structure and fail the comparison;
+- DaemonSet selectors always match their pod-template labels (kubelet
+  would reject the object otherwise).
 """
 
+import os
 import string
 
 import yaml
@@ -27,8 +27,10 @@ from hypothesis import HealthCheck, assume, given, settings, strategies as st
 from tpu_operator.render.engine import TemplateError
 from test_golden_render import render_all
 
-FUZZ = settings(max_examples=40, deadline=None, derandomize=True,
-                suppress_health_check=[HealthCheck.too_slow])
+FUZZ = settings(
+    max_examples=int(os.environ.get("TPU_FUZZ_EXAMPLES", "40")),
+    deadline=None, derandomize=True,
+    suppress_health_check=[HealthCheck.too_slow])
 
 # strings a user can legally supply that are hazardous to YAML or to a
 # template engine if quoting is sloppy
@@ -119,6 +121,7 @@ class TestOperandRenderFuzz:
             assert d.get("apiVersion"), d
             assert d.get("kind"), d
             assert d.get("metadata", {}).get("name"), d
+
     @FUZZ
     @given(_ENV, st.lists(_HOSTILE, max_size=2),
            st.dictionaries(st.sampled_from(["note", "contact"]), _HOSTILE,

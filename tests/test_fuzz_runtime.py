@@ -16,6 +16,7 @@ properties pin the algebra:
   and the scale tier's write-free property depend on).
 """
 
+import os
 import string
 
 from hypothesis import HealthCheck, given, settings, strategies as st
@@ -23,8 +24,10 @@ from hypothesis import HealthCheck, given, settings, strategies as st
 from tpu_operator.runtime import FakeClient
 from tpu_operator.runtime.client import merge_patch
 
-FUZZ = settings(max_examples=80, deadline=None, derandomize=True,
-                suppress_health_check=[HealthCheck.too_slow])
+FUZZ = settings(
+    max_examples=int(os.environ.get("TPU_FUZZ_EXAMPLES", "80")),
+    deadline=None, derandomize=True,
+    suppress_health_check=[HealthCheck.too_slow])
 
 _KEYS = st.text(string.ascii_lowercase, min_size=1, max_size=5)
 
