@@ -196,6 +196,17 @@ class Controller:
         self.queue.shutdown()
         for cancel in self._watch_cancels:
             cancel()
+        # join the workers: stop() returning must mean no reconcile is
+        # still writing — a caller that starts a successor manager (or a
+        # test snapshotting cluster state) needs quiescence, not just a
+        # flag. Daemon threads + bounded join keep a wedged reconcile
+        # from hanging shutdown forever.
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=30.0)
+                if t.is_alive():  # pragma: no cover - wedged reconcile
+                    log.warning("[%s] worker did not stop within 30s",
+                                self.name)
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Test helper: wait until the queue fully drains (incl. delayed)."""
@@ -288,6 +299,14 @@ class Manager:
             ctrl.start()
 
     def stop(self):
+        # signal the client FIRST: a worker sleeping in the HTTP client's
+        # 429 throttle-retry wait is interruptible only by client.close(),
+        # and ctrl.stop() below joins that worker — closing after the
+        # joins would turn each throttled reconcile into a full
+        # Retry-After nap on the shutdown path (fake clients have no
+        # connections and no close())
+        if hasattr(self.client, "close"):
+            self.client.close()
         for ctrl in self.controllers:
             ctrl.stop()
         if self.elector:
@@ -295,10 +314,6 @@ class Manager:
         if self._http:
             self._http.shutdown()
             self._http.server_close()
-        # wake throttle-retry sleeps and stop watch threads (real
-        # apiserver client only; the fake has no connections to close)
-        if hasattr(self.client, "close"):
-            self.client.close()
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         return all(c.wait_idle(timeout) for c in self.controllers)
