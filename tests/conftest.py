@@ -86,4 +86,8 @@ def load_factor():
         external = os.getloadavg()[0] / ncpu
     except (OSError, AttributeError):  # platform without getloadavg
         external = 0.0
-    return max(1.0, workers / ncpu, external)
+    # the 1-min loadavg lags burst contention (xdist warm-up, first JAX
+    # compiles), so parallel runs keep a small workers-based floor for
+    # that window; capped so budgets never scale unbounded with -n
+    burst_floor = min(workers / 2.0, 4.0)
+    return max(1.0, workers / ncpu, external, burst_floor)

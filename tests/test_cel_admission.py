@@ -267,3 +267,18 @@ def test_tpu_driver_crd_emits_rules_in_generated_output():
     text = json.dumps(crd)
     assert "x-kubernetes-validations" in text
     assert "channel is immutable" in text
+
+
+def test_unsupported_token_in_rule_rejects_not_crashes():
+    """A rule using valid-CEL-but-unsupported syntax ('+') must land in
+    the fail-closed rejection path of schema admission, not raise out of
+    the transition-rule probe (references_old_self) and crash the
+    caller."""
+    from tpu_operator.api.cel import schema_cel_errors
+
+    schema = {"type": "object", "properties": {"replicas": {
+        "type": "integer",
+        "x-kubernetes-validations": [
+            {"rule": "self + 1 > 0", "message": "bad"}]}}}
+    errs = schema_cel_errors({"replicas": 3}, None, schema)
+    assert len(errs) == 1 and "failed to evaluate" in errs[0]

@@ -462,3 +462,19 @@ class TestThrottleWire:
         assert time.monotonic() - t0 < 5, "lease 429 slept on Retry-After"
         assert len(srv.requests) == 1
         assert "/leases/" in srv.requests[0][1]
+
+    def test_429_retried_in_namespace_named_leases(self, wire):
+        """The lease exemption matches the coordination.k8s.io group,
+        not a path substring: resources in a user namespace that happens
+        to be called 'leases' keep their throttle retries."""
+        srv, client = wire
+        srv.script("GET", "any",
+                   Exchange(throttled("0")),
+                   Exchange(plain(200, "OK", {
+                       "kind": "Pod", "apiVersion": "v1",
+                       "metadata": {"name": "a", "namespace": "leases",
+                                    "resourceVersion": "5"}})))
+        obj = client.get("v1", "Pod", "a", "leases")
+        assert obj["metadata"]["resourceVersion"] == "5"
+        assert len(srv.requests) == 2
+        assert "/namespaces/leases/pods/a" in srv.requests[0][1]

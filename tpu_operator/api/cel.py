@@ -283,7 +283,14 @@ def _eval(node, env: dict) -> Any:
 
 
 def references_old_self(rule: str) -> bool:
-    return any(t == ("ident", "oldSelf") for t in _tokenize(rule))
+    """True when the rule mentions ``oldSelf`` (a transition rule).
+    An untokenizable rule returns False so the caller's evaluate() is
+    the one place that raises — the rule then lands in the fail-closed
+    rejection path instead of crashing admission from this probe."""
+    try:
+        return any(t == ("ident", "oldSelf") for t in _tokenize(rule))
+    except EvalError:
+        return False
 
 
 def evaluate(rule: str, self_val: Any, old_self: Any = _ABSENT) -> bool:

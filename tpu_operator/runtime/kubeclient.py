@@ -15,7 +15,6 @@ import json
 import os
 import tempfile
 import threading
-import time
 from typing import Callable, Optional
 
 import requests
@@ -212,16 +211,21 @@ class HTTPClient(Client):
         request was REJECTED BEFORE EXECUTION (so every verb is safe to
         re-issue) and carries Retry-After. Bounded: two retries, sleep
         capped at 10s, then the 429 surfaces as a plain ApiError for the
-        reconcile loop's own backoff. The sleep is interruptible: a
-        stopping client (watch cancel, shutdown) gives up immediately.
+        reconcile loop's own backoff. The sleep wakes on client
+        shutdown (close()); a per-watch cancel alone does not reach it —
+        the watch loop re-checks its stop flag right after _send returns.
 
         Exemptions: the pods/eviction subresource never comes through
-        here (its 429 means PDB-blocked, not throttled), and Lease
-        operations are NOT retried — a leader blocking tens of seconds
-        inside a renew during an apiserver load spike would outlive its
-        own lease and churn leadership; client-go deliberately runs
-        leader election on a non-retrying client for the same reason."""
-        retriable = "/leases/" not in url and not url.endswith("/leases")
+        here (its 429 means PDB-blocked, not throttled), and
+        coordination.k8s.io Lease operations are NOT retried — a leader
+        blocking tens of seconds inside a renew during an apiserver load
+        spike would outlive its own lease and churn leadership;
+        client-go deliberately runs leader election on a non-retrying
+        client for the same reason. The match is on the API group, not a
+        path substring, so a user namespace or object named 'leases'
+        keeps its retries."""
+        retriable = "/apis/coordination.k8s.io/" not in url or \
+            not ("/leases/" in url or url.endswith("/leases"))
         for attempt in range(3):
             resp = getattr(self.session, method)(url, **kw)
             if resp.status_code != 429 or attempt == 2 or not retriable:
