@@ -1,0 +1,81 @@
+# Developer surface for the TPU operator (reference slot: the root
+# Makefile's test/validate/generate/bundle targets). Every target wraps
+# a command documented in README.md / OPERATIONS.md — the Makefile adds
+# no behavior of its own.
+
+PYTHON ?= python
+
+.PHONY: all test unit-test e2e-test jax-test soak-test shell-test \
+        bench-test fuzz-deep \
+        native validate-samples generate manifests bundle helm-chart \
+        bench dryrun demo clean
+
+all: native unit-test
+
+# -- tests (tiers mirror tests/conftest.py) ---------------------------------
+
+test:            ## full suite (~10 min at -n 8; see README Tests)
+	$(PYTHON) -m pytest tests/ -q -n 8
+
+unit-test:       ## CI-fast tier
+	$(PYTHON) -m pytest tests/ -m unit -q
+
+e2e-test:        ## operator lifecycle over the mock HTTP apiserver
+	$(PYTHON) -m pytest tests/ -m e2e -q
+
+jax-test:        ## compile-heavy workload proofs (8-device CPU mesh)
+	$(PYTHON) -m pytest tests/ -m jax -q
+
+soak-test:       ## chaos soak + scale tier + render fuzz
+	$(PYTHON) -m pytest tests/ -m soak -q
+
+shell-test:      ## real-CLI shell e2e + native probe/telemetry + container build
+	$(PYTHON) -m pytest tests/ -m shell -q
+
+bench-test:      ## bench harness tests
+	$(PYTHON) -m pytest tests/ -m bench -q
+
+fuzz-deep:       ## property tiers at 2000 examples each
+	TPU_FUZZ_EXAMPLES=2000 $(PYTHON) -m pytest -q \
+	    tests/test_fuzz_engines.py tests/test_fuzz_runtime.py \
+	    tests/test_fuzz_operands.py
+
+# -- build / packaging ------------------------------------------------------
+
+native:          ## C++ helpers (libtpu-probe, tpu-telemetry)
+	$(MAKE) -C native
+
+generate:        ## CRDs + operator deployment stream to stdout
+	$(PYTHON) -m tpu_operator.cli.tpuop_cfg generate all
+
+manifests: generate
+
+bundle:          ## OLM registry+v1 bundle directory
+	$(PYTHON) -m tpu_operator.cli.tpuop_cfg generate bundle --dir bundle/
+
+helm-chart:      ## Helm chart (golden-pinned to `generate all`)
+	$(PYTHON) -m tpu_operator.cli.tpuop_cfg generate helm-chart \
+	    --dir deployments/tpu-operator
+
+validate-samples:  ## sample CRs stay valid offline
+	$(PYTHON) -m tpu_operator.cli.tpuop_cfg validate clusterpolicy \
+	    -f config/samples/tpu_v1_tpuclusterpolicy.yaml
+	$(PYTHON) -m tpu_operator.cli.tpuop_cfg validate tpudriver \
+	    -f config/samples/tpu_v1alpha1_tpudriver.yaml
+
+# -- run --------------------------------------------------------------------
+
+demo:            ## full control-plane demo on an in-memory cluster
+	$(PYTHON) -m tpu_operator.cli.operator --fake-cluster --once
+
+bench:           ## single JSON line; real chip when reachable
+	$(PYTHON) bench.py
+
+dryrun:          ## multi-chip sharding compile+execute on 8 CPU devices
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -rf .pytest_cache .hypothesis bundle/
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
