@@ -25,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-operator",
         description="TPU-native cluster operator controller manager")
+    from .. import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
     p.add_argument("--namespace",
                    default=os.environ.get("OPERATOR_NAMESPACE", "tpu-operator"),
                    help="namespace operands are deployed into")

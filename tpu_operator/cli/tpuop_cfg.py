@@ -234,6 +234,10 @@ def _lifecycle_verbs(args, client, docs, log) -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
+    from .. import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     v = sub.add_parser("validate", help="offline CR validation")
