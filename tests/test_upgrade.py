@@ -17,7 +17,7 @@ from tpu_operator.controllers.upgrade_controller import (
     UpgradeReconciler,
 )
 from tpu_operator.runtime import FakeClient, ListOptions, Request
-from tpu_operator.runtime.objects import get_nested, labels_of
+from tpu_operator.runtime.objects import get_nested, labels_of, name_of
 
 
 def build_converged_cluster(n_nodes=2, auto_upgrade=True):
@@ -839,3 +839,92 @@ class TestIsolatedPlaneDrain:
         # drive one pass: the drain stage must evict the renamed consumer
         rec.reconcile(Request(name="tpu-cluster-policy"))
         assert c.get_or_none("v1", "Pod", "renamed-wl", "default") is None
+
+
+class TestOperatorRestartMidUpgrade:
+    """Operator crash mid-rollout: the reconciler holds NO in-memory FSM
+    state — the state label and every deadline stamp live on the node —
+    so a FRESH reconciler instance must resume an in-flight rollout
+    exactly where the dead one stopped. The reference relies on the same
+    label-resident FSM for restart safety (upgrade_controller.go requeues
+    rebuild the picture from node labels every pass)."""
+
+    def test_fresh_instance_resumes_validation_without_redrain(self):
+        c, prec = build_converged_cluster(n_nodes=3)
+        rec1 = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec1.reconcile(Request(name="tpu-cluster-policy"))
+        in_flight = [name_of(n) for n in c.list("v1", "Node")
+                     if labels_of(n).get(L.UPGRADE_STATE) == STATE_VALIDATION]
+        assert len(in_flight) == 1  # budget 1
+        node_name = in_flight[0]
+        # kubelet recreates the driver pod on the new revision
+        c.simulate_kubelet(ready=True)
+        [new_pod] = [p for p in driver_pods(c)
+                     if get_nested(p, "spec", "nodeName") == node_name]
+        new_rv = get_nested(new_pod, "metadata", "resourceVersion")
+        # the operator dies; a brand-new instance picks up the cluster
+        rec2 = UpgradeReconciler(client=c, namespace="tpu-operator")
+        rec2.reconcile(Request(name="tpu-cluster-policy"))
+        # the in-flight node resumed forward (validation -> done), was
+        # NOT walked back through cordon/drain...
+        assert node_state(c, node_name) == STATE_DONE
+        assert not get_nested(c.get("v1", "Node", node_name), "spec",
+                              "unschedulable", default=False)
+        # ...and its new-revision driver pod was not deleted again
+        [pod_after] = [p for p in driver_pods(c)
+                       if get_nested(p, "spec", "nodeName") == node_name]
+        assert get_nested(pod_after, "metadata",
+                          "resourceVersion") == new_rv
+        # the rollout also moves on: the next pass hands the freed budget
+        # slot to another node
+        rec2.reconcile(Request(name="tpu-cluster-policy"))
+        states = [labels_of(n).get(L.UPGRADE_STATE)
+                  for n in c.list("v1", "Node")]
+        assert states.count(STATE_VALIDATION) == 1
+        assert states.count(STATE_DONE) == 1
+
+    def test_drain_deadline_survives_restart(self):
+        """A PDB-blocked drain stamped by the dead operator must time out
+        against the ORIGINAL stamp — a restart cannot re-base the drain
+        window and give the blocking pod another full timeout."""
+        clock = [1000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
+        c.create({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                  "metadata": {"name": "guard", "namespace": "default"},
+                  "spec": {"selector": {"matchLabels": {"app": "guarded"}},
+                           "minAvailable": 1}})
+        rec1 = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                 now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec1.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_DRAIN  # stamped at t=1000
+        # operator restarts 301s later; the new instance must see the
+        # original stamp and fail the node immediately, not at t+300
+        clock[0] += 301.0
+        rec2 = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                 now=lambda: clock[0])
+        rec2.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        anns = c.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert "drain timed out" in anns[L.UPGRADE_FAILED_REASON]
+
+    def test_validation_deadline_survives_restart(self):
+        """Same contract for the validation window: the stamp set by the
+        dead operator bounds the wait, not the restart time."""
+        clock = [5000.0]
+        c, prec = build_converged_cluster(n_nodes=1)
+        rec1 = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                 now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec1.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_VALIDATION
+        # validator never re-proves; restart past the 300s window
+        clock[0] += 301.0
+        rec2 = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                 now=lambda: clock[0])
+        rec2.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "tpu-0") == STATE_FAILED
+        anns = c.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert "validation timed out" in anns[L.UPGRADE_FAILED_REASON]
