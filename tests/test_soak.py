@@ -26,6 +26,7 @@ from tpu_operator.runtime.fake import simulate_kubelet
 from tpu_operator.runtime.kubeclient import HTTPClient, KubeConfig
 from tpu_operator.runtime.manager import Manager
 from tpu_operator.runtime.objects import get_nested, labels_of
+from tpu_operator.utils.hash import object_hash
 
 from mock_apiserver import MockApiServer
 
@@ -96,19 +97,29 @@ def test_chaos_soak_converges_after_every_disruption():
     def ready():
         return cr_state(ops) == "ready"
 
+    def update_policy(mutate_fn):
+        """Conflict-retried CR mutation: the manager writes status in
+        parallel, so the adversary re-reads and retries on any write
+        failure. Exhausting the retries raises — a move that never
+        landed must fail loudly, not time out later with a baffling
+        'pred error: None'."""
+        for _ in range(10):
+            cr = ops.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+            mutate_fn(cr.setdefault("spec", {}))
+            try:
+                ops.update(cr)
+                return
+            except Exception:
+                time.sleep(0.1)
+        raise AssertionError("soak: policy mutation never landed "
+                             "after 10 conflict retries")
+
     # -- the adversary's moves (each returns a description) -------------
     def mutate_policy():
         marker = f"SOAK_{rng.randrange(1_000_000)}"
-        for _ in range(10):
-            cr = ops.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
-            spec = cr.setdefault("spec", {})
-            spec.setdefault("devicePlugin", {})["env"] = [
-                {"name": "SOAK_MARKER", "value": marker}]
-            try:
-                ops.update(cr)
-                break
-            except Exception:
-                time.sleep(0.1)
+        update_policy(lambda spec: spec.setdefault("devicePlugin", {})
+                      .__setitem__("env", [{"name": "SOAK_MARKER",
+                                            "value": marker}]))
 
         def applied():
             ds = ops.get_or_none("apps/v1", "DaemonSet",
@@ -179,14 +190,68 @@ def test_chaos_soak_converges_after_every_disruption():
         srv.fail_next_writes = n
         return f"{desc} + {n} write conflicts injected", pred
 
+    def trigger_upgrade():
+        # change the OnDelete driver DS template: nothing rolls until
+        # the upgrade FSM walks every node through cordon -> drain ->
+        # pod restart -> re-validation -> uncordon — under whatever
+        # chaos the other moves have left behind (churned nodes,
+        # conflict injection, dropped watches)
+        marker = f"/opt/soak-libtpu-{rng.randrange(1_000_000)}"
+        update_policy(lambda spec: spec.setdefault("libtpu", {})
+                      .__setitem__("installDir", marker))
+
+        def rolled():
+            if not ready():
+                return False
+            nodes = ops.list("v1", "Node")
+            tpu_nodes = [n for n in nodes
+                         if labels_of(n).get(L.GKE_TPU_ACCELERATOR)]
+            # the FSM finished everywhere and left the fleet schedulable
+            if any(labels_of(n).get(L.UPGRADE_STATE) not in (None, "done")
+                   for n in tpu_nodes):
+                return False
+            if any(get_nested(n, "spec", "unschedulable", default=False)
+                   for n in nodes):
+                return False
+            # the marker reached the rendered template, and the rollout
+            # really happened: one live driver pod per TPU node, every
+            # one at the NEW template revision (the simulated kubelet
+            # stamps pods with controller-revision-hash only — the same
+            # key the FSM itself rolls on)
+            import json as _json
+
+            ds = ops.get_or_none("apps/v1", "DaemonSet",
+                                 "tpu-libtpu-driver-daemonset", NS)
+            if ds is None or marker not in _json.dumps(ds):
+                return False
+            want = object_hash(
+                get_nested(ds, "spec", "template", default={}))
+            pods = [p for p in ops.list("v1", "Pod",
+                                        ListOptions(namespace=NS))
+                    if (get_nested(p, "metadata", "labels", default={})
+                        or {}).get("tpu.graft.dev/component")
+                    == "libtpu-driver"
+                    and not get_nested(p, "metadata", "deletionTimestamp")]
+            return (len(pods) == len(tpu_nodes)
+                    and all((get_nested(p, "metadata", "labels",
+                                        "controller-revision-hash"))
+                            == want for p in pods))
+
+        return f"driver template changed ({marker}): FSM rollout", rolled
+
     moves = [mutate_policy, delete_operand, add_node, remove_node,
-             drop_watches, inject_conflicts]
+             drop_watches, inject_conflicts, trigger_upgrade]
 
     mgr.start()
     try:
         for i in range(2):
             ops.create(tpu_node(f"tpu-{i}"))
-        ops.create(new_cluster_policy())
+        # autoUpgrade on, wide budget: the trigger_upgrade move needs the
+        # FSM live, and a parallel budget keeps a fleet rollout inside
+        # the per-step convergence window
+        ops.create(new_cluster_policy(spec={
+            "upgradePolicy": {"autoUpgrade": True,
+                              "maxParallelUpgrades": 4}}))
         wait_converged(ops, ready, "initial install")
 
         # default 10 disruptions; TPU_SOAK_STEPS=200 turns this into a
@@ -194,7 +259,18 @@ def test_chaos_soak_converges_after_every_disruption():
         for step in range(int(os.environ.get("TPU_SOAK_STEPS", "10"))):
             move = rng.choice(moves)
             desc, pred = move()
-            wait_converged(ops, pred, f"step {step}: {desc}")
+            # a fleet FSM walk is the slowest convergence in the suite;
+            # it gets the same wider window as the final rollout
+            wait_converged(ops, pred, f"step {step}: {desc}",
+                           timeout=180.0 if move is trigger_upgrade
+                           else 90.0)
+
+        # one guaranteed fleet rollout regardless of what the seed drew,
+        # against whatever cluster the chaos steps left behind; a full
+        # FSM walk over every node is the slowest convergence in the
+        # suite, so it gets a wider window
+        desc, pred = trigger_upgrade()
+        wait_converged(ops, pred, f"final: {desc}", timeout=180.0)
     finally:
         mgr.stop()
         ops._stop.set()
