@@ -180,9 +180,20 @@ def test_install_rejects_invalid_values(cluster, capsys):
 
 def test_status_verb_tracks_lifecycle(cluster, capsys):
     """`tpuop-cfg status` is the helm-status slot: NOT READY right after
-    install (operator not yet reconciling), READY with per-operand and
-    cluster-facts detail once converged, rc 1 after uninstall."""
+    install (operator not yet reconciling), READY with per-operand,
+    per-slice and cluster-facts detail once converged, rc 1 after
+    uninstall."""
+    from tpu_operator.api import labels as L
+
     srv, ops = cluster
+    # a 2-host v5p slice on top of the fixture's single-host nodes:
+    # 2x2x2 = 8 chips at 4 chips/host, one nodepool
+    for i in range(2):
+        node = tpu_node(f"slice-a-{i}")
+        node["metadata"]["labels"].update({
+            L.GKE_TPU_TOPOLOGY: "2x2x2",
+            L.GKE_NODEPOOL: "pool-slice-a"})
+        ops.create(node)
     assert tpuop_cfg.main(["status"]) == 1
     assert "no TPUClusterPolicy" in capsys.readouterr().out
 
@@ -197,8 +208,11 @@ def test_status_verb_tracks_lifecycle(cluster, capsys):
         assert tpuop_cfg.main(["status"]) == 0
         out = capsys.readouterr().out
         assert "TPUClusterPolicy/tpu-cluster-policy: ready" in out
-        assert "tpu-device-plugin-daemonset: 2/2 ready" in out
-        assert "generations {'v5p': 2}" in out
+        assert "tpu-device-plugin-daemonset: 4/4 ready" in out
+        assert "generations {'v5p': 4}" in out
+        # the multi-host slice is one readable row (status.slices[])
+        assert ("slice pool-slice-a [tpu-v5p-slice 2x2x2]: "
+                "2/2 hosts validated") in out
         assert out.strip().splitlines()[-1] == "READY"
     finally:
         mgr.stop()

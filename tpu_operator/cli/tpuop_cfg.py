@@ -74,9 +74,11 @@ def _generate_docs(args):
 
 def _status(args) -> int:
     """One-shot install health (kubectl-get rolled into the operator's
-    own vocabulary): CR states + ready conditions, per-operand DaemonSet
-    readiness, node upgrade-state histogram, cluster facts. Exit 0 only
-    when every CR reports ready — scriptable like `helm status`."""
+    own vocabulary): CR states + ready conditions, per-slice rows
+    (status.slices[]), per-operand DaemonSet readiness, node
+    upgrade-state histogram, cluster facts. Exit 0 only when every CR
+    reports ready, every listed multi-host slice is validated, and every
+    operand DaemonSet is ready — scriptable like `helm status`."""
     from ..api import V1, V1ALPHA1
     from ..api import labels as L
     from ..runtime.client import ListOptions, NotFoundError
@@ -117,6 +119,18 @@ def _status(args) -> int:
                           f", {info.get('containerRuntime')}, "
                           f"topologies {info.get('tpuTopologies')}, "
                           f"generations {info.get('tpuGenerations')}")
+                # one readable row per multi-host slice (status.slices[]):
+                # a v5p-64 slice is one line, not 16 node lines
+                for row in get_nested(cr, "status", "slices",
+                                      default=[]) or []:
+                    up = row.get("upgradeState")
+                    print(f"  slice {row.get('id')}"
+                          f" [{row.get('accelerator')}"
+                          f" {row.get('topology')}]: "
+                          f"{row.get('hostsValidated', 0)}/"
+                          f"{row.get('hosts', 0)} hosts validated"
+                          + (f", upgrade {up}" if up else ""))
+                    all_ready = all_ready and bool(row.get("validated"))
         if not any_cr:
             print("no TPUClusterPolicy/TPUDriver CRs found")
             return 1
@@ -298,9 +312,10 @@ def main(argv=None) -> int:
                        help="--wait budget; default matches the "
                             "reference e2e's 5-minute install budget")
     st = sub.add_parser(
-        "status", help="one-shot install health: CR states, per-operand "
-                       "readiness, node upgrade states, cluster facts; "
-                       "exit 1 unless everything is ready")
+        "status", help="one-shot install health: CR states, multi-host "
+                       "slice rows, per-operand readiness, node upgrade "
+                       "states, cluster facts; exit 1 unless every CR is "
+                       "ready, every slice validated, every operand ready")
     st.add_argument("-n", "--namespace", default="tpu-operator")
 
     u = sub.add_parser("uninstall",
