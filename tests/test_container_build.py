@@ -135,6 +135,40 @@ def test_entrypoints_in_dockerfile_are_declared_scripts():
         assert ep in scripts, f"ENTRYPOINT {ep!r} is not a console script"
 
 
+def test_console_scripts_resolve_and_cover_manifest_commands():
+    """Packaging-rot guard: every [project.scripts] target must import to
+    a callable (a broken entry point only surfaces at container runtime
+    otherwise), and every command a manifest launches (argv[0] of a
+    `command:` list, block or inline, quoted or not) must be a declared
+    console script. Dockerfile ENTRYPOINTs have their own test above."""
+    import importlib
+    import tomllib
+
+    scripts = tomllib.loads(
+        (REPO / "pyproject.toml").read_text())["project"]["scripts"]
+    for name, target in scripts.items():
+        mod, _, attr = target.partition(":")
+        assert attr, f"console script {name}: no ':' in {target!r}"
+        obj = importlib.import_module(mod)
+        for part in attr.split("."):
+            obj = getattr(obj, part, None)
+        assert callable(obj), (
+            f"console script {name} -> {target} does not resolve")
+
+    # argv[0] of every command: in the manifests — block list items
+    # (`command:\n  - "tpu-x"`) and inline arrays (`command: [ 'tpu-x'`)
+    argv0_re = re.compile(
+        r"command:\s*(?:\n\s*-\s*|\[\s*)[\"']?"
+        r"((?:tpu|libtpu|tpuop)-[a-z0-9-]+)")
+    argv0 = set()
+    for path in (REPO / "manifests").rglob("*.yaml"):
+        argv0.update(argv0_re.findall(path.read_text()))
+    assert argv0, "no manifest commands found — pattern rotted?"
+    missing = argv0 - set(scripts)
+    assert not missing, (
+        f"manifest commands without console scripts: {missing}")
+
+
 def test_buildx_multiarch_target_present():
     """multi-arch.mk slot: a buildx target with a multi-platform list
     must exist for every image (buildx-% pattern + PLATFORMS default)."""
