@@ -196,6 +196,14 @@ def test_status_verb_tracks_lifecycle(cluster, capsys):
         ops.create(node)
     assert tpuop_cfg.main(["status"]) == 1
     assert "no TPUClusterPolicy" in capsys.readouterr().out
+    # the json shape is stable even with no CRs: consumers script
+    # against nodes.tpu/upgradeStates in exactly the failure cases
+    import json as _json
+
+    assert tpuop_cfg.main(["status", "-o", "json"]) == 1
+    empty = _json.loads(capsys.readouterr().out)
+    assert empty["ready"] is False and empty["crs"] == []
+    assert empty["nodes"] == {"tpu": 0, "upgradeStates": {}}
 
     assert tpuop_cfg.main(["install"]) == 0
     capsys.readouterr()
@@ -214,6 +222,19 @@ def test_status_verb_tracks_lifecycle(cluster, capsys):
         assert ("slice pool-slice-a [tpu-v5p-slice 2x2x2]: "
                 "2/2 hosts validated") in out
         assert out.strip().splitlines()[-1] == "READY"
+        # -o json: the same picture, machine-readable, same exit code
+        import json
+
+        assert tpuop_cfg.main(["status", "-o", "json"]) == 0
+        jdoc = json.loads(capsys.readouterr().out)
+        assert jdoc["ready"] is True
+        assert any(cr["kind"] == "TPUClusterPolicy"
+                   and cr["state"] == "ready" for cr in jdoc["crs"])
+        [srow] = [s for cr in jdoc["crs"] for s in cr["slices"]]
+        assert srow["validated"] is True and srow["hosts"] == 2
+        assert any(op["name"] == "tpu-device-plugin-daemonset"
+                   and op["ready"] for op in jdoc["operands"])
+        assert jdoc["nodes"]["tpu"] == 4
     finally:
         mgr.stop()
         mgr_client._stop.set()

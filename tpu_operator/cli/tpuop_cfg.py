@@ -26,6 +26,7 @@ hook Job (templates/cleanup_crd.yaml).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 import yaml
@@ -72,19 +73,118 @@ def _generate_docs(args):
     return generate(args.what, namespace=namespace, image=args.image)
 
 
+def _status_report(client, namespace: str) -> dict:
+    """Gather the install-health picture into one plain dict — the single
+    source both status renderers (text and -o json) read, so they cannot
+    disagree about readiness."""
+    from ..api import V1, V1ALPHA1
+    from ..api import labels as L
+    from ..runtime.client import ListOptions, NotFoundError
+    from ..runtime.objects import get_nested, labels_of, name_of
+    from ..state.skel import daemonset_ready
+
+    # shape is stable across cluster states (nodes.tpu always an int,
+    # upgradeStates always a map) — the -o json contract consumers
+    # script against must not vary in exactly the failure cases
+    report: dict = {"crs": [], "operands": [],
+                    "nodes": {"tpu": 0, "upgradeStates": {}},
+                    "ready": True}
+    for av, kind in ((V1, KIND_CLUSTER_POLICY), (V1ALPHA1, KIND_TPU_DRIVER)):
+        try:
+            crs = client.list(av, kind)
+        except NotFoundError:
+            continue
+        for cr in crs:
+            state = get_nested(cr, "status", "state", default="unset")
+            report["ready"] = report["ready"] and state == "ready"
+            slices = get_nested(cr, "status", "slices", default=[]) or []
+            for row in slices:
+                report["ready"] = (report["ready"]
+                                   and bool(row.get("validated")))
+            report["crs"].append({
+                "kind": kind,
+                "name": name_of(cr),
+                "state": state,
+                "message": next(
+                    (c.get("message", "") for c in
+                     get_nested(cr, "status", "conditions",
+                                default=[]) or []
+                     if c.get("type") == "Ready"), ""),
+                "clusterInfo": get_nested(cr, "status", "clusterInfo",
+                                          default=None),
+                "slices": slices,
+            })
+    for node in client.list("v1", "Node"):
+        nl = labels_of(node)
+        if L.TPU_PRESENT in nl:
+            report["nodes"]["tpu"] += 1
+        s = nl.get(L.UPGRADE_STATE)
+        if s:
+            states = report["nodes"]["upgradeStates"]
+            states[s] = states.get(s, 0) + 1
+
+    if not report["crs"]:
+        report["ready"] = False
+        return report
+
+    dss = client.list("apps/v1", "DaemonSet", ListOptions(
+        namespace=namespace,
+        label_selector={"matchExpressions": [
+            {"key": L.STATE_LABEL, "operator": "Exists"}]}))
+    for ds in sorted(dss, key=name_of):
+        ok, why = daemonset_ready(ds)
+        status = ds.get("status") or {}
+        report["operands"].append({
+            "name": name_of(ds),
+            "ready": ok,
+            "numberReady": status.get("numberReady", 0),
+            "desired": status.get("desiredNumberScheduled", 0),
+            "reason": "" if ok else why,
+        })
+        report["ready"] = report["ready"] and ok
+    return report
+
+
+def _print_status_text(report: dict) -> None:
+    for cr in report["crs"]:
+        msg = cr["message"]
+        print(f"{cr['kind']}/{cr['name']}: {cr['state']}"
+              + (f" — {msg}" if msg else ""))
+        info = cr["clusterInfo"]
+        if info:
+            print(f"  cluster: k8s {info.get('kubernetesVersion')}"
+                  f", {info.get('containerRuntime')}, "
+                  f"topologies {info.get('tpuTopologies')}, "
+                  f"generations {info.get('tpuGenerations')}")
+        # one readable row per multi-host slice (status.slices[]): a
+        # v5p-64 slice is one line, not 16 node lines
+        for row in cr["slices"]:
+            up = row.get("upgradeState")
+            print(f"  slice {row.get('id')}"
+                  f" [{row.get('accelerator')} {row.get('topology')}]: "
+                  f"{row.get('hostsValidated', 0)}/"
+                  f"{row.get('hosts', 0)} hosts validated"
+                  + (f", upgrade {up}" if up else ""))
+    for op in report["operands"]:
+        print(f"  {op['name']}: {op['numberReady']}/{op['desired']} ready"
+              + ("" if op["ready"] else f" ({op['reason']})"))
+    nodes = report["nodes"]
+    upgrade = nodes.get("upgradeStates") or {}
+    print(f"nodes: {nodes.get('tpu', 0)} TPU"
+          + (f", upgrade states {upgrade}" if upgrade else ""))
+    print("READY" if report["ready"] else "NOT READY")
+
+
 def _status(args) -> int:
     """One-shot install health (kubectl-get rolled into the operator's
     own vocabulary): CR states + ready conditions, per-slice rows
     (status.slices[]), per-operand DaemonSet readiness, node
     upgrade-state histogram, cluster facts. Exit 0 only when every CR
     reports ready, every listed multi-host slice is validated, and every
-    operand DaemonSet is ready — scriptable like `helm status`."""
-    from ..api import V1, V1ALPHA1
-    from ..api import labels as L
-    from ..runtime.client import ListOptions, NotFoundError
+    operand DaemonSet is ready — scriptable like `helm status`, with
+    ``-o json`` emitting the same picture as one machine-readable
+    object."""
     from ..runtime.kubeclient import HTTPClient, KubeConfig
-    from ..runtime.objects import get_nested, labels_of, name_of
-    from ..state.skel import daemonset_ready
 
     try:
         client = HTTPClient(KubeConfig.load())
@@ -93,74 +193,15 @@ def _status(args) -> int:
         return 1
 
     try:
-        all_ready = True
-        any_cr = False
-        for av, kind in ((V1, KIND_CLUSTER_POLICY),
-                         (V1ALPHA1, KIND_TPU_DRIVER)):
-            try:
-                crs = client.list(av, kind)
-            except NotFoundError:
-                continue
-            for cr in crs:
-                any_cr = True
-                state = get_nested(cr, "status", "state",
-                                   default="unset")
-                all_ready = all_ready and state == "ready"
-                msg = next((c.get("message", "") for c in
-                            get_nested(cr, "status", "conditions",
-                                       default=[]) or []
-                            if c.get("type") == "Ready"), "")
-                print(f"{kind}/{name_of(cr)}: {state}"
-                      + (f" — {msg}" if msg else ""))
-                info = get_nested(cr, "status", "clusterInfo",
-                                  default=None)
-                if info:
-                    print(f"  cluster: k8s {info.get('kubernetesVersion')}"
-                          f", {info.get('containerRuntime')}, "
-                          f"topologies {info.get('tpuTopologies')}, "
-                          f"generations {info.get('tpuGenerations')}")
-                # one readable row per multi-host slice (status.slices[]):
-                # a v5p-64 slice is one line, not 16 node lines
-                for row in get_nested(cr, "status", "slices",
-                                      default=[]) or []:
-                    up = row.get("upgradeState")
-                    print(f"  slice {row.get('id')}"
-                          f" [{row.get('accelerator')}"
-                          f" {row.get('topology')}]: "
-                          f"{row.get('hostsValidated', 0)}/"
-                          f"{row.get('hosts', 0)} hosts validated"
-                          + (f", upgrade {up}" if up else ""))
-                    all_ready = all_ready and bool(row.get("validated"))
-        if not any_cr:
+        report = _status_report(client, args.namespace)
+        if getattr(args, "output", "text") == "json":
+            print(json.dumps(report, indent=2, sort_keys=True))
+            return 0 if report["ready"] else 1
+        if not report["crs"]:
             print("no TPUClusterPolicy/TPUDriver CRs found")
             return 1
-
-        dss = client.list("apps/v1", "DaemonSet", ListOptions(
-            namespace=args.namespace,
-            label_selector={"matchExpressions": [
-                {"key": L.STATE_LABEL, "operator": "Exists"}]}))
-        for ds in sorted(dss, key=name_of):
-            ok, why = daemonset_ready(ds)
-            status = ds.get("status") or {}
-            print(f"  {name_of(ds)}: "
-                  f"{status.get('numberReady', 0)}/"
-                  f"{status.get('desiredNumberScheduled', 0)} ready"
-                  + ("" if ok else f" ({why})"))
-            all_ready = all_ready and ok
-
-        upgrade: dict = {}
-        tpu_nodes = 0
-        for node in client.list("v1", "Node"):
-            nl = labels_of(node)
-            if L.TPU_PRESENT in nl:
-                tpu_nodes += 1
-            s = nl.get(L.UPGRADE_STATE)
-            if s:
-                upgrade[s] = upgrade.get(s, 0) + 1
-        print(f"nodes: {tpu_nodes} TPU"
-              + (f", upgrade states {upgrade}" if upgrade else ""))
-        print("READY" if all_ready else "NOT READY")
-        return 0 if all_ready else 1
+        _print_status_text(report)
+        return 0 if report["ready"] else 1
     except Exception as e:
         print(f"status failed: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
@@ -317,6 +358,10 @@ def main(argv=None) -> int:
                        "states, cluster facts; exit 1 unless every CR is "
                        "ready, every slice validated, every operand ready")
     st.add_argument("-n", "--namespace", default="tpu-operator")
+    st.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text",
+                    help="json: the same health picture as one "
+                         "machine-readable object (same exit code)")
 
     u = sub.add_parser("uninstall",
                        help="delete CRs (waiting for operand teardown), "
