@@ -159,3 +159,65 @@ def test_isolated_validator_pods_count(monkeypatch):
                                              "status": "True"}]}})
     [row] = slice_status(c, "tpu-operator")
     assert row["validated"] is True and row["hostsValidated"] == 2
+
+
+def test_slice_gauges_track_validation():
+    """The Prometheus face of status.slices[]: slices_total /
+    slices_validated move with the rows, so a slice losing a host's
+    validation is alertable without reading the CR."""
+    from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+    total = lambda: OPERATOR_METRICS.slices_total._value.get()  # noqa: E731
+    ok = lambda: OPERATOR_METRICS.slices_validated._value.get()  # noqa: E731
+
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    assert total() == 1 and ok() == 0  # pods exist, none ready yet
+
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+    assert total() == 1 and ok() == 1
+
+    set_validator_pod_ready(c, "slice-a-1", False)
+    rec.reconcile(req)
+    assert total() == 1 and ok() == 0
+
+
+def test_slice_gauges_reset_when_policy_deleted():
+    """Gauges follow the CR lifecycle: a deleted policy exports no
+    slices, so a firing TPUSliceNotValidated cannot outlive the
+    uninstall (and a frozen healthy snapshot cannot mask a later
+    failure)."""
+    from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    assert OPERATOR_METRICS.slices_total._value.get() == 1
+    c.delete(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    rec.reconcile(req)
+    assert OPERATOR_METRICS.slices_total._value.get() == 0
+    assert OPERATOR_METRICS.slices_validated._value.get() == 0
+
+
+def test_status_cap_does_not_blind_the_gauges(monkeypatch):
+    """MAX_ROWS bounds the CR's status size only; the gauges count every
+    slice, so an unvalidated slice sorting past the cap still trips
+    validated < total."""
+    from tpu_operator.controllers import slices as slices_mod
+    from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+    monkeypatch.setattr(slices_mod, "MAX_ROWS", 1)
+    c, rec = make_sliced_cluster()
+    # a second 2-host pool whose id sorts after the capped row
+    for i in range(2):
+        c.add_node(f"slice-z-{i}",
+                   labels=dict(SLICE_LABELS, **{L.GKE_NODEPOOL: "pool-z"}),
+                   allocatable={"google.com/tpu": "4"})
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    rows = cr_slices(c)
+    assert len(rows) == 1  # CR copy capped
+    assert OPERATOR_METRICS.slices_total._value.get() == 2
+    assert OPERATOR_METRICS.slices_validated._value.get() == 0

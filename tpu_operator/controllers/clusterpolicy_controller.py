@@ -105,6 +105,12 @@ class ClusterPolicyReconciler(Reconciler):
         if cr is None:
             self._first_seen.pop(request.name, None)
             self._ready_recorded.discard(request.name)
+            # a deleted policy exports no slices: stale non-zero gauges
+            # would keep TPUSliceNotValidated firing against an
+            # uninstalled operator (or a frozen healthy snapshot would
+            # mask a later failure)
+            OPERATOR_METRICS.slices_total.set(0)
+            OPERATOR_METRICS.slices_validated.set(0)
             return Result()
         if request.name not in self._first_seen:
             self._first_seen[request.name] = _time.monotonic()
@@ -155,6 +161,10 @@ class ClusterPolicyReconciler(Reconciler):
             self._set_state(cr, STATE_NOT_READY)
             OPERATOR_METRICS.reconcile_status.set(0)
             OPERATOR_METRICS.policy_state.labels(policy=request.name).set(1)
+            # no TPU nodes -> no slices; freezing prior values would
+            # mask a later real failure behind a healthy snapshot
+            OPERATOR_METRICS.slices_total.set(0)
+            OPERATOR_METRICS.slices_validated.set(0)
             conditions.set_not_ready(
                 self.client, cr, "NoTPUNodes",
                 "no nodes with cloud.google.com/gke-tpu-accelerator labels "
@@ -174,12 +184,17 @@ class ClusterPolicyReconciler(Reconciler):
         # section 7): one row per v5p-style slice, validated only when
         # every host's validator pod is Ready. One node LIST serves this,
         # the pool gauge, and the chip totals below.
-        from .slices import slice_status
+        from .slices import MAX_ROWS, slice_status
 
         nodes = self.client.list("v1", "Node")
-        set_nested(cr, slice_status(self.client, self.namespace,
-                                    nodes=nodes),
-                   "status", "slices")
+        slices = slice_status(self.client, self.namespace, nodes=nodes)
+        # the status-size cap applies only to the CR copy; the gauges
+        # count every slice so the not-validated alert cannot be blinded
+        # by truncation
+        set_nested(cr, slices[:MAX_ROWS], "status", "slices")
+        OPERATOR_METRICS.slices_total.set(len(slices))
+        OPERATOR_METRICS.slices_validated.set(
+            sum(1 for s in slices if s["validated"]))
 
         not_ready = {n: r for n, r in results.items() if not r.ready}
         errors = {n: r for n, r in results.items()

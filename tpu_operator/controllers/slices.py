@@ -76,7 +76,11 @@ def slice_status(client: Client, namespace: str,
                  nodes: Optional[List[dict]] = None) -> List[dict]:
     """Rows for ``status.slices[]``; empty when no multi-host pool
     exists. Pass ``nodes`` when the caller already holds the node list —
-    the reconcile loop must not re-list the cluster for each consumer."""
+    the reconcile loop must not re-list the cluster for each consumer.
+    Returns the FULL sorted row list; the CR writer applies the
+    MAX_ROWS status-size cap, so gauge/alert consumers still see every
+    slice (a truncated count would hide an unvalidated slice whose id
+    sorts past the cap)."""
     if nodes is None:
         nodes = client.list("v1", "Node")
     by_name = {name_of(n): n for n in nodes}
@@ -100,4 +104,4 @@ def slice_status(client: Client, namespace: str,
                      for m in members]),
             })
     rows.sort(key=lambda r: r["id"])
-    return rows[:MAX_ROWS]
+    return rows

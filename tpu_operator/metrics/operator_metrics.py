@@ -79,6 +79,14 @@ class OperatorMetrics:
             "tpu_operator_install_to_ready_seconds",
             "Wall time from first observation of a TPUClusterPolicy to "
             "its first all-operands-ready", labelnames=("policy",))
+        # slice-level face of status.slices[]: alert when a multi-host
+        # slice loses a host's validation without digging through the CR
+        self.slices_total = g(
+            "tpu_operator_slices_total",
+            "Multi-host TPU slices discovered (status.slices[] rows)")
+        self.slices_validated = g(
+            "tpu_operator_slices_validated",
+            "Multi-host slices whose every host passed validation")
 
 
 OPERATOR_METRICS = OperatorMetrics()
