@@ -374,11 +374,12 @@ def _controlplane_doc() -> dict | None:
         n = int(os.environ.get("TPUOP_BENCH_SCALE_NODES", "500"))
         from tpu_operator.benchmarks.controlplane import (
             INSTALL_BUDGET_S,
+            run_rollout_bench,
             run_scale_bench,
         )
 
         r = run_scale_bench(n)
-        return {
+        doc = {
             "n_tpu_nodes": r["n_tpu_nodes"],
             "n_states": r["n_states"],
             "ready": r["ready"],
@@ -389,6 +390,20 @@ def _controlplane_doc() -> dict | None:
                 INSTALL_BUDGET_S / max(r["install_to_ready_s"], 1e-9), 2)
             if r["ready"] else 0.0,
         }
+        # fleet driver-rollout throughput (tests/test_scale.py asserts
+        # the budgets; this puts the measured figure on the record).
+        # Its own try: a rollout failure must not discard the scale
+        # figures already in doc.
+        try:
+            ro = run_rollout_bench(100, max_parallel=8)
+            doc["rollout_100_nodes"] = {
+                "passes": ro["passes"],
+                "wall_s": round(ro["wall_s"], 2),
+                "rolled": ro["rolled"],
+            }
+        except Exception as e:
+            doc["rollout_100_nodes"] = {"error": f"{type(e).__name__}: {e}"}
+        return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}
 

@@ -106,3 +106,21 @@ def test_scale_env_override(r500):
     assert abs(r["steady_requests"] - r500["steady_requests"]) \
         <= NODE_INDEPENDENCE_SLACK, (r["steady_verbs"],
                                      r500["steady_verbs"])
+
+
+def test_fleet_rollout_at_scale():
+    """Driver rollout throughput at 100 nodes: bump the libtpu spec and
+    drive the upgrade FSM (maxParallelUpgrades=8) until every TPU node
+    is done and every driver pod runs the new revision
+    (benchmarks.controlplane.run_rollout_bench — the same datapoint
+    bench.py puts on the official record). Budgets pin two properties:
+    the FSM finishes in O(units/parallel) reconcile passes (no per-pass
+    stalls), and the whole rollout stays inside a wall budget that an
+    O(nodes^2) regression would blow."""
+    from tpu_operator.benchmarks.controlplane import run_rollout_bench
+
+    # 100 TPU nodes at 8 parallel units: <=13 waves of single-host units
+    # (multi-host slices count once, so fewer), ~2 passes per wave.
+    r = run_rollout_bench(100, max_parallel=8, pass_budget=50)
+    assert r["rolled"], r
+    assert r["wall_s"] < 90.0 * load_factor(), r

@@ -114,3 +114,79 @@ def run_scale_bench(n_tpu: int = 500,
         "steady_requests": sum(verbs.values()),
         "steady_verbs": verbs,
     }
+
+
+def run_rollout_bench(n_tpu: int = 100, max_parallel: int = 8,
+                      pass_budget: int = 50) -> Dict:
+    """Fleet driver-rollout throughput: bump the libtpu spec on a
+    converged n_tpu-node cluster and drive the upgrade FSM
+    (maxParallelUpgrades=max_parallel) until every TPU node is done and
+    every driver pod runs the new template revision.
+
+    Returns {n_tpu_nodes, max_parallel, passes, wall_s, rolled} —
+    the scale datapoint the reference has no analog for (its upgrade
+    loop is driven by requeues against a live cluster and is never
+    measured). ``rolled`` False means the pass budget ran out first."""
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.upgrade_controller import (
+        STATE_DONE,
+        UpgradeReconciler,
+        desired_revision,
+    )
+    from ..runtime import ListOptions
+    from ..runtime.objects import get_nested, labels_of
+
+    c = build_cluster(n_tpu)
+    c.create(new_cluster_policy(spec={
+        "upgradePolicy": {"autoUpgrade": True,
+                          "maxParallelUpgrades": max_parallel}}))
+    prec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    urec = UpgradeReconciler(client=c, namespace="tpu-operator")
+    req = Request(name="tpu-cluster-policy")
+    prec.reconcile(req)
+    c.simulate_kubelet(ready=True)
+    prec.reconcile(req)
+
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    cr["spec"]["libtpu"] = {"installDir": "/opt/rollout-marker"}
+    c.update(cr)
+    prec.reconcile(req)
+    c.simulate_kubelet(ready=True)
+
+    def fleet_done() -> bool:
+        tpu_nodes = [n for n in c.list("v1", "Node")
+                     if labels_of(n).get(L.GKE_TPU_ACCELERATOR)]
+        if any(labels_of(n).get(L.UPGRADE_STATE) != STATE_DONE
+               for n in tpu_nodes):
+            return False
+        [ds] = [d for d in c.list(
+            "apps/v1", "DaemonSet", ListOptions(namespace="tpu-operator"))
+            if d["metadata"]["name"] == "tpu-libtpu-driver-daemonset"]
+        # the controller's own canonical revision definition, so the
+        # bench can never disagree with what the FSM rolled to
+        want = desired_revision(c, ds)
+        pods = c.list("v1", "Pod", ListOptions(
+            namespace="tpu-operator",
+            label_selector={"tpu.graft.dev/component": "libtpu-driver"}))
+        return (len(pods) == len(tpu_nodes)
+                and all(get_nested(p, "metadata", "labels",
+                                   "controller-revision-hash") == want
+                        for p in pods))
+
+    t0 = time.perf_counter()
+    passes = 0
+    rolled = False
+    while passes < pass_budget:
+        passes += 1
+        urec.reconcile(req)
+        c.simulate_kubelet(ready=True)
+        if fleet_done():
+            rolled = True
+            break
+    return {
+        "n_tpu_nodes": n_tpu,
+        "max_parallel": max_parallel,
+        "passes": passes,
+        "wall_s": time.perf_counter() - t0,
+        "rolled": rolled,
+    }
