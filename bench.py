@@ -393,16 +393,20 @@ def _controlplane_doc() -> dict | None:
         # fleet driver-rollout throughput (tests/test_scale.py asserts
         # the budgets; this puts the measured figure on the record).
         # Its own try: a rollout failure must not discard the scale
-        # figures already in doc.
+        # figures already in doc. Honors the same node-count knob the
+        # scale rider does (capped at 100 — the rollout is O(nodes) per
+        # pass and the datapoint doesn't need more).
         try:
-            ro = run_rollout_bench(100, max_parallel=8)
-            doc["rollout_100_nodes"] = {
+            ro_n = min(100, n)
+            ro = run_rollout_bench(ro_n, max_parallel=8)
+            doc["rollout"] = {
+                "n_tpu_nodes": ro_n,
                 "passes": ro["passes"],
                 "wall_s": round(ro["wall_s"], 2),
                 "rolled": ro["rolled"],
             }
         except Exception as e:
-            doc["rollout_100_nodes"] = {"error": f"{type(e).__name__}: {e}"}
+            doc["rollout"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

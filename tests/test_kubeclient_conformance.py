@@ -233,6 +233,36 @@ class TestWatchWire:
         finally:
             cancel()
 
+    def test_multibyte_utf8_split_across_chunks(self, wire):
+        """Chunk boundaries fall on byte offsets, not character
+        boundaries: a multibyte UTF-8 character (here U+2713 in an
+        annotation) cut mid-sequence across two chunks must reassemble
+        — a client decoding each chunk independently would raise
+        UnicodeDecodeError or corrupt the object."""
+        srv, client = wire
+        p = pod("a", "101")
+        p["metadata"]["annotations"] = {"note": "tpü✓"}
+        # Go's encoding/json does NOT escape non-ASCII: the wire carries
+        # raw UTF-8 bytes (ensure_ascii=False mirrors the apiserver)
+        e1 = json.dumps({"type": "ADDED", "object": p},
+                        ensure_ascii=False).encode() + b"\n"
+        cut = e1.index("✓".encode()) + 1  # inside the 3-byte char
+        body = chunk(e1[:cut]) + chunk(e1[cut:]) + END_CHUNKS
+        srv.script("GET", "plain", Exchange(pod_list("100")))
+        srv.script("GET", "watch",
+                   Exchange(CHUNKED_HEAD + body, split=3,
+                            frame_delay_s=0.001),
+                   Exchange(CHUNKED_HEAD, hold=True))
+        events, cancel = self.collect(client)
+        try:
+            srv.wait_requests(lambda r: len(
+                [x for x in r if x[2].get("watch") == "true"]) >= 2)
+            assert [(e.type,
+                     e.obj["metadata"]["annotations"]["note"])
+                    for e in events] == [("ADDED", "tpü✓")]
+        finally:
+            cancel()
+
     def test_bookmark_advances_resume_rv_without_relist(self, wire):
         """Bookmark cadence: the server recycles the stream right after
         a BOOKMARK; the client must resume from the bookmark's rv (not
