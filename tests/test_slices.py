@@ -221,3 +221,62 @@ def test_status_cap_does_not_blind_the_gauges(monkeypatch):
     assert len(rows) == 1  # CR copy capped
     assert OPERATOR_METRICS.slices_total._value.get() == 2
     assert OPERATOR_METRICS.slices_validated._value.get() == 0
+
+
+def test_slice_validation_transitions_emit_events():
+    """kubectl-describe history for the alert: losing a host's
+    validation emits one Warning (transition-only — steady degraded
+    passes add nothing new), recovery emits a Normal."""
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)          # operands land
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)          # validator pods ready -> validated
+    assert cr_slices(c)[0]["validated"] is True
+
+    def events(reason):
+        return [e for e in c.list("v1", "Event", ListOptions(
+            namespace="tpu-operator"))
+            if e.get("reason") == reason]
+
+    set_validator_pod_ready(c, "slice-a-1", False)
+    rec.reconcile(req)
+    [ev] = events("SliceNotValidated")
+    assert ev["type"] == "Warning"
+    assert "pool-slice-a" in ev["message"] and "1/2" in ev["message"]
+
+    # steady degraded state: no new event, the existing one dedups
+    rec.reconcile(req)
+    [ev] = events("SliceNotValidated")
+
+    set_validator_pod_ready(c, "slice-a-1", True)
+    rec.reconcile(req)
+    [rev] = events("SliceValidated")
+    assert rev["type"] == "Normal" and "2/2" in rev["message"]
+
+
+def test_truncated_slice_still_emits_transition_event(monkeypatch):
+    """The MAX_ROWS cap bounds the CR copy only: a slice sorting past
+    the cap still gets its SliceNotValidated Event (the reconciler
+    diffs the full row list it keeps in memory, not the capped
+    status)."""
+    from tpu_operator.controllers import slices as slices_mod
+
+    monkeypatch.setattr(slices_mod, "MAX_ROWS", 1)
+    c, rec = make_sliced_cluster()
+    for i in range(2):
+        c.add_node(f"slice-z-{i}",
+                   labels=dict(SLICE_LABELS, **{L.GKE_NODEPOOL: "pool-z"}),
+                   allocatable={"google.com/tpu": "4"})
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+    assert [r["id"] for r in cr_slices(c)] == ["pool-slice-a"]  # capped
+
+    set_validator_pod_ready(c, "slice-z-1", False)
+    rec.reconcile(req)
+    [ev] = [e for e in c.list("v1", "Event", ListOptions(
+        namespace="tpu-operator"))
+        if e.get("reason") == "SliceNotValidated"]
+    assert "pool-z" in ev["message"]

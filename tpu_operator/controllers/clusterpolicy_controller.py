@@ -66,6 +66,10 @@ class ClusterPolicyReconciler(Reconciler):
         # skew on creationTimestamp.
         self._first_seen: dict = {}
         self._ready_recorded: set = set()
+        # full (untruncated) slice rows from the previous pass, for
+        # transition-only Events: the CR's status copy is MAX_ROWS-capped,
+        # so diffing against it would blind events for slices past the cap
+        self._prev_slices: dict = {}
 
     # -- wiring (SetupWithManager analog, clusterpolicy_controller.go:355) --
 
@@ -105,6 +109,7 @@ class ClusterPolicyReconciler(Reconciler):
         if cr is None:
             self._first_seen.pop(request.name, None)
             self._ready_recorded.discard(request.name)
+            self._prev_slices.pop(request.name, None)
             # a deleted policy exports no slices: stale non-zero gauges
             # would keep TPUSliceNotValidated firing against an
             # uninstalled operator (or a frozen healthy snapshot would
@@ -187,10 +192,33 @@ class ClusterPolicyReconciler(Reconciler):
         from .slices import MAX_ROWS, slice_status
 
         nodes = self.client.list("v1", "Node")
+        # previous FULL rows from this process; after a restart fall back
+        # to the CR's persisted (capped) copy — slices past the cap then
+        # miss at most one transition, not all of them
+        prev_rows = self._prev_slices.get(request.name)
+        if prev_rows is None:
+            prev_rows = {r.get("id"): r for r in
+                         get_nested(cr, "status", "slices",
+                                    default=[]) or []}
         slices = slice_status(self.client, self.namespace, nodes=nodes)
+        # transition-only Events pair with the TPUSliceNotValidated
+        # alert: kubectl describe shows WHEN a slice lost (or regained)
+        # a host's validation, not just that it is currently degraded
+        for row in slices:
+            prev = prev_rows.get(row["id"])
+            if prev is not None and \
+                    bool(prev.get("validated")) != row["validated"]:
+                self.recorder.event(
+                    cr,
+                    "Normal" if row["validated"] else "Warning",
+                    "SliceValidated" if row["validated"]
+                    else "SliceNotValidated",
+                    f"slice {row['id']}: {row['hostsValidated']}/"
+                    f"{row['hosts']} hosts validated")
+        self._prev_slices[request.name] = {r["id"]: r for r in slices}
         # the status-size cap applies only to the CR copy; the gauges
-        # count every slice so the not-validated alert cannot be blinded
-        # by truncation
+        # and transition Events consume every slice so truncation cannot
+        # blind the not-validated alert or its history
         set_nested(cr, slices[:MAX_ROWS], "status", "slices")
         OPERATOR_METRICS.slices_total.set(len(slices))
         OPERATOR_METRICS.slices_validated.set(
