@@ -192,22 +192,21 @@ class ClusterPolicyReconciler(Reconciler):
         from .slices import MAX_ROWS, slice_status
 
         nodes = self.client.list("v1", "Node")
-        # previous FULL rows from this process; after a restart fall back
-        # to the CR's persisted (capped) copy — slices past the cap then
-        # miss at most one transition, not all of them
-        prev_rows = self._prev_slices.get(request.name)
-        if prev_rows is None:
-            prev_rows = {r.get("id"): r for r in
-                         get_nested(cr, "status", "slices",
-                                    default=[]) or []}
+        # previous FULL {id: validated} map from this process; after a
+        # restart fall back to the CR's persisted (capped) copy — slices
+        # past the cap then miss at most one transition, not all of them
+        prev_ok = self._prev_slices.get(request.name)
+        if prev_ok is None:
+            prev_ok = {r.get("id"): bool(r.get("validated")) for r in
+                       get_nested(cr, "status", "slices",
+                                  default=[]) or []}
         slices = slice_status(self.client, self.namespace, nodes=nodes)
         # transition-only Events pair with the TPUSliceNotValidated
         # alert: kubectl describe shows WHEN a slice lost (or regained)
         # a host's validation, not just that it is currently degraded
         for row in slices:
-            prev = prev_rows.get(row["id"])
-            if prev is not None and \
-                    bool(prev.get("validated")) != row["validated"]:
+            prev = prev_ok.get(row["id"])
+            if prev is not None and prev != row["validated"]:
                 self.recorder.event(
                     cr,
                     "Normal" if row["validated"] else "Warning",
@@ -215,7 +214,8 @@ class ClusterPolicyReconciler(Reconciler):
                     else "SliceNotValidated",
                     f"slice {row['id']}: {row['hostsValidated']}/"
                     f"{row['hosts']} hosts validated")
-        self._prev_slices[request.name] = {r["id"]: r for r in slices}
+        self._prev_slices[request.name] = {
+            r["id"]: r["validated"] for r in slices}
         # the status-size cap applies only to the CR copy; the gauges
         # and transition Events consume every slice so truncation cannot
         # blind the not-validated alert or its history

@@ -43,6 +43,10 @@ class FakeClient(Client):
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
+        # uids of live objects, maintained on create/delete so the
+        # orphaned-ownerRef check in create() is O(#refs), not a scan of
+        # the whole store (which made bulk creates O(n^2) at scale)
+        self._live_uids: set = set()
         self._rv = 0
         self.hub = WatchHub()
         # apiserver request accounting for the scale tier: every verb a
@@ -132,10 +136,9 @@ class FakeClient(Client):
             # the real apiserver accepts this and the GC controller collects
             # it shortly after; the fake compresses that to "immediately",
             # which closes the CR-deleted-mid-reconcile race deterministically
-            live_uids = {get_nested(o, "metadata", "uid")
-                         for o in self._store.values()}
+            self._live_uids.add(meta["uid"])
             orphaned = any(
-                r.get("uid") and r.get("uid") not in live_uids
+                r.get("uid") and r.get("uid") not in self._live_uids
                 for r in meta.get("ownerReferences") or [])
         self._publish("ADDED", obj)
         if orphaned:
@@ -204,6 +207,11 @@ class FakeClient(Client):
             if cur is None:
                 raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
             merged = merge_patch(deepcopy_obj(cur), patch)
+            # uid is immutable on a real apiserver; forcing it from the
+            # stored object (like update() does) also keeps _live_uids
+            # in sync with the store
+            merged.setdefault("metadata", {})["uid"] = get_nested(
+                cur, "metadata", "uid")
             if merged == cur:
                 return deepcopy_obj(cur)  # no-op patch
             merged["metadata"]["resourceVersion"] = self._next_rv()
@@ -219,6 +227,9 @@ class FakeClient(Client):
         key = self._key(api_version, kind, name, namespace)
         with self._lock:
             obj = self._store.pop(key, None)
+            if obj is not None:
+                self._live_uids.discard(
+                    get_nested(obj, "metadata", "uid"))
         if obj is None:
             raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
         self._publish("DELETED", obj)

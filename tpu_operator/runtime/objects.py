@@ -10,6 +10,7 @@ label-selector matching, and owner references.
 from __future__ import annotations
 
 import copy
+from collections.abc import Mapping as _ABCMapping
 from dataclasses import dataclass
 from typing import Any, Iterable, Mapping
 
@@ -19,10 +20,19 @@ def deepcopy_obj(obj: dict) -> dict:
 
 
 def get_nested(obj: Mapping, *path: str, default: Any = None) -> Any:
-    """Walk ``path`` through nested mappings, returning ``default`` on miss."""
+    """Walk ``path`` through nested mappings, returning ``default`` on miss.
+
+    Hot path for the whole framework (tens of millions of calls in the
+    scale tier): plain dicts take a ``type() is dict`` fast path;
+    anything else falls back to the abc Mapping check (NOT
+    ``typing.Mapping``, whose ``__instancecheck__`` costs ~2µs/call and
+    dominated the 500-node install profile)."""
     cur: Any = obj
     for key in path:
-        if not isinstance(cur, Mapping) or key not in cur:
+        if type(cur) is dict:
+            if key not in cur:
+                return default
+        elif not isinstance(cur, _ABCMapping) or key not in cur:
             return default
         cur = cur[key]
     return cur
