@@ -584,3 +584,41 @@ class TestDiff:
         [drift] = [r for r in diff_bundle(c, self._docs())
                    if r["verdict"] == "drift"]
         assert "&id" not in drift["diff"] and "*id" not in drift["diff"]
+
+
+class TestStatusJsonFailure:
+    """`status -o json` promises one machine-readable object on stdout
+    for EVERY outcome: a script piping to jq must get {"ready": false,
+    "error": ...} and rc 1 when the cluster is unreachable, not an
+    empty document."""
+
+    def test_unreachable_cluster_emits_json_error(self, monkeypatch,
+                                                  capsys):
+        import json
+
+        from tpu_operator.runtime import kubeclient as kc
+
+        def boom():
+            raise RuntimeError("no kubeconfig anywhere")
+
+        monkeypatch.setattr(kc.KubeConfig, "load", staticmethod(boom))
+        rc = main(["status", "-o", "json"])
+        out = capsys.readouterr()
+        assert rc == 1
+        doc = json.loads(out.out)
+        assert doc["ready"] is False
+        assert "no kubeconfig anywhere" in doc["error"]
+
+    def test_unreachable_cluster_text_mode_keeps_stdout_clean(
+            self, monkeypatch, capsys):
+        from tpu_operator.runtime import kubeclient as kc
+
+        def boom():
+            raise RuntimeError("no kubeconfig anywhere")
+
+        monkeypatch.setattr(kc.KubeConfig, "load", staticmethod(boom))
+        rc = main(["status"])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out == ""  # diagnostics belong to stderr in text mode
+        assert "cannot reach the cluster" in out.err

@@ -1,0 +1,114 @@
+"""Chaos plane: deterministic fault injection + invariant checking.
+
+Three claims under test:
+
+1. Determinism — the verdict is a pure function of (scenario, nodes,
+   seed, steps): same seed, byte-identical JSON; different seed,
+   different schedule.
+2. Resilience — every named scenario converges to all-Ready with zero
+   invariant violations on a 100-node mock cluster.
+3. Sensitivity — a deliberately broken controller (its status write
+   monkeypatched away) is CAUGHT: the checker records a violation and
+   the verdict goes red. A chaos harness that can't fail is theater.
+"""
+
+import json
+
+import pytest
+
+from tpu_operator.chaos.faults import FaultPlan
+from tpu_operator.chaos.runner import SCENARIOS, run_scenario
+
+
+class TestDeterminism:
+    def test_same_seed_same_schedule(self):
+        nodes = [f"tpu-{i}" for i in range(8)]
+        a = FaultPlan.build("conflict-storm", 11, nodes, 12)
+        b = FaultPlan.build("conflict-storm", 11, nodes, 12)
+        assert a.schedule_json() == b.schedule_json()
+
+    def test_different_seed_different_schedule(self):
+        nodes = [f"tpu-{i}" for i in range(8)]
+        a = FaultPlan.build("node-churn", 1, nodes, 12)
+        b = FaultPlan.build("node-churn", 2, nodes, 12)
+        assert a.schedule_json() != b.schedule_json()
+
+    def test_same_seed_byte_identical_verdict(self):
+        """The acceptance bar: two full runs emit byte-identical JSON —
+        a red verdict is its own reproducer."""
+        runs = [run_scenario("conflict-storm", nodes=32, seed=7)
+                for _ in range(2)]
+        payloads = [json.dumps(v, indent=2, sort_keys=True) for v in runs]
+        assert payloads[0] == payloads[1]
+        assert runs[0]["ok"] is True
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos scenario"):
+            run_scenario("split-brain", nodes=4, seed=0)
+
+
+class TestScenariosConverge:
+    @pytest.mark.parametrize("scenario", SCENARIOS)
+    def test_scenario_converges_at_100_nodes(self, scenario):
+        v = run_scenario(scenario, nodes=100, seed=7)
+        assert v["violations"] == [], \
+            f"{scenario}: invariant violations {v['violations']}"
+        assert v["converged"] is True
+        assert v["ok"] is True
+        # the scenario actually did something: faults were injected
+        assert sum(v["faults_injected"].values()) > 0
+        # and the counters exported them
+        from tpu_operator.metrics.registry import REGISTRY
+
+        for kind, count in v["faults_injected"].items():
+            assert REGISTRY.get_sample_value(
+                "tpu_operator_chaos_faults_injected_total",
+                {"kind": kind}) >= count
+
+    def test_upgrade_under_fire_rolls_the_fleet(self):
+        """The rollout marker fault really drives the upgrade FSM: the
+        scenario only converges once every driver pod runs the new
+        template revision, so trigger-rollout must appear injected."""
+        v = run_scenario("upgrade-under-fire", nodes=50, seed=3)
+        assert v["ok"] is True
+        assert v["faults_injected"].get("trigger-rollout") == 1
+
+
+class TestBrokenControllerIsCaught:
+    def test_dropped_status_write_goes_red(self, monkeypatch):
+        """A controller that silently drops its status update (the exact
+        bug class the rv/convergence invariants exist for) must produce
+        a red verdict, not a green one."""
+        from tpu_operator.controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+        )
+
+        monkeypatch.setattr(ClusterPolicyReconciler, "_set_state",
+                            lambda self, cr, state: None)
+        v = run_scenario("conflict-storm", nodes=8, seed=0, steps=2)
+        assert v["ok"] is False
+        assert any(viol["invariant"] == "convergence"
+                   for viol in v["violations"])
+
+    def test_lost_update_is_a_violation_not_a_crash(self, monkeypatch):
+        """A reconciler error mid-run (here: every update_status raising)
+        degrades to a red verdict with the failure named — the harness
+        itself must survive the controllers it's torturing."""
+        from tpu_operator.controllers import clusterpolicy_controller as cpc
+        from tpu_operator.runtime.client import ServerUnavailableError
+
+        orig = cpc.ClusterPolicyReconciler._reconcile
+
+        def flaky(self, request):
+            flaky.calls += 1
+            if flaky.calls % 2 == 0:
+                raise ServerUnavailableError("chaos-test: injected")
+            return orig(self, request)
+
+        flaky.calls = 0
+        monkeypatch.setattr(cpc.ClusterPolicyReconciler, "_reconcile",
+                            flaky)
+        v = run_scenario("watch-flap", nodes=8, seed=1, steps=2)
+        # every other reconcile dying is survivable: retries land the rest
+        assert isinstance(v["ok"], bool)
+        assert "violations" in v

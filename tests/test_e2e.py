@@ -112,9 +112,14 @@ class TestEndToEnd:
         # -- verify-operand-restarts: steady state must not churn -------
         rvs = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
                for d in c.list("apps/v1", "DaemonSet")}
-        time.sleep(0.5)  # several reconcile cycles
+        # drain the work queues instead of napping a fixed 0.5s: idle
+        # means every queued reconcile (and its near-term requeues)
+        # actually ran, so the no-churn assertion below checks real
+        # cycles, not luck. horizon=1 skips the 120s periodic resync
+        # the steady-state upgrade controller always keeps parked.
+        assert mgr.wait_idle(timeout=30 * load_factor(), horizon=1.0)
         c.simulate_kubelet(ready=True)
-        time.sleep(0.5)
+        assert mgr.wait_idle(timeout=30 * load_factor(), horizon=1.0)
         rvs2 = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
                 for d in c.list("apps/v1", "DaemonSet")}
         assert rvs == rvs2, "DaemonSets churned with no spec change"
@@ -152,8 +157,17 @@ class TestEndToEnd:
 
         # -- restart-operator: fresh manager converges with no churn ----
         mgr.stop()
-        rvs = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
-               for d in c.list("apps/v1", "DaemonSet")}
+        # tick the fake kubelet to a status fixpoint first: its DS status
+        # (updatedNumberScheduled) can lag the FSM's last pod restarts,
+        # and a post-restart catch-up write would read as operator churn
+        while True:
+            before = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
+                      for d in c.list("apps/v1", "DaemonSet")}
+            c.simulate_kubelet(ready=True)
+            rvs = {d["metadata"]["name"]: d["metadata"]["resourceVersion"]
+                   for d in c.list("apps/v1", "DaemonSet")}
+            if rvs == before:
+                break
         mgr2 = make_manager(c)
         try:
             wait_ready(c, mgr2)

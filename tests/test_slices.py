@@ -201,6 +201,33 @@ def test_slice_gauges_reset_when_policy_deleted():
     assert OPERATOR_METRICS.slices_validated._value.get() == 0
 
 
+def test_duplicate_policy_deletion_keeps_active_gauges():
+    """Deleting an *ignored* duplicate CR must not zero the slice gauges
+    the active CR exports: only the CR that last wrote them resets them
+    on deletion."""
+    from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+    c, rec = make_sliced_cluster()
+    c.create(new_cluster_policy(name="zz-duplicate"))
+    rec.reconcile(Request(name="tpu-cluster-policy"))  # creates the pods
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(Request(name="tpu-cluster-policy"))
+    rec.reconcile(Request(name="zz-duplicate"))  # -> ignored
+    assert OPERATOR_METRICS.slices_total._value.get() == 1
+    assert OPERATOR_METRICS.slices_validated._value.get() == 1
+
+    c.delete(V1, KIND_CLUSTER_POLICY, "zz-duplicate")
+    rec.reconcile(Request(name="zz-duplicate"))
+    assert OPERATOR_METRICS.slices_total._value.get() == 1
+    assert OPERATOR_METRICS.slices_validated._value.get() == 1
+
+    # the active CR's deletion still resets them
+    c.delete(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    rec.reconcile(Request(name="tpu-cluster-policy"))
+    assert OPERATOR_METRICS.slices_total._value.get() == 0
+    assert OPERATOR_METRICS.slices_validated._value.get() == 0
+
+
 def test_status_cap_does_not_blind_the_gauges(monkeypatch):
     """MAX_ROWS bounds the CR's status size only; the gauges count every
     slice, so an unvalidated slice sorting past the cap still trips

@@ -579,3 +579,71 @@ class TestDCNBandwidthProbe:
         assert info["DCN_SLICES"] == "2"
         assert float(info["DCN_BUS_GBPS"]) > 0
         assert barrier.is_ready("dcn-ready")
+
+    def _probe_with(self, monkeypatch, bus_bw_gbps):
+        """Wire a live coordinator socket + a stubbed psum probe, run
+        validate_dcn, and return (call, cleanup)."""
+        import socket
+        import threading
+        from types import SimpleNamespace
+
+        from tpu_operator.parallel import multihost
+
+        monkeypatch.setattr(
+            multihost, "dcn_allreduce_probe",
+            lambda **kw: SimpleNamespace(correct=True, slices=2,
+                                         bus_bw_gbps=bus_bw_gbps,
+                                         algo_bw_gbps=bus_bw_gbps))
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        threading.Thread(target=lambda: srv.accept(), daemon=True).start()
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                           f"127.0.0.1:{port}")
+        monkeypatch.setenv("DCN_BANDWIDTH_PROBE", "true")
+        return srv
+
+    def test_dcn_threshold_fails_slow_fabric(self, valdir, monkeypatch):
+        """DCN_THRESHOLD (absolute Gbps — ICI_THRESHOLD's DCN mirror):
+        a measured bus bandwidth below it fails the proof."""
+        import pytest
+
+        from tpu_operator.validator.components import (
+            ValidationFailed,
+            validate_dcn,
+        )
+
+        monkeypatch.setenv("DCN_THRESHOLD", "10")
+        srv = self._probe_with(monkeypatch, bus_bw_gbps=3.5)
+        try:
+            with pytest.raises(ValidationFailed, match="DCN_THRESHOLD"):
+                validate_dcn(timeout=5)
+        finally:
+            srv.close()
+
+    def test_dcn_threshold_passes_fast_fabric(self, valdir, monkeypatch):
+        from tpu_operator.validator.components import validate_dcn
+
+        monkeypatch.setenv("DCN_THRESHOLD", "10")
+        srv = self._probe_with(monkeypatch, bus_bw_gbps=25.0)
+        try:
+            info = validate_dcn(timeout=5)
+        finally:
+            srv.close()
+        assert float(info["DCN_BUS_GBPS"]) == 25.0
+        assert barrier.is_ready("dcn-ready")
+
+    def test_no_threshold_means_reachability_only(self, valdir, monkeypatch):
+        """Default off: without DCN_THRESHOLD any measured figure passes
+        — reachability plus correct data is the base contract."""
+        from tpu_operator.validator.components import validate_dcn
+
+        monkeypatch.delenv("DCN_THRESHOLD", raising=False)
+        srv = self._probe_with(monkeypatch, bus_bw_gbps=0.01)
+        try:
+            info = validate_dcn(timeout=5)
+        finally:
+            srv.close()
+        assert info["DCN_BUS_GBPS"] == "0.01"

@@ -1,0 +1,363 @@
+"""Deterministic fault injection for the control plane.
+
+Two halves:
+
+- :class:`FaultPlan` — a seeded RNG materialized up-front into a
+  reproducible schedule of :class:`Fault` events. Nothing draws from the
+  RNG at run time, so the same (scenario, seed, node set, steps) always
+  yields a byte-identical schedule — the property the determinism test
+  pins (tests/test_chaos.py) and the property that makes a chaos failure
+  reproducible from its verdict alone.
+- :class:`ChaosClient` — a :class:`~tpu_operator.runtime.client.Client`
+  wrapper that injects apiserver-side faults into whatever client the
+  controllers actually use: 409 conflict storms, 429 Retry-After
+  throttles, transient 5xx, request latency (charged to a virtual
+  clock, never a real sleep), and dropped watch streams healed the way
+  a real informer heals them — 410 Gone, then relist (the underlying
+  ``watch()`` replays ADDED for every live object).
+
+Object-level faults (node NotReady flaps, chip disappearance, operand
+pod crash-loops, node churn) are *adversary moves against the world*,
+not apiserver behaviors, so they are applied by the runner directly
+through the unwrapped inner client — see ``runner._apply_fault``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime.client import (
+    Client,
+    ConflictError,
+    ListOptions,
+    ServerUnavailableError,
+    TooManyRequestsError,
+)
+
+# fault kinds consumed by ChaosClient (apiserver-side)
+API_CONFLICT = "api-conflict"      # 409 on the next mutating request
+API_THROTTLE = "api-throttle"      # 429 Retry-After on the next request
+API_UNAVAILABLE = "api-5xx"        # 503 on the next request
+API_LATENCY = "api-latency"        # virtual latency on the next requests
+WATCH_DROP = "watch-drop"          # drop every stream; 410-then-relist
+
+# fault kinds applied by the runner against cluster objects
+NODE_FLAP = "node-flap"            # Ready=False on one node
+NODE_HEAL = "node-heal"            # Ready=True again
+NODE_ADD = "node-add"              # a TPU node joins
+NODE_REMOVE = "node-remove"        # a TPU node (and its pods) vanishes
+CHIP_LOSS = "chip-loss"            # health engine reports chips missing
+CHIP_RESTORE = "chip-restore"      # chips come back
+POD_CRASH = "pod-crash"            # an operand pod crash-loops
+MUTATE_POLICY = "mutate-policy"    # spec edit the operator must apply
+TRIGGER_ROLLOUT = "trigger-rollout"  # libtpu change -> fleet upgrade FSM
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. ``step`` indexes the runner's step loop;
+    ``arg`` is a target node name or a marker value; ``count``/``seconds``
+    parameterize the apiserver faults."""
+
+    step: int
+    kind: str
+    arg: str = ""
+    count: int = 0
+    seconds: float = 0.0
+
+
+class VirtualClock:
+    """Monotonic virtual time: the runner advances it per step, latency
+    faults charge it per request, and the upgrade FSM's deadlines read it
+    (``UpgradeReconciler(now=clock)``) — so timeout behavior is part of
+    the deterministic schedule, not the wall clock."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@dataclass
+class FaultPlan:
+    scenario: str
+    seed: int
+    steps: int
+    faults: List[Fault] = field(default_factory=list)
+
+    def for_step(self, step: int) -> List[Fault]:
+        return [f for f in self.faults if f.step == step]
+
+    def schedule_json(self) -> str:
+        """Stable serialization — the determinism contract's artifact."""
+        return json.dumps(
+            {"scenario": self.scenario, "seed": self.seed,
+             "steps": self.steps,
+             "faults": [asdict(f) for f in self.faults]},
+            sort_keys=True)
+
+    # -- schedule generation ------------------------------------------------
+
+    @classmethod
+    def build(cls, scenario: str, seed: int, node_names: Sequence[str],
+              steps: int) -> "FaultPlan":
+        """Materialize the schedule for a named scenario. ``node_names``
+        must be the sorted TPU node list of the cluster under test (the
+        runner passes it), so node-targeted faults are reproducible."""
+        rng = random.Random(f"{scenario}:{seed}")
+        nodes = list(node_names)
+        build = {
+            "conflict-storm": cls._conflict_storm,
+            "watch-flap": cls._watch_flap,
+            "node-churn": cls._node_churn,
+            "upgrade-under-fire": cls._upgrade_under_fire,
+            "chip-loss": cls._chip_loss,
+        }.get(scenario)
+        if build is None:
+            raise ValueError(f"unknown chaos scenario {scenario!r}")
+        faults = build(rng, nodes, steps)
+        faults.sort(key=lambda f: (f.step, f.kind, f.arg))
+        return cls(scenario=scenario, seed=seed, steps=steps, faults=faults)
+
+    @staticmethod
+    def _marker(rng: random.Random, prefix: str) -> str:
+        return f"{prefix}-{rng.randrange(1_000_000)}"
+
+    @classmethod
+    def _conflict_storm(cls, rng, nodes, steps) -> List[Fault]:
+        """Write 409s in bursts, with 429/503 sprinkled in, each burst
+        paired with a spec mutation the operator must still land."""
+        out: List[Fault] = []
+        for step in range(steps):
+            if step % 3 == 0:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 6)))
+                out.append(Fault(step, MUTATE_POLICY,
+                                 arg=cls._marker(rng, "storm")))
+            if step % 5 == 2:
+                out.append(Fault(step, API_THROTTLE,
+                                 count=rng.randrange(1, 3),
+                                 seconds=float(rng.randrange(1, 5))))
+            if step % 7 == 3:
+                out.append(Fault(step, API_UNAVAILABLE, count=1))
+        return out
+
+    @classmethod
+    def _watch_flap(cls, rng, nodes, steps) -> List[Fault]:
+        """Streams die repeatedly; every drop pairs with a mutation so a
+        client that fails to relist demonstrably loses the event."""
+        out: List[Fault] = []
+        for step in range(steps):
+            if step % 4 == 1:
+                out.append(Fault(step, WATCH_DROP))
+                out.append(Fault(step, MUTATE_POLICY,
+                                 arg=cls._marker(rng, "flap")))
+            if step % 6 == 4:
+                out.append(Fault(step, API_LATENCY, count=rng.randrange(3, 8),
+                                 seconds=0.5))
+        return out
+
+    @classmethod
+    def _node_churn(cls, rng, nodes, steps) -> List[Fault]:
+        """Nodes flap NotReady, join, and vanish mid-run."""
+        out: List[Fault] = []
+        join = 0
+        for step in range(steps):
+            if step % 4 == 0 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(step + 2, steps - 1), NODE_HEAL,
+                                 arg=victim))
+            if step % 6 == 3:
+                join += 1
+                out.append(Fault(step, NODE_ADD, arg=f"chaos-join-{join}"))
+            if step % 9 == 5 and len(nodes) > 1:
+                # never remove a node scheduled to heal later
+                flapped = {f.arg for f in out if f.kind == NODE_FLAP}
+                candidates = [n for n in nodes if n not in flapped]
+                if candidates:
+                    victim = rng.choice(candidates)
+                    nodes.remove(victim)
+                    out.append(Fault(step, NODE_REMOVE, arg=victim))
+        return out
+
+    @classmethod
+    def _upgrade_under_fire(cls, rng, nodes, steps) -> List[Fault]:
+        """A fleet libtpu rollout, then every apiserver fault class plus
+        node flaps while the FSM walks the cluster."""
+        out: List[Fault] = [
+            Fault(0, TRIGGER_ROLLOUT, arg=cls._marker(rng, "/opt/chaos-libtpu"))]
+        for step in range(1, steps):
+            if step % 3 == 1:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(1, 4)))
+            if step % 5 == 2:
+                out.append(Fault(step, WATCH_DROP))
+            if step % 4 == 3 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(step + 2, steps - 1), NODE_HEAL,
+                                 arg=victim))
+            if step % 7 == 4:
+                out.append(Fault(step, API_THROTTLE, count=1,
+                                 seconds=float(rng.randrange(1, 4))))
+        return out
+
+    @classmethod
+    def _chip_loss(cls, rng, nodes, steps) -> List[Fault]:
+        """Chips disappear from health samples (allocatable drops), come
+        back, and operand pods crash-loop in between."""
+        out: List[Fault] = []
+        for step in range(steps):
+            if step % 4 == 0 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, CHIP_LOSS, arg=victim))
+                out.append(Fault(min(step + 3, steps - 1), CHIP_RESTORE,
+                                 arg=victim))
+            if step % 5 == 2 and nodes:
+                out.append(Fault(step, POD_CRASH, arg=rng.choice(nodes)))
+            if step % 6 == 5:
+                out.append(Fault(step, API_UNAVAILABLE, count=1))
+        return out
+
+
+# mutating verbs a 409 can hit (create 409s are AlreadyExists, a
+# different controller path — conflict storms target RV'd writes)
+_CONFLICT_VERBS = ("update", "update_status", "patch")
+
+
+class ChaosClient(Client):
+    """Client wrapper injecting armed apiserver faults into every verb.
+
+    Faults are armed as a FIFO; each incoming request consumes the head
+    fault if it applies to the request's verb (conflicts only hit RV'd
+    writes, throttles/5xx hit anything, latency charges the virtual
+    clock and lets the request through). With a synchronous runner the
+    consumption order — and therefore the whole run — is deterministic.
+    """
+
+    def __init__(self, inner: Client, clock: Optional[VirtualClock] = None):
+        self.inner = inner
+        self.clock = clock
+        self.injected: dict = {}            # kind -> count, for the verdict
+        self._armed: List[Fault] = []
+        self._watches: List[dict] = []
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, fault: Fault) -> None:
+        """Queue an apiserver fault: count N expands to N queued shots."""
+        for _ in range(max(1, fault.count)):
+            self._armed.append(fault)
+
+    def record(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        OPERATOR_METRICS.chaos_faults_injected.labels(kind=kind).inc()
+
+    def _intercept(self, verb: str) -> None:
+        while self._armed:
+            fault = self._armed[0]
+            if fault.kind == API_LATENCY:
+                self._armed.pop(0)
+                self.record(API_LATENCY)
+                if self.clock is not None:
+                    self.clock.advance(fault.seconds)
+                continue  # slow, not failed — let the request through
+            if fault.kind == API_CONFLICT:
+                if verb not in _CONFLICT_VERBS:
+                    return  # head stays armed for the next RV'd write
+                self._armed.pop(0)
+                self.record(API_CONFLICT)
+                raise ConflictError(
+                    "chaos: the object has been modified; please apply "
+                    "your changes to the latest version")
+            if fault.kind == API_THROTTLE:
+                self._armed.pop(0)
+                self.record(API_THROTTLE)
+                if self.clock is not None:
+                    self.clock.advance(fault.seconds)
+                raise TooManyRequestsError(
+                    "chaos: too many requests", retry_after=fault.seconds)
+            if fault.kind == API_UNAVAILABLE:
+                self._armed.pop(0)
+                self.record(API_UNAVAILABLE)
+                raise ServerUnavailableError(
+                    "chaos: the server is currently unable to handle "
+                    "the request")
+            return  # unknown armed kind: ignore defensively
+
+    # -- Client verbs -------------------------------------------------------
+
+    def get(self, api_version, kind, name, namespace=None,
+            metadata_only=False):
+        self._intercept("get")
+        return self.inner.get(api_version, kind, name, namespace,
+                              metadata_only=metadata_only)
+
+    def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        self._intercept("list")
+        return self.inner.list(api_version, kind, opts)
+
+    def create(self, obj):
+        self._intercept("create")
+        return self.inner.create(obj)
+
+    def update(self, obj):
+        self._intercept("update")
+        return self.inner.update(obj)
+
+    def update_status(self, obj):
+        self._intercept("update_status")
+        return self.inner.update_status(obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        self._intercept("patch")
+        return self.inner.patch(api_version, kind, name, patch, namespace)
+
+    def delete(self, api_version, kind, name, namespace=None):
+        self._intercept("delete")
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def watch(self, api_version, kind, handler: Callable) -> Callable:
+        entry = {"av": api_version, "kind": kind, "handler": handler,
+                 "cancel": self.inner.watch(api_version, kind, handler)}
+        self._watches.append(entry)
+
+        def cancel():
+            entry["cancel"]()
+            if entry in self._watches:
+                self._watches.remove(entry)
+
+        return cancel
+
+    def suspend_watch_streams(self) -> None:
+        """Every active stream dies (the 410 Gone analog). Events
+        published while suspended are genuinely lost to the controllers —
+        the runner mutates cluster objects in exactly this window."""
+        self.record(WATCH_DROP)
+        for entry in self._watches:
+            entry["cancel"]()
+
+    def resume_watch_streams(self) -> None:
+        """Re-establish every suspended stream — the underlying
+        ``watch()`` replays ADDED for all live objects, which is exactly
+        an informer relist. A client that skipped the relist would
+        silently miss every event between drop and resubscribe; pairing
+        drops with mutations in the plan makes that failure mode a
+        convergence violation, not a mystery."""
+        for entry in self._watches:
+            entry["cancel"] = self.inner.watch(entry["av"], entry["kind"],
+                                               entry["handler"])
+
+    def drop_watch_streams(self) -> None:
+        """Suspend + immediately resume: a plain stream reset."""
+        self.suspend_watch_streams()
+        self.resume_watch_streams()

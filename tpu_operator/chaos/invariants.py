@@ -1,0 +1,227 @@
+"""Continuous cluster-invariant checking for chaos runs.
+
+The checker reads the cluster through the UNWRAPPED inner client (its
+reads must never consume an armed fault or perturb the run) and asserts
+the properties the control plane promises to hold *at every observation
+point*, not just at convergence:
+
+- ``rv-regress``: resourceVersions never move backwards on the policy
+  CR, Nodes, or operand DaemonSets. The fake apiserver's RV counter is
+  globally monotonic, so a regression means a write path resurrected a
+  stale snapshot — a lost status update.
+- ``fsm-monotonic``: per upgrade *unit* (all hosts of a multi-host
+  slice, the upgrade controller's own grouping), the aggregate FSM state
+  only walks forward through ``_STAGE_ORDER``, with exactly the legal
+  resets: anything may fail; ``failed`` retries to ``upgrade-required``;
+  ``done`` may re-enter ``upgrade-required`` on a new rollout. A unit
+  observed moving backward mid-flight (drain back to cordon) lost a
+  member's transition.
+- ``upgrade-budget``: units concurrently in ``IN_PROGRESS_STATES`` never
+  exceed ``upgradePolicy.maxParallelUpgrades``.
+- ``gauge-consistency`` (settled runs only): the slice gauges and the
+  CR's ``status.slices[]`` rows agree with a fresh
+  :func:`~tpu_operator.controllers.slices.slice_status` computation.
+  Checked only once faults stop — mid-storm a reconcile legally sets
+  gauges and then loses its status write to an injected 409.
+- ``convergence``: recorded by the runner when the cluster fails to
+  reach all-Ready within the soak budget after faults stop.
+
+Every violation also increments
+``tpu_operator_chaos_invariant_violations_total{invariant=...}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..api import labels as L
+from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1
+from ..controllers.upgrade_controller import (
+    IN_PROGRESS_STATES,
+    STATE_DONE,
+    STATE_FAILED,
+    STATE_UPGRADE_REQUIRED,
+    _STAGE_ORDER,
+)
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..metrics.registry import REGISTRY
+from ..runtime.client import Client, ListOptions
+from ..runtime.objects import get_nested, labels_of, name_of
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    step: int
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {"invariant": self.invariant, "step": self.step,
+                "detail": self.detail}
+
+
+class InvariantChecker:
+    def __init__(self, client: Client, namespace: str = "tpu-operator"):
+        self.client = client
+        self.namespace = namespace
+        self.violations: List[Violation] = []
+        self._last_rv: Dict[Tuple[str, str, str], int] = {}
+        self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
+
+    def record(self, invariant: str, step: int, detail: str) -> None:
+        self.violations.append(Violation(invariant, step, detail))
+        OPERATOR_METRICS.chaos_invariant_violations.labels(
+            invariant=invariant).inc()
+
+    def to_list(self) -> List[dict]:
+        return [v.to_dict() for v in self.violations]
+
+    # -- periodic observation ----------------------------------------------
+
+    def observe(self, step: int) -> None:
+        nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
+        self._check_rv(step, nodes)
+        self._check_fsm(step, nodes)
+        self._check_budget(step, nodes)
+
+    def _check_rv(self, step: int, nodes: Dict[str, dict]) -> None:
+        tracked = list(self.client.list(V1, KIND_CLUSTER_POLICY))
+        tracked += list(nodes.values())
+        tracked += self.client.list(
+            "apps/v1", "DaemonSet", ListOptions(namespace=self.namespace))
+        seen = set()
+        for obj in tracked:
+            key = (obj.get("kind", ""), namespace_key(obj), name_of(obj))
+            seen.add(key)
+            try:
+                rv = int(get_nested(obj, "metadata", "resourceVersion"))
+            except (TypeError, ValueError):
+                continue
+            last = self._last_rv.get(key)
+            if last is not None and rv < last:
+                self.record("rv-regress", step,
+                            f"{key[0]} {key[2]}: resourceVersion went "
+                            f"{last} -> {rv}")
+            self._last_rv[key] = rv
+        # deleted objects stop being tracked; a re-created namesake gets a
+        # fresh (higher, globally monotonic) RV anyway
+        for key in [k for k in self._last_rv if k not in seen]:
+            del self._last_rv[key]
+
+    # -- upgrade FSM monotonicity ------------------------------------------
+
+    @staticmethod
+    def _units(nodes: Dict[str, dict]) -> List[List[str]]:
+        """The upgrade controller's own unit partition (multi-host slices
+        move as one unit; everything else is a singleton) — recomputed
+        here so the invariant judges the controller by its own grouping."""
+        from ..state.nodepool import get_node_pools, slices_of
+
+        units: List[List[str]] = []
+        grouped = set()
+        for pool in get_node_pools(list(nodes.values())):
+            if pool.multi_host:
+                for _, members in sorted(slices_of(pool, nodes).items()):
+                    units.append(sorted(members))
+            else:
+                for node_name in pool.nodes:
+                    units.append([node_name])
+            grouped.update(pool.nodes)
+        for name in sorted(set(nodes) - grouped):
+            units.append([name])
+        units.sort(key=lambda u: u[0])
+        return units
+
+    @staticmethod
+    def _unit_state(members: List[str],
+                    nodes: Dict[str, dict]) -> Optional[str]:
+        states = [labels_of(nodes[m]).get(L.UPGRADE_STATE) for m in members]
+        if any(s == STATE_FAILED for s in states):
+            return STATE_FAILED
+        present = [s for s in states if s in _STAGE_ORDER]
+        if not present:
+            return None
+        return min(present, key=_STAGE_ORDER.index)
+
+    @staticmethod
+    def _legal_transition(prev: Optional[str], new: Optional[str]) -> bool:
+        if prev is None or new is None or prev == new:
+            return True
+        if new == STATE_FAILED:
+            return True  # any stage may fail
+        if prev == STATE_FAILED:
+            return new == STATE_UPGRADE_REQUIRED  # backoff retry
+        if prev == STATE_DONE:
+            return new == STATE_UPGRADE_REQUIRED  # a new rollout began
+        if prev in _STAGE_ORDER and new in _STAGE_ORDER:
+            return _STAGE_ORDER.index(new) >= _STAGE_ORDER.index(prev)
+        return True  # unknown label value: not this invariant's problem
+
+    def _check_fsm(self, step: int, nodes: Dict[str, dict]) -> None:
+        seen = set()
+        for members in self._units(nodes):
+            key = tuple(members)
+            seen.add(key)
+            new = self._unit_state(members, nodes)
+            prev = self._unit_states.get(key)
+            if not self._legal_transition(prev, new):
+                self.record("fsm-monotonic", step,
+                            f"unit [{members[0]}+{len(members) - 1}]: "
+                            f"{prev} -> {new}")
+            self._unit_states[key] = new
+        # churned units (membership changed) restart with no history —
+        # a different member set is a different unit, not a regression
+        for key in [k for k in self._unit_states if k not in seen]:
+            del self._unit_states[key]
+
+    def _check_budget(self, step: int, nodes: Dict[str, dict]) -> None:
+        crs = self.client.list(V1, KIND_CLUSTER_POLICY)
+        if not crs:
+            return
+        crs.sort(key=lambda c: (
+            get_nested(c, "metadata", "creationTimestamp", default=""),
+            name_of(c)))
+        raw = get_nested(crs[0], "spec", "upgradePolicy",
+                         "maxParallelUpgrades")
+        budget = max(1, raw or 1)  # the controller's own default
+        in_progress = sum(
+            1 for members in self._units(nodes)
+            if self._unit_state(members, nodes) in IN_PROGRESS_STATES)
+        if in_progress > budget:
+            self.record("upgrade-budget", step,
+                        f"{in_progress} upgrade units in progress, "
+                        f"budget is {budget}")
+
+    # -- settled-only checks ------------------------------------------------
+
+    def check_settled(self, step: int) -> None:
+        """Gauge/status consistency, valid only once faults have stopped
+        and the cluster has had time to settle: mid-storm a reconcile can
+        legally set the gauges and then lose the CR status write to an
+        injected 409."""
+        from ..controllers.slices import MAX_ROWS, slice_status
+
+        rows = slice_status(self.client, self.namespace)
+        total = REGISTRY.get_sample_value("tpu_operator_slices_total")
+        validated = REGISTRY.get_sample_value("tpu_operator_slices_validated")
+        want_total = float(len(rows))
+        want_validated = float(sum(1 for r in rows if r["validated"]))
+        if total != want_total or validated != want_validated:
+            self.record("gauge-consistency", step,
+                        f"slice gauges ({total}, {validated}) != "
+                        f"recomputed ({want_total}, {want_validated})")
+        crs = self.client.list(V1, KIND_CLUSTER_POLICY)
+        for cr in crs:
+            if get_nested(cr, "status", "state") != "ready":
+                continue
+            cr_rows = get_nested(cr, "status", "slices", default=[]) or []
+            if cr_rows != rows[:MAX_ROWS]:
+                self.record("gauge-consistency", step,
+                            f"policy {name_of(cr)}: status.slices[] "
+                            f"({len(cr_rows)} rows) disagrees with a fresh "
+                            f"slice_status ({len(rows)} rows)")
+
+
+def namespace_key(obj: dict) -> str:
+    return get_nested(obj, "metadata", "namespace", default="") or ""

@@ -1,0 +1,467 @@
+"""Named chaos scenarios against the mock cluster.
+
+The runner is deliberately single-threaded: the production Manager's
+worker threads would make fault-consumption order depend on the
+scheduler, and a chaos verdict that can't be reproduced from (scenario,
+seed) is a bug report nobody can act on. :class:`_SyncController`
+re-uses the real ``setup_controller`` wiring — watches, predicates,
+mappers — so the event plumbing under test is the production code, only
+the thread is gone. Time is a :class:`~.faults.VirtualClock`: requeue
+delays, FSM deadlines and injected latency all advance it, never the
+wall clock, so a 100-node scenario runs in seconds and two runs with the
+same seed emit byte-identical JSON.
+
+Each step: apply the step's faults (apiserver faults arm the
+ChaosClient; object faults mutate the world through the unwrapped fake),
+drain both controllers, tick the fake kubelet, drain again, advance the
+clock, then let the invariant checker observe. After the plan runs out,
+the cluster must converge to all-Ready within the soak budget —
+"eventual convergence once faults stop" is itself an invariant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..api import labels as L
+from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, new_cluster_policy
+from ..benchmarks.controlplane import build_cluster
+from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+from ..controllers.upgrade_controller import (
+    STATE_DONE,
+    UpgradeReconciler,
+    desired_revision,
+)
+from ..runtime import FakeClient, Request
+from ..runtime.client import (
+    ApiError,
+    ConflictError,
+    ListOptions,
+    NotFoundError,
+)
+from ..runtime.fake import simulate_kubelet
+from ..runtime.manager import any_event, enqueue_object
+from ..runtime.objects import (
+    get_nested,
+    labels_of,
+    name_of,
+    namespace_of,
+    set_nested,
+)
+from .faults import (
+    API_CONFLICT,
+    API_LATENCY,
+    API_THROTTLE,
+    API_UNAVAILABLE,
+    CHIP_LOSS,
+    CHIP_RESTORE,
+    MUTATE_POLICY,
+    NODE_ADD,
+    NODE_FLAP,
+    NODE_HEAL,
+    NODE_REMOVE,
+    POD_CRASH,
+    TRIGGER_ROLLOUT,
+    WATCH_DROP,
+    ChaosClient,
+    Fault,
+    FaultPlan,
+    VirtualClock,
+)
+from .invariants import InvariantChecker
+
+SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
+             "upgrade-under-fire", "chip-loss")
+
+NAMESPACE = "tpu-operator"
+POLICY = "tpu-cluster-policy"
+STEP_DT = 20.0           # virtual seconds per runner step
+DEFAULT_STEPS = 12
+SETUP_PASS_BUDGET = 30   # fault-free passes to reach the baseline Ready
+SOAK_PASS_BUDGET = 150   # post-fault passes before convergence fails
+DRAIN_BUDGET = 500       # reconciles per drain — a backstop, not a knob
+RETRY_DELAY_S = 1.0      # virtual requeue delay after an injected failure
+MAX_PARALLEL_UPGRADES = 8
+
+
+class _SyncController:
+    """Single-threaded Controller stand-in: same watch/predicate/mapper
+    registration surface, but reconciles run inline from :meth:`drain`
+    and delayed requeues key off the virtual clock."""
+
+    def __init__(self, reconciler, client, clock: VirtualClock):
+        self.reconciler = reconciler
+        self.client = client
+        self.clock = clock
+        self._queue: List[Request] = []
+        self._delayed: Dict[Request, float] = {}
+        self._last_seen: Dict[tuple, dict] = {}
+        self.reconcile_errors = 0
+
+    def watch(self, api_version: str, kind: str,
+              predicate: Callable = any_event,
+              mapper: Callable = enqueue_object) -> None:
+        def handler(event):
+            key = (api_version, kind, namespace_of(event.obj),
+                   name_of(event.obj))
+            old = self._last_seen.get(key)
+            if event.type == "DELETED":
+                self._last_seen.pop(key, None)
+            else:
+                self._last_seen[key] = event.obj
+            try:
+                if not predicate(event, old):
+                    return
+                for req in mapper(event):
+                    self.add(req)
+            except ApiError:
+                # the mapper's LIST ate an armed fault; the per-tick
+                # resync (and any relist) re-enqueues what this loses
+                pass
+
+        self.client.watch(api_version, kind, handler)
+
+    def add(self, request: Request) -> None:
+        if request not in self._queue:
+            self._queue.append(request)
+
+    def _schedule(self, request: Request, due: float) -> None:
+        prev = self._delayed.get(request)
+        self._delayed[request] = due if prev is None else min(prev, due)
+
+    def _promote(self) -> None:
+        for req in [r for r, t in self._delayed.items()
+                    if t <= self.clock()]:
+            del self._delayed[req]
+            self.add(req)
+
+    def drain(self, budget: int = DRAIN_BUDGET) -> int:
+        done = 0
+        self._promote()
+        while self._queue and done < budget:
+            req = self._queue.pop(0)
+            done += 1
+            try:
+                result = self.reconciler.reconcile(req)
+            except ApiError:
+                # an injected 409/429/5xx escaped the reconcile: retry
+                # with a (virtual) delay, like the workqueue rate limiter
+                self.reconcile_errors += 1
+                self._schedule(req, self.clock() + RETRY_DELAY_S)
+                continue
+            if result and result.requeue_after > 0:
+                self._schedule(req, self.clock() + result.requeue_after)
+            elif result and result.requeue:
+                self.add(req)
+            self._promote()
+        return done
+
+
+# -- object-level faults (adversary moves through the unwrapped fake) -------
+
+
+def _mutate_cr(fake: FakeClient, mutate: Callable[[dict], None]) -> None:
+    for _ in range(10):
+        cr = fake.get_or_none(V1, KIND_CLUSTER_POLICY, POLICY)
+        if cr is None:
+            return
+        mutate(cr)
+        try:
+            fake.update(cr)
+            return
+        except ConflictError:
+            continue
+
+
+def _set_node_ready(fake: FakeClient, name: str, ready: bool) -> bool:
+    node = fake.get_or_none("v1", "Node", name)
+    if node is None:
+        return False
+    set_nested(node, [{"type": "Ready",
+                       "status": "True" if ready else "False"}],
+               "status", "conditions")
+    fake.update_status(node)
+    return True
+
+
+def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
+                 state: dict) -> None:
+    kind = fault.kind
+    if kind in (API_CONFLICT, API_THROTTLE, API_UNAVAILABLE, API_LATENCY):
+        chaos.arm(fault)
+        return
+    applied = False
+    if kind in (NODE_FLAP, NODE_HEAL):
+        applied = _set_node_ready(fake, fault.arg, ready=kind == NODE_HEAL)
+    elif kind == NODE_ADD:
+        fake.add_node(fault.arg, labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+            L.GKE_TPU_TOPOLOGY: "2x2x1",
+            L.GKE_ACCELERATOR_COUNT: "4"},
+            allocatable={L.TPU_RESOURCE: "4"})
+        applied = True
+    elif kind == NODE_REMOVE:
+        if fake.get_or_none("v1", "Node", fault.arg) is not None:
+            # the VM is gone: its pods go with it (no graceful drain)
+            for pod in fake.list("v1", "Pod"):
+                if get_nested(pod, "spec", "nodeName") == fault.arg:
+                    try:
+                        fake.delete("v1", "Pod", name_of(pod),
+                                    namespace_of(pod) or None)
+                    except NotFoundError:
+                        pass
+            try:
+                fake.delete("v1", "Node", fault.arg)
+                applied = True
+            except NotFoundError:
+                pass
+    elif kind == CHIP_LOSS:
+        node = fake.get_or_none("v1", "Node", fault.arg)
+        if node is not None:
+            alloc = get_nested(node, "status", "allocatable",
+                               default={}) or {}
+            state["chips"].setdefault(fault.arg,
+                                      alloc.get(L.TPU_RESOURCE, "0"))
+            for field in ("allocatable", "capacity"):
+                cur = dict(get_nested(node, "status", field,
+                                      default={}) or {})
+                cur[L.TPU_RESOURCE] = "0"
+                set_nested(node, cur, "status", field)
+            fake.update_status(node)
+            applied = True
+    elif kind == CHIP_RESTORE:
+        saved = state["chips"].pop(fault.arg, None)
+        node = fake.get_or_none("v1", "Node", fault.arg)
+        if saved is not None and node is not None:
+            for field in ("allocatable", "capacity"):
+                cur = dict(get_nested(node, "status", field,
+                                      default={}) or {})
+                cur[L.TPU_RESOURCE] = saved
+                set_nested(node, cur, "status", field)
+            fake.update_status(node)
+            applied = True
+    elif kind == POD_CRASH:
+        pods = sorted(
+            (p for p in fake.list("v1", "Pod",
+                                  ListOptions(namespace=NAMESPACE))
+             if get_nested(p, "spec", "nodeName") == fault.arg
+             and not get_nested(p, "metadata", "deletionTimestamp")),
+            key=name_of)
+        if pods:  # deterministic victim: first by name
+            victim = pods[0]
+            set_nested(victim, "Pending", "status", "phase")
+            set_nested(victim, [{"type": "Ready", "status": "False"}],
+                       "status", "conditions")
+            fake.update_status(victim)
+            applied = True
+    elif kind == MUTATE_POLICY:
+        def set_marker(cr: dict) -> None:
+            cr.setdefault("spec", {}).setdefault("devicePlugin", {})[
+                "env"] = [{"name": "CHAOS_MARKER", "value": fault.arg}]
+
+        _mutate_cr(fake, set_marker)
+        state["marker"] = fault.arg
+        applied = True
+    elif kind == TRIGGER_ROLLOUT:
+        _mutate_cr(fake, lambda cr: cr.setdefault("spec", {}).__setitem__(
+            "libtpu", {"installDir": fault.arg}))
+        state["rollout"] = True
+        applied = True
+    if applied:
+        chaos.record(kind)
+
+
+# -- convergence ------------------------------------------------------------
+
+
+def _marker_landed(fake: FakeClient, marker: str) -> bool:
+    for ds in fake.list("apps/v1", "DaemonSet",
+                        ListOptions(namespace=NAMESPACE)):
+        for ctr in get_nested(ds, "spec", "template", "spec", "containers",
+                              default=[]) or []:
+            for var in ctr.get("env") or []:
+                if var.get("name") == "CHAOS_MARKER" \
+                        and var.get("value") == marker:
+                    return True
+    return False
+
+
+def _fleet_rolled(fake: FakeClient) -> bool:
+    """Every driver pod runs its DaemonSet's current template revision —
+    the controller's own canonical definition (desired_revision), same as
+    the rollout bench's fleet check."""
+    sel = ListOptions(namespace=NAMESPACE,
+                      label_selector={"tpu.graft.dev/component":
+                                      "libtpu-driver"})
+    wants = {name_of(ds): desired_revision(fake, ds)
+             for ds in fake.list("apps/v1", "DaemonSet", sel)}
+    if not wants:
+        return False
+    pods = fake.list("v1", "Pod", sel)
+    for pod in pods:
+        ds_name = next(
+            (o.get("name") for o in get_nested(
+                pod, "metadata", "ownerReferences", default=[]) or []
+             if o.get("kind") == "DaemonSet"), None)
+        want = wants.get(ds_name)
+        if want is not None and get_nested(
+                pod, "metadata", "labels",
+                "controller-revision-hash") != want:
+            return False
+    return bool(pods)
+
+
+def _converged(fake: FakeClient, state: dict) -> bool:
+    cr = fake.get_or_none(V1, KIND_CLUSTER_POLICY, POLICY)
+    if cr is None or get_nested(cr, "status", "state") != "ready":
+        return False
+    for node in fake.list("v1", "Node"):
+        if not labels_of(node).get(L.GKE_TPU_ACCELERATOR):
+            continue
+        if get_nested(node, "spec", "unschedulable", default=False):
+            return False
+        conds = get_nested(node, "status", "conditions", default=[]) or []
+        if not any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in conds):
+            return False
+        if labels_of(node).get(L.UPGRADE_STATE) not in (None, STATE_DONE):
+            return False
+    if state["marker"] is not None \
+            and not _marker_landed(fake, state["marker"]):
+        return False
+    if state["rollout"] and not _fleet_rolled(fake):
+        return False
+    from ..controllers.slices import slice_status
+
+    return all(r["validated"] for r in slice_status(fake, NAMESPACE))
+
+
+# -- scenario driver --------------------------------------------------------
+
+
+def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
+                 steps: Optional[int] = None) -> dict:
+    """Run one named scenario and return its deterministic verdict."""
+    if scenario not in SCENARIOS:
+        raise ValueError(f"unknown chaos scenario {scenario!r}; "
+                         f"choose from {', '.join(SCENARIOS)}")
+    import logging
+
+    # injected faults make the controllers log real ERROR tracebacks by
+    # design — hundreds of them. The verdict is the signal; the expected
+    # failure spam is not. Anything that matters (a dropped invariant, a
+    # non-convergence) lands in the verdict, not the log.
+    op_log = logging.getLogger("tpu_operator")
+    prev_level = op_log.level
+    op_log.setLevel(logging.CRITICAL)
+    try:
+        return _run_scenario(scenario, nodes, seed, steps)
+    finally:
+        op_log.setLevel(prev_level)
+
+
+def _run_scenario(scenario: str, nodes: int, seed: int,
+                  steps: Optional[int]) -> dict:
+    n_steps = steps or DEFAULT_STEPS
+    fake = build_cluster(n_tpu=nodes)
+    clock = VirtualClock()
+    chaos = ChaosClient(fake, clock)
+    fake.create(new_cluster_policy(spec={
+        "upgradePolicy": {"autoUpgrade": True,
+                          "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}}))
+    prec = ClusterPolicyReconciler(client=chaos, namespace=NAMESPACE)
+    urec = UpgradeReconciler(client=chaos, namespace=NAMESPACE, now=clock)
+    ctrls = [_SyncController(prec, chaos, clock),
+             _SyncController(urec, chaos, clock)]
+    prec.setup_controller(ctrls[0], None)
+    urec.setup_controller(ctrls[1], None)
+
+    state = {"marker": None, "rollout": False, "chips": {}}
+    resync = Request(name=POLICY)
+    checker = InvariantChecker(fake, NAMESPACE)
+
+    def tick() -> None:
+        # the resync add is the informer-resync analog: the liveness
+        # backstop that keeps a scenario about SAFETY invariants — one
+        # event lost to an armed fault inside a watch handler must not
+        # deadlock the whole run
+        for c in ctrls:
+            c.add(resync)
+            c.drain()
+        simulate_kubelet(fake, ready=True)
+        for c in ctrls:
+            c.drain()
+        clock.advance(STEP_DT)
+        for c in ctrls:
+            c.drain()
+
+    def verdict(plan: FaultPlan, converged: bool, soak: int,
+                conv_s: Optional[float]) -> dict:
+        violations = checker.to_list()
+        return {
+            "scenario": scenario,
+            "seed": seed,
+            "nodes": nodes,
+            "steps": plan.steps,
+            "schedule": [asdict(f) for f in plan.faults],
+            "faults_injected": {k: chaos.injected[k]
+                                for k in sorted(chaos.injected)},
+            "converged": converged,
+            "soak_passes": soak,
+            "convergence_virtual_s": conv_s,
+            "violations": violations,
+            "ok": bool(converged and not violations),
+        }
+
+    # baseline convergence — faults only start from a known-good state,
+    # so a later non-convergence indicts the storm, not the install
+    for _ in range(SETUP_PASS_BUDGET):
+        tick()
+        if _converged(fake, state):
+            break
+    else:
+        checker.record("convergence", -1,
+                       "cluster never reached all-Ready before fault "
+                       "injection")
+        return verdict(FaultPlan(scenario=scenario, seed=seed, steps=0),
+                       converged=False, soak=0, conv_s=None)
+
+    tpu_names = sorted(
+        name_of(n) for n in fake.list("v1", "Node")
+        if labels_of(n).get(L.GKE_TPU_ACCELERATOR))
+    plan = FaultPlan.build(scenario, seed, tpu_names, n_steps)
+
+    for step in range(plan.steps):
+        step_faults = plan.for_step(step)
+        dropping = any(f.kind == WATCH_DROP for f in step_faults)
+        if dropping:
+            # streams die BEFORE this step's mutations land, so the
+            # events are genuinely lost; the resume's relist must heal
+            chaos.suspend_watch_streams()
+        for fault in step_faults:
+            if fault.kind != WATCH_DROP:
+                _apply_fault(fault, fake, chaos, state)
+        if dropping:
+            chaos.resume_watch_streams()
+        tick()
+        checker.observe(step)
+
+    faults_stopped_at = clock.t
+    soak = 0
+    converged = _converged(fake, state)
+    while not converged and soak < SOAK_PASS_BUDGET:
+        tick()
+        soak += 1
+        checker.observe(plan.steps + soak - 1)
+        converged = _converged(fake, state)
+    if converged:
+        conv_s = clock.t - faults_stopped_at
+        checker.check_settled(plan.steps + soak)
+    else:
+        conv_s = None
+        checker.record(
+            "convergence", plan.steps + soak,
+            f"cluster not all-Ready after {soak} soak passes "
+            f"({soak * STEP_DT:.0f} virtual s) past the last fault")
+    return verdict(plan, converged=converged, soak=soak, conv_s=conv_s)

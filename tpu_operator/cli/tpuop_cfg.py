@@ -186,15 +186,27 @@ def _status(args) -> int:
     object."""
     from ..runtime.kubeclient import HTTPClient, KubeConfig
 
+    as_json = getattr(args, "output", "text") == "json"
+
+    def fail_json(e: Exception) -> int:
+        # -o json promises one machine-readable object on STDOUT for
+        # every outcome — scripts parse `tpuop-cfg status -o json` and a
+        # stderr-only failure would hand them an empty document. The
+        # human diagnostic still goes to stderr.
+        print(json.dumps({"ready": False,
+                          "error": f"{type(e).__name__}: {e}"},
+                         indent=2, sort_keys=True))
+        return 1
+
     try:
         client = HTTPClient(KubeConfig.load())
     except Exception as e:
         print(f"cannot reach the cluster: {e}", file=sys.stderr)
-        return 1
+        return fail_json(e) if as_json else 1
 
     try:
         report = _status_report(client, args.namespace)
-        if getattr(args, "output", "text") == "json":
+        if as_json:
             print(json.dumps(report, indent=2, sort_keys=True))
             return 0 if report["ready"] else 1
         if not report["crs"]:
@@ -204,7 +216,7 @@ def _status(args) -> int:
         return 0 if report["ready"] else 1
     except Exception as e:
         print(f"status failed: {type(e).__name__}: {e}", file=sys.stderr)
-        return 1
+        return fail_json(e) if as_json else 1
 
 
 def _lifecycle(args) -> int:

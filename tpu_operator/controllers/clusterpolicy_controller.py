@@ -70,6 +70,9 @@ class ClusterPolicyReconciler(Reconciler):
         # transition-only Events: the CR's status copy is MAX_ROWS-capped,
         # so diffing against it would blind events for slices past the cap
         self._prev_slices: dict = {}
+        # which CR last wrote the slice gauges: deleting an *ignored*
+        # duplicate must not zero the gauges the active CR exports
+        self._slices_exporter: Optional[str] = None
 
     # -- wiring (SetupWithManager analog, clusterpolicy_controller.go:355) --
 
@@ -113,9 +116,13 @@ class ClusterPolicyReconciler(Reconciler):
             # a deleted policy exports no slices: stale non-zero gauges
             # would keep TPUSliceNotValidated firing against an
             # uninstalled operator (or a frozen healthy snapshot would
-            # mask a later failure)
-            OPERATOR_METRICS.slices_total.set(0)
-            OPERATOR_METRICS.slices_validated.set(0)
+            # mask a later failure). Only the CR that last wrote the
+            # gauges resets them — deleting an ignored duplicate while
+            # the active CR keeps exporting must not blank its values.
+            if self._slices_exporter in (None, request.name):
+                OPERATOR_METRICS.slices_total.set(0)
+                OPERATOR_METRICS.slices_validated.set(0)
+                self._slices_exporter = None
             return Result()
         if request.name not in self._first_seen:
             self._first_seen[request.name] = _time.monotonic()
@@ -170,6 +177,7 @@ class ClusterPolicyReconciler(Reconciler):
             # mask a later real failure behind a healthy snapshot
             OPERATOR_METRICS.slices_total.set(0)
             OPERATOR_METRICS.slices_validated.set(0)
+            self._slices_exporter = request.name
             conditions.set_not_ready(
                 self.client, cr, "NoTPUNodes",
                 "no nodes with cloud.google.com/gke-tpu-accelerator labels "
@@ -223,6 +231,7 @@ class ClusterPolicyReconciler(Reconciler):
         OPERATOR_METRICS.slices_total.set(len(slices))
         OPERATOR_METRICS.slices_validated.set(
             sum(1 for s in slices if s["validated"]))
+        self._slices_exporter = request.name
 
         not_ready = {n: r for n, r in results.items() if not r.ready}
         errors = {n: r for n, r in results.items()

@@ -87,6 +87,17 @@ class OperatorMetrics:
         self.slices_validated = g(
             "tpu_operator_slices_validated",
             "Multi-host slices whose every host passed validation")
+        # chaos plane (chaos/): injected faults and caught invariant
+        # violations are first-class observables, so a chaos run against
+        # a live control plane shows up on the same /metrics the
+        # operator always serves — not only in the runner's JSON verdict
+        self.chaos_faults_injected = c(
+            "tpu_operator_chaos_faults_injected_total",
+            "Faults injected by the chaos plane", labelnames=("kind",))
+        self.chaos_invariant_violations = c(
+            "tpu_operator_chaos_invariant_violations_total",
+            "Cluster invariant violations caught by the chaos checker",
+            labelnames=("invariant",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

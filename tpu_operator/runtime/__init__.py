@@ -7,6 +7,8 @@ from .client import (  # noqa: F401
     InvalidError,
     ListOptions,
     NotFoundError,
+    ServerUnavailableError,
+    TooManyRequestsError,
     WatchEvent,
 )
 from .fake import FakeClient  # noqa: F401

@@ -61,6 +61,26 @@ class EvictionBlockedError(ApiError):
     code = 429
 
 
+class TooManyRequestsError(ApiError):
+    """HTTP 429 with a Retry-After hint — apiserver overload /
+    priority-and-fairness rejection. Raised by the chaos plane's fault
+    injector (chaos/faults.py) and by HTTP clients when the server
+    throttles; callers treat it like any retryable ApiError."""
+
+    code = 429
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class ServerUnavailableError(ApiError):
+    """Transient 5xx — the apiserver (or a webhook in front of it) is
+    briefly unable to serve the request."""
+
+    code = 503
+
+
 @dataclass(frozen=True)
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED

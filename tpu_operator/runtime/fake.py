@@ -43,10 +43,14 @@ class FakeClient(Client):
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
-        # uids of live objects, maintained on create/delete so the
+        # live-object uid -> refcount, maintained on create/delete so the
         # orphaned-ownerRef check in create() is O(#refs), not a scan of
-        # the whole store (which made bulk creates O(n^2) at scale)
-        self._live_uids: set = set()
+        # the whole store (which made bulk creates O(n^2) at scale). A
+        # refcount, not a set: callers may create objects with duplicate
+        # explicit uids (a real apiserver would too — uid is caller data
+        # here), and deleting one of them must not make the survivor look
+        # dead to the GC path the chaos plane leans on.
+        self._live_uids: dict = {}
         self._rv = 0
         self.hub = WatchHub()
         # apiserver request accounting for the scale tier: every verb a
@@ -136,7 +140,8 @@ class FakeClient(Client):
             # the real apiserver accepts this and the GC controller collects
             # it shortly after; the fake compresses that to "immediately",
             # which closes the CR-deleted-mid-reconcile race deterministically
-            self._live_uids.add(meta["uid"])
+            self._live_uids[meta["uid"]] = \
+                self._live_uids.get(meta["uid"], 0) + 1
             orphaned = any(
                 r.get("uid") and r.get("uid") not in self._live_uids
                 for r in meta.get("ownerReferences") or [])
@@ -228,8 +233,12 @@ class FakeClient(Client):
         with self._lock:
             obj = self._store.pop(key, None)
             if obj is not None:
-                self._live_uids.discard(
-                    get_nested(obj, "metadata", "uid"))
+                gone = get_nested(obj, "metadata", "uid")
+                left = self._live_uids.get(gone, 0) - 1
+                if left > 0:
+                    self._live_uids[gone] = left
+                else:
+                    self._live_uids.pop(gone, None)
         if obj is None:
             raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
         self._publish("DELETED", obj)

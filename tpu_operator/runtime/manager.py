@@ -208,14 +208,23 @@ class Controller:
                     log.warning("[%s] worker did not stop within 30s",
                                 self.name)
 
-    def wait_idle(self, timeout: float = 30.0) -> bool:
-        """Test helper: wait until the queue fully drains (incl. delayed)."""
+    def wait_idle(self, timeout: float = 30.0,
+                  horizon: Optional[float] = None) -> bool:
+        """Test helper: wait until the queue fully drains (incl. delayed).
+        With ``horizon``, delayed requeues due more than ``horizon``
+        seconds out don't count as pending work — a steady-state
+        controller parks a periodic resync (120s) that would otherwise
+        make it never idle."""
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self.queue._cond:
+                delayed = self.queue._delayed
+                if horizon is not None:
+                    cut = time.monotonic() + horizon
+                    delayed = [d for d in delayed if d[0] <= cut]
                 busy = (self.queue._queue or self.queue._processing
-                        or self.queue._delayed)
+                        or delayed)
             if not busy:
                 return True
             time.sleep(0.01)
@@ -315,5 +324,7 @@ class Manager:
             self._http.shutdown()
             self._http.server_close()
 
-    def wait_idle(self, timeout: float = 30.0) -> bool:
-        return all(c.wait_idle(timeout) for c in self.controllers)
+    def wait_idle(self, timeout: float = 30.0,
+                  horizon: Optional[float] = None) -> bool:
+        return all(c.wait_idle(timeout, horizon=horizon)
+                   for c in self.controllers)

@@ -417,6 +417,17 @@ def _maybe_dcn_bandwidth_probe(info: Dict[str, str]) -> None:
         raise ValidationFailed("DCN psum produced wrong values")
     info["DCN_SLICES"] = str(res.slices)
     info["DCN_BUS_GBPS"] = f"{res.bus_bw_gbps:.2f}"
+    # DCN_THRESHOLD (Gbps bus bandwidth): ICI_THRESHOLD's DCN mirror,
+    # but absolute not fraction-of-peak — DCN peak depends on the
+    # inter-slice fabric, which the node cannot introspect. Off unless
+    # set: reachability plus correct data is the default contract.
+    thr_s = os.environ.get("DCN_THRESHOLD", "")
+    if thr_s:
+        thr = float(thr_s)
+        if res.bus_bw_gbps < thr:
+            raise ValidationFailed(
+                f"DCN psum bus bandwidth {res.bus_bw_gbps:.2f} Gbps is "
+                f"below the {thr:g} Gbps DCN_THRESHOLD")
 
 
 def validate_fencing() -> Dict[str, str]:
