@@ -386,6 +386,14 @@ def _controlplane_doc() -> dict | None:
             "install_to_ready_s": round(r["install_to_ready_s"], 2),
             "steady_pass_s": round(r["steady_pass_s"], 4),
             "steady_requests": r["steady_requests"],
+            # informer-cache steady pass: apiserver requests left once
+            # reads come from the watch-fed cache (write verbs only; the
+            # readthrough verb split above is the before picture)
+            "steady_verbs": r["steady_verbs"],
+            "steady_pass_cached_s": round(r["steady_pass_cached_s"], 4),
+            "steady_requests_cached": r["steady_requests_cached"],
+            "steady_verbs_cached": r["steady_verbs_cached"],
+            "steady_cache_reads": r["steady_cache_reads"],
             "vs_baseline": round(
                 INSTALL_BUDGET_S / max(r["install_to_ready_s"], 1e-9), 2)
             if r["ready"] else 0.0,
@@ -407,6 +415,21 @@ def _controlplane_doc() -> dict | None:
             }
         except Exception as e:
             doc["rollout"] = {"error": f"{type(e).__name__}: {e}"}
+        # concurrent-reconcile datapoint: the same install through the
+        # threaded Manager at workers=1 vs workers=2 over the cache (its
+        # own try for the same reason as rollout's)
+        try:
+            from tpu_operator.benchmarks.controlplane import (
+                run_concurrency_bench,
+            )
+
+            cc_n = min(100, n)
+            doc["workers"] = {
+                str(w): round(run_concurrency_bench(cc_n, workers=w)["wall_s"], 2)
+                for w in (1, 2)}
+            doc["workers"]["n_tpu_nodes"] = cc_n
+        except Exception as e:
+            doc["workers"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

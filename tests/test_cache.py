@@ -1,0 +1,222 @@
+"""Informer-backed cache coherence (runtime/cache.py).
+
+Four claims under test:
+
+1. Read path — warm gets/lists are served from the store with ZERO
+   apiserver get/list verbs, copy-on-read, and fake-identical selector
+   semantics.
+2. Read-your-writes — a get immediately after the client's own
+   update/update_status never observes a staler resourceVersion than
+   the write returned.
+3. Healing — a watch stream dropped mid-gap (writes land while no
+   stream is connected) is detected on resume and healed by relist:
+   post-gap updates, creates AND deletes all become visible.
+4. Indexes — secondary indexes stay consistent under DELETED events,
+   and the by-accelerator bucket union equals the TPU node set even
+   for capacity-only (unlabeled) nodes.
+
+The 100-node cached chaos runs for every scenario live in
+test_chaos.py::TestScenariosConverge (``cached=True`` is the runner
+default); here the watch-flap verdict's cache metadata is asserted
+explicitly.
+"""
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.chaos.faults import ChaosClient
+from tpu_operator.chaos.runner import run_scenario
+from tpu_operator.runtime import CachedClient, FakeClient
+
+
+def _cm(name, data, namespace="tpu-operator"):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": namespace},
+            "data": data}
+
+
+def _pod(name, node, labels=None, namespace="tpu-operator"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": namespace,
+                         "labels": labels or {}},
+            "spec": {"nodeName": node}}
+
+
+@pytest.fixture
+def fake():
+    c = FakeClient()
+    yield c
+
+
+@pytest.fixture
+def cached(fake):
+    cc = CachedClient(fake)
+    yield cc
+    cc.close()
+
+
+class TestReadPath:
+    def test_warm_reads_issue_zero_apiserver_verbs(self, fake, cached):
+        for i in range(8):
+            fake.add_node(f"tpu-{i}",
+                          labels={L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice"},
+                          allocatable={L.TPU_RESOURCE: "4"})
+        fake.create(_cm("a", {"k": "1"}))
+        cached.list("v1", "Node")          # warm: one bootstrap LIST each
+        cached.list("v1", "ConfigMap")
+        fake.reset_verb_counts()
+        for _ in range(25):
+            assert len(cached.list("v1", "Node")) == 8
+            assert cached.get("v1", "ConfigMap", "a",
+                              namespace="tpu-operator")["data"] == {"k": "1"}
+        assert "list" not in fake.verb_counts, fake.verb_counts
+        assert "get" not in fake.verb_counts, fake.verb_counts
+
+    def test_copy_on_read_isolates_callers(self, fake, cached):
+        fake.create(_cm("a", {"k": "1"}))
+        got = cached.get("v1", "ConfigMap", "a", namespace="tpu-operator")
+        got["data"]["k"] = "corrupted"
+        again = cached.get("v1", "ConfigMap", "a", namespace="tpu-operator")
+        assert again["data"] == {"k": "1"}
+
+    def test_list_matches_fake_selector_semantics(self, fake, cached):
+        fake.create(_pod("p1", "n1", labels={"app": "x", "tier": "db"}))
+        fake.create(_pod("p2", "n1", labels={"app": "x"}))
+        fake.create(_pod("p3", "n2", labels={"app": "y"}))
+        from tpu_operator.runtime.client import ListOptions
+        for sel in ({"app": "x"}, {"app": "x", "tier": "db"},
+                    {"app": "z"}, None):
+            opts = ListOptions(label_selector=sel) if sel else None
+            want = sorted(p["metadata"]["name"]
+                          for p in fake.list("v1", "Pod", opts))
+            got = sorted(p["metadata"]["name"]
+                         for p in cached.list("v1", "Pod", opts))
+            assert got == want, (sel, got, want)
+
+
+class TestReadYourWrites:
+    def test_get_after_own_update_never_staler(self, fake, cached):
+        obj = cached.create(_cm("rv", {"n": "0"}))
+        for i in range(1, 12):
+            obj["data"]["n"] = str(i)
+            written = cached.update(obj)
+            wrote_rv = int(written["metadata"]["resourceVersion"])
+            got = cached.get("v1", "ConfigMap", "rv",
+                             namespace="tpu-operator")
+            got_rv = int(got["metadata"]["resourceVersion"])
+            assert got_rv >= wrote_rv, (i, got_rv, wrote_rv)
+            assert got["data"]["n"] == str(i)
+            obj = got
+
+    def test_update_status_write_through(self, fake, cached):
+        fake.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n1"}})
+        node = cached.get("v1", "Node", "n1")
+        node.setdefault("status", {})["phase"] = "Ready"
+        written = cached.update_status(node)
+        got = cached.get("v1", "Node", "n1")
+        assert int(got["metadata"]["resourceVersion"]) >= \
+            int(written["metadata"]["resourceVersion"])
+        assert got["status"]["phase"] == "Ready"
+
+
+class TestHealing:
+    def test_gap_writes_served_after_heal(self, fake):
+        chaos = ChaosClient(fake)
+        cached = CachedClient(chaos)
+        try:
+            # one object stays untouched across the gap: its same-RV
+            # ADDED replay on resume is the resumed-stream signature the
+            # cache keys its relist decision on
+            cached.create(_cm("anchor", {"k": "0"}))
+            victim = cached.create(_cm("victim", {"k": "0"}))
+            cached.create(_cm("doomed", {"k": "0"}))
+            assert cached.list("v1", "ConfigMap")  # informer live
+
+            chaos.suspend_watch_streams()
+            # mutate behind the cache's back — no stream is connected,
+            # so these events are genuinely lost, not merely delayed
+            victim = fake.get("v1", "ConfigMap", "victim",
+                              namespace="tpu-operator")
+            victim["data"]["k"] = "post-gap"
+            victim = fake.update(victim)
+            fake.create(_cm("born-in-gap", {"k": "1"}))
+            fake.delete("v1", "ConfigMap", "doomed",
+                        namespace="tpu-operator")
+            chaos.resume_watch_streams()  # ADDED replay for live objects
+
+            relists_before = cached.relists
+            names = sorted(c["metadata"]["name"]
+                           for c in cached.list("v1", "ConfigMap"))
+            assert names == ["anchor", "born-in-gap", "victim"], names
+            got = cached.get("v1", "ConfigMap", "victim",
+                             namespace="tpu-operator")
+            assert got["data"]["k"] == "post-gap"
+            assert int(got["metadata"]["resourceVersion"]) >= \
+                int(victim["metadata"]["resourceVersion"])
+            assert cached.relists > relists_before  # healed BY relist
+        finally:
+            cached.close()
+
+    def test_watch_flap_scenario_runs_cached(self):
+        v = run_scenario("watch-flap", nodes=100, seed=7)
+        assert v["ok"] is True and v["converged"] is True
+        assert v["cached"] is True
+        assert v["cache_relists"] > 0  # the drops actually exercised healing
+        assert v["violations"] == []
+
+    def test_conflict_storm_cached_flag(self):
+        v = run_scenario("conflict-storm", nodes=24, seed=3)
+        assert v["ok"] is True
+        assert v["cached"] is True
+
+
+class TestIndexes:
+    def test_by_node_index_consistent_under_deleted(self, fake, cached):
+        fake.create(_pod("p1", "n1"))
+        fake.create(_pod("p2", "n1"))
+        fake.create(_pod("p3", "n2"))
+        assert sorted(p["metadata"]["name"] for p in
+                      cached.index("v1", "Pod", "by-node", "n1")) == \
+            ["p1", "p2"]
+        fake.delete("v1", "Pod", "p1", namespace="tpu-operator")
+        assert [p["metadata"]["name"] for p in
+                cached.index("v1", "Pod", "by-node", "n1")] == ["p2"]
+        fake.delete("v1", "Pod", "p2", namespace="tpu-operator")
+        assert cached.index("v1", "Pod", "by-node", "n1") == []
+        # the other bucket is untouched
+        assert [p["metadata"]["name"] for p in
+                cached.index("v1", "Pod", "by-node", "n2")] == ["p3"]
+
+    def test_label_index_consistent_under_deleted(self, fake, cached):
+        from tpu_operator.runtime.client import ListOptions
+        fake.create(_pod("p1", "n1", labels={"app": "x"}))
+        fake.create(_pod("p2", "n1", labels={"app": "x"}))
+        opts = ListOptions(label_selector={"app": "x"})
+        assert len(cached.list("v1", "Pod", opts)) == 2
+        fake.delete("v1", "Pod", "p1", namespace="tpu-operator")
+        assert [p["metadata"]["name"]
+                for p in cached.list("v1", "Pod", opts)] == ["p2"]
+
+    def test_accelerator_bucket_union_is_tpu_node_set(self, fake, cached):
+        fake.add_node("tpu-a",
+                      labels={L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice"},
+                      allocatable={L.TPU_RESOURCE: "4"})
+        fake.add_node("cpu-1", labels={})
+        # capacity-only node: no accelerator label, still a TPU node
+        fake.add_node("tpu-bare", labels={},
+                      allocatable={L.TPU_RESOURCE: "8"})
+        from tpu_operator.runtime.cache import UNLABELED_TPU
+        keys = cached.index_keys("v1", "Node", "by-accelerator")
+        assert keys == sorted([UNLABELED_TPU, "tpu-v5p-slice"])
+        union = sorted(
+            n["metadata"]["name"] for k in keys
+            for n in cached.index("v1", "Node", "by-accelerator", k))
+        assert union == ["tpu-a", "tpu-bare"]
+        fake.delete("v1", "Node", "tpu-bare")
+        assert cached.index_keys("v1", "Node", "by-accelerator") == \
+            ["tpu-v5p-slice"]
+
+    def test_unknown_index_raises(self, fake, cached):
+        with pytest.raises(KeyError, match="no index"):
+            cached.index("v1", "Pod", "by-zone", "z1")

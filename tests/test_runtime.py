@@ -239,6 +239,33 @@ class TestWorkQueue:
         assert q.get(0.01) is None
         assert q.get(0.5) == "x"
 
+    def test_snapshot_reflects_all_three_states(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("b")
+        q.add_after("c", 30.0)
+        item = q.get(0.1)          # "a" moves queued -> processing
+        snap = q.snapshot()
+        assert snap.processing == ("a",)
+        assert snap.queued == ("b",)
+        assert [k for _, k in snap.delayed] == ["c"]
+        assert not snap.idle()
+        q.done(item)
+        q.done(q.get(0.1))          # drain "b"
+        snap = q.snapshot()
+        assert snap.queued == () and snap.processing == ()
+        # "c" is due 30s out: idle under any horizon shorter than that,
+        # not idle when the horizon reaches it
+        assert not snap.idle()
+        assert snap.idle(horizon=1.0)
+        assert not snap.idle(horizon=60.0)
+
+    def test_fifo_order_preserved(self):
+        q = WorkQueue()
+        for k in ("a", "b", "c"):
+            q.add(k)
+        assert [q.get(0.1) for _ in range(3)] == ["a", "b", "c"]
+
 
 class CountingReconciler(Reconciler):
     name = "counting"
@@ -327,6 +354,49 @@ class TestController:
             mgr.wait_idle(5)
             time.sleep(0.05)
             assert Request(name="policy") in rec.seen
+        finally:
+            mgr.stop()
+
+    def test_multiple_workers_reconcile_distinct_keys_concurrently(self):
+        c = FakeClient()
+        mgr = Manager(c)
+        barrier = threading.Barrier(2, timeout=10)
+
+        class MeetingRec(CountingReconciler):
+            def reconcile(self, request):
+                # only passes if TWO requests are in flight at once —
+                # a single worker would deadlock until the barrier
+                # timeout and fail the assertion below
+                barrier.wait()
+                return super().reconcile(request)
+
+        rec = MeetingRec(c)
+        mgr.add_reconciler(rec, workers=2)
+        mgr.start()
+        try:
+            c.create(make_cm("a"))
+            c.create(make_cm("b"))
+            assert mgr.wait_idle(8)
+            names = {r.name for r in rec.seen}
+            assert {"a", "b"} <= names, rec.seen
+            assert not barrier.broken
+        finally:
+            mgr.stop()
+
+    def test_reconcile_counters_survive_concurrent_workers(self):
+        c = FakeClient()
+        mgr = Manager(c)
+        rec = CountingReconciler(c)
+        mgr.add_reconciler(rec, workers=4)
+        mgr.start()
+        try:
+            for i in range(12):
+                c.create(make_cm(f"cm-{i}"))
+            assert mgr.wait_idle(10)
+            time.sleep(0.05)
+            ctrl = mgr.controllers[0]
+            assert ctrl.reconcile_total == len(rec.seen)
+            assert ctrl.reconcile_errors == 0
         finally:
             mgr.stop()
 

@@ -7,7 +7,13 @@ state_manager.go:481-581, and ships no numbers for it):
 - install -> all-operands-Ready wall time on an N-node cluster,
 - a steady-state reconcile pass's wall time,
 - apiserver requests per steady-state pass, split by verb — the number
-  that must be O(states), not O(states x nodes).
+  that must be O(states), not O(states x nodes),
+- the same steady pass through the informer-backed
+  :class:`~tpu_operator.runtime.cache.CachedClient`: reads served from
+  the watch-fed cache, so the apiserver sees *write verbs only* and the
+  request count is independent of node count,
+- install wall time through the real threaded Manager at workers=N
+  (``run_concurrency_bench``), the MaxConcurrentReconciles knob.
 
 Used by tests/test_scale.py (budget assertions) and bench.py (the scale
 lines on the official record). Everything runs on the in-memory fake
@@ -105,6 +111,28 @@ def run_scale_bench(n_tpu: int = 500,
         steady_s = min(steady_s, time.perf_counter() - t1)
         verbs = c.reset_verb_counts()
 
+    # the same steady pass, reads served by the informer cache: a fresh
+    # reconciler on the converged cluster, its client wrapped in
+    # CachedClient. The first pass warms the informers (each kind's
+    # subscribe replays current state — the fake counts it as one LIST,
+    # the honest informer start-up cost); measurement starts after.
+    from ..runtime import CachedClient
+
+    cached = CachedClient(c)
+    crec = ClusterPolicyReconciler(client=cached, namespace="tpu-operator")
+    crec.reconcile(req)                # warm: informers subscribe + fill
+    steady_cached_s = float("inf")
+    c.reset_verb_counts()
+    reads_before = cached.cache_reads
+    for _ in range(3):
+        t1 = time.perf_counter()
+        crec.reconcile(req)
+        steady_cached_s = min(steady_cached_s, time.perf_counter() - t1)
+        verbs_cached = c.reset_verb_counts()
+        cache_reads = cached.cache_reads - reads_before
+        reads_before = cached.cache_reads
+    cached.close()
+
     return {
         "n_tpu_nodes": n_tpu,
         "n_states": n_states,
@@ -113,6 +141,56 @@ def run_scale_bench(n_tpu: int = 500,
         "steady_pass_s": steady_s,
         "steady_requests": sum(verbs.values()),
         "steady_verbs": verbs,
+        # cached figures: apiserver requests left per steady pass (write
+        # verbs only) and the reads the cache absorbed instead
+        "steady_pass_cached_s": steady_cached_s,
+        "steady_requests_cached": sum(verbs_cached.values()),
+        "steady_verbs_cached": verbs_cached,
+        "steady_cache_reads": cache_reads,
+    }
+
+
+def run_concurrency_bench(n_tpu: int = 500, workers: int = 1,
+                          timeout_s: float = 240.0) -> Dict:
+    """Install -> Ready through the real threaded Manager with
+    ``workers`` reconcile workers per controller (MaxConcurrentReconciles
+    analog) over a CachedClient, on an n_tpu-node cluster.
+
+    The kubelet simulator ticks between idle-waits, as in the e2e tier.
+    Returns {n_tpu_nodes, workers, ready, wall_s, reconciles} — the
+    datapoint tests/test_scale.py uses to assert the multi-worker
+    configuration costs nothing on the single-CR install path."""
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..runtime import CachedClient, Manager
+
+    c = build_cluster(n_tpu)
+    cached = CachedClient(c)
+    mgr = Manager(cached, namespace="tpu-operator")
+    ctrl = mgr.add_reconciler(
+        ClusterPolicyReconciler(client=cached, namespace="tpu-operator"),
+        workers=workers)
+    mgr.start()
+    t0 = time.perf_counter()
+    c.create(new_cluster_policy())
+    ready = False
+    deadline = t0 + timeout_s
+    while time.perf_counter() < deadline:
+        c.simulate_kubelet(ready=True)
+        mgr.wait_idle(timeout=30.0, horizon=1.0)
+        cr = c.get_or_none(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        if cr is not None and (cr.get("status") or {}).get("state") == "ready":
+            ready = True
+            break
+    wall = time.perf_counter() - t0
+    reconciles = ctrl.reconcile_total
+    mgr.stop()
+    cached.close()
+    return {
+        "n_tpu_nodes": n_tpu,
+        "workers": workers,
+        "ready": ready,
+        "wall_s": wall,
+        "reconciles": reconciles,
     }
 
 

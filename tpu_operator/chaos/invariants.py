@@ -23,6 +23,14 @@ point*, not just at convergence:
   :func:`~tpu_operator.controllers.slices.slice_status` computation.
   Checked only once faults stop — mid-storm a reconcile legally sets
   gauges and then loses its status write to an injected 409.
+- ``cache-staleness`` (when the controllers read through a
+  :class:`~tpu_operator.runtime.cache.CachedClient`): continuously, no
+  cached object may be *ahead* of the authoritative store — a cached
+  resourceVersion above the apiserver's means the cache invented state
+  (being behind mid-storm is legal; that's what healing is for). Once
+  settled, the cache must agree exactly: same keys, same
+  resourceVersions, for every kind it caches — a dropped watch that
+  resumed must leave no stale or phantom entries behind.
 - ``convergence``: recorded by the runner when the cluster fails to
   reach all-Ready within the soak budget after faults stop.
 
@@ -62,9 +70,11 @@ class Violation:
 
 
 class InvariantChecker:
-    def __init__(self, client: Client, namespace: str = "tpu-operator"):
+    def __init__(self, client: Client, namespace: str = "tpu-operator",
+                 cache=None):
         self.client = client
         self.namespace = namespace
+        self.cache = cache  # CachedClient under test, or None
         self.violations: List[Violation] = []
         self._last_rv: Dict[Tuple[str, str, str], int] = {}
         self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
@@ -84,6 +94,50 @@ class InvariantChecker:
         self._check_rv(step, nodes)
         self._check_fsm(step, nodes)
         self._check_budget(step, nodes)
+        self._check_cache(step, settled=False)
+
+    # -- cache coherence ----------------------------------------------------
+
+    def _authoritative_rvs(self, api_version: str,
+                           kind: str) -> Dict[tuple, str]:
+        return {(namespace_key(obj), name_of(obj)):
+                get_nested(obj, "metadata", "resourceVersion")
+                for obj in self.client.list(api_version, kind)}
+
+    def _check_cache(self, step: int, settled: bool) -> None:
+        if self.cache is None:
+            return
+        for api_version, kind in self.cache.cached_kinds():
+            cached = self.cache.store_snapshot(api_version, kind)
+            auth = self._authoritative_rvs(api_version, kind)
+            for key, rv in sorted(cached.items()):
+                want = auth.get(key)
+                if want is not None:
+                    try:
+                        ahead = int(rv) > int(want)
+                    except (TypeError, ValueError):
+                        ahead = False
+                    if ahead:
+                        self.record(
+                            "cache-staleness", step,
+                            f"{kind} {key[0]}/{key[1]}: cache rv {rv} is "
+                            f"AHEAD of apiserver rv {want}")
+                    elif settled and rv != want:
+                        self.record(
+                            "cache-staleness", step,
+                            f"{kind} {key[0]}/{key[1]}: settled cache rv "
+                            f"{rv} != apiserver rv {want}")
+                elif settled:
+                    self.record(
+                        "cache-staleness", step,
+                        f"{kind} {key[0]}/{key[1]}: phantom cache entry "
+                        f"(rv {rv}) for an object the apiserver deleted")
+            if settled:
+                for key in sorted(set(auth) - set(cached)):
+                    self.record(
+                        "cache-staleness", step,
+                        f"{kind} {key[0]}/{key[1]}: missing from cache "
+                        f"after settling (apiserver rv {auth[key]})")
 
     def _check_rv(self, step: int, nodes: Dict[str, dict]) -> None:
         tracked = list(self.client.list(V1, KIND_CLUSTER_POLICY))
@@ -221,6 +275,7 @@ class InvariantChecker:
                             f"policy {name_of(cr)}: status.slices[] "
                             f"({len(cr_rows)} rows) disagrees with a fresh "
                             f"slice_status ({len(rows)} rows)")
+        self._check_cache(step, settled=True)
 
 
 def namespace_key(obj: dict) -> str:

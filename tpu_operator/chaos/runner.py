@@ -33,7 +33,7 @@ from ..controllers.upgrade_controller import (
     UpgradeReconciler,
     desired_revision,
 )
-from ..runtime import FakeClient, Request
+from ..runtime import CachedClient, FakeClient, Request
 from ..runtime.client import (
     ApiError,
     ConflictError,
@@ -341,8 +341,14 @@ def _converged(fake: FakeClient, state: dict) -> bool:
 
 
 def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
-                 steps: Optional[int] = None) -> dict:
-    """Run one named scenario and return its deterministic verdict."""
+                 steps: Optional[int] = None, cached: bool = True) -> dict:
+    """Run one named scenario and return its deterministic verdict.
+
+    ``cached=True`` (the default, matching production) puts an
+    informer-backed :class:`~tpu_operator.runtime.cache.CachedClient`
+    between the controllers and the fault-injecting apiserver — the
+    watch-drop scenarios then exercise the cache's relist healing, and
+    the checker's ``cache-staleness`` invariant holds it to account."""
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown chaos scenario {scenario!r}; "
                          f"choose from {', '.join(SCENARIOS)}")
@@ -356,30 +362,35 @@ def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
     prev_level = op_log.level
     op_log.setLevel(logging.CRITICAL)
     try:
-        return _run_scenario(scenario, nodes, seed, steps)
+        return _run_scenario(scenario, nodes, seed, steps, cached)
     finally:
         op_log.setLevel(prev_level)
 
 
 def _run_scenario(scenario: str, nodes: int, seed: int,
-                  steps: Optional[int]) -> dict:
+                  steps: Optional[int], cached: bool) -> dict:
     n_steps = steps or DEFAULT_STEPS
     fake = build_cluster(n_tpu=nodes)
     clock = VirtualClock()
     chaos = ChaosClient(fake, clock)
+    # controllers read through the cache (which reads through the chaos
+    # client, so informer relists still eat armed faults); the adversary
+    # and the checker keep talking to the unwrapped fake
+    client = CachedClient(chaos) if cached else chaos
     fake.create(new_cluster_policy(spec={
         "upgradePolicy": {"autoUpgrade": True,
                           "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}}))
-    prec = ClusterPolicyReconciler(client=chaos, namespace=NAMESPACE)
-    urec = UpgradeReconciler(client=chaos, namespace=NAMESPACE, now=clock)
-    ctrls = [_SyncController(prec, chaos, clock),
-             _SyncController(urec, chaos, clock)]
+    prec = ClusterPolicyReconciler(client=client, namespace=NAMESPACE)
+    urec = UpgradeReconciler(client=client, namespace=NAMESPACE, now=clock)
+    ctrls = [_SyncController(prec, client, clock),
+             _SyncController(urec, client, clock)]
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
 
     state = {"marker": None, "rollout": False, "chips": {}}
     resync = Request(name=POLICY)
-    checker = InvariantChecker(fake, NAMESPACE)
+    checker = InvariantChecker(fake, NAMESPACE,
+                               cache=client if cached else None)
 
     def tick() -> None:
         # the resync add is the informer-resync analog: the liveness
@@ -407,6 +418,8 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
             "schedule": [asdict(f) for f in plan.faults],
             "faults_injected": {k: chaos.injected[k]
                                 for k in sorted(chaos.injected)},
+            "cached": cached,
+            "cache_relists": client.relists if cached else 0,
             "converged": converged,
             "soak_passes": soak,
             "convergence_virtual_s": conv_s,

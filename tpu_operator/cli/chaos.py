@@ -43,6 +43,10 @@ def main(argv=None) -> int:
                    help="fault-schedule seed; same seed, same verdict")
     r.add_argument("--steps", type=int, default=DEFAULT_STEPS,
                    help=f"fault-injection steps (default {DEFAULT_STEPS})")
+    r.add_argument("--no-cache", action="store_true",
+                   help="controllers read through to the apiserver instead "
+                        "of the informer cache (also drops the "
+                        "cache-staleness invariant)")
 
     args = p.parse_args(argv)
     if args.cmd == "list":
@@ -51,7 +55,7 @@ def main(argv=None) -> int:
         return 0
 
     verdict = run_scenario(args.scenario, nodes=args.nodes, seed=args.seed,
-                           steps=args.steps)
+                           steps=args.steps, cached=not args.no_cache)
     print(json.dumps(verdict, indent=2, sort_keys=True))
     return 0 if verdict["ok"] else 1
 

@@ -44,6 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect", action="store_true",
                    help="gate controllers behind a coordination.k8s.io "
                         "Lease (for multi-replica deployments)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="read through to the apiserver instead of the "
+                        "informer-backed cache (debugging escape hatch)")
+    p.add_argument("--workers", type=int,
+                   default=int(os.environ.get("OPERATOR_WORKERS", "1")),
+                   help="reconcile workers per controller "
+                        "(MaxConcurrentReconciles analog)")
     p.add_argument("--kubeconfig", default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
@@ -93,18 +100,31 @@ def main(argv=None) -> int:
         client = HTTPClient(cfg)
         stop = None
 
-    mgr = Manager(client, namespace=args.namespace,
+    # controllers read through the informer cache by default; the raw
+    # client stays in `client` for the kubelet sim and status polling
+    # (the "apiserver side" of the demo)
+    if args.no_cache:
+        api = client
+    else:
+        from ..runtime import CachedClient
+        api = CachedClient(client)
+
+    mgr = Manager(api, namespace=args.namespace,
                   health_port=args.health_port,
                   leader_elect=args.leader_elect)
     mgr.add_reconciler(
-        ClusterPolicyReconciler(client=client, namespace=args.namespace))
+        ClusterPolicyReconciler(client=api, namespace=args.namespace),
+        workers=args.workers)
     mgr.add_reconciler(
-        TPUDriverReconciler(client=client, namespace=args.namespace))
+        TPUDriverReconciler(client=api, namespace=args.namespace),
+        workers=args.workers)
     mgr.add_reconciler(
-        UpgradeReconciler(client=client, namespace=args.namespace))
+        UpgradeReconciler(client=api, namespace=args.namespace),
+        workers=args.workers)
     mgr.start()
-    log.info("tpu-operator started (namespace=%s, fake=%s)",
-             args.namespace, args.fake_cluster)
+    log.info("tpu-operator started (namespace=%s, fake=%s, cache=%s, "
+             "workers=%d)", args.namespace, args.fake_cluster,
+             not args.no_cache, args.workers)
 
     try:
         start = time.monotonic()

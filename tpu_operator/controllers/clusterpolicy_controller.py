@@ -90,6 +90,9 @@ class ClusterPolicyReconciler(Reconciler):
                          mapper=enqueue_owner(V1, KIND_CLUSTER_POLICY))
 
     def _enqueue_all_policies(self, event: WatchEvent) -> Iterable[Request]:
+        # runs on every matching node event; with the informer-backed
+        # CachedClient (the default wiring) this LIST never leaves the
+        # process, so a node-label storm costs no apiserver traffic
         for cr in self.client.list(V1, KIND_CLUSTER_POLICY):
             yield Request(name=name_of(cr))
 
@@ -102,8 +105,13 @@ class ClusterPolicyReconciler(Reconciler):
         try:
             return self._reconcile(request)
         finally:
-            OPERATOR_METRICS.reconcile_duration.set(
-                _time.perf_counter() - started)
+            elapsed = _time.perf_counter() - started
+            OPERATOR_METRICS.reconcile_duration.set(elapsed)
+            # the per-controller series the Controller worker also keeps;
+            # set here too so direct-driven runs (benchmarks, chaos
+            # runner) report durations without a Controller in the loop
+            OPERATOR_METRICS.reconcile_duration_by_controller.labels(
+                controller=self.name).set(elapsed)
 
     def _reconcile(self, request: Request) -> Result:
         import time as _time

@@ -107,4 +107,19 @@ class NodeInfoProvider:
         return [attributes_of(n) for n in self.nodes(node_filter)]
 
     def tpu_nodes(self) -> List[NodeAttributes]:
+        # informer fast path: the by-accelerator index files every node
+        # satisfying is_tpu (labeled ones under their accelerator type,
+        # capacity-only ones under UNLABELED_TPU), so the union of its
+        # buckets is exactly this result — O(tpu nodes), never
+        # O(cluster). Index-free clients keep the full scan.
+        has_index = getattr(self.client, "has_index", None)
+        if has_index and has_index("v1", "Node", "by-accelerator"):
+            seen = {}
+            for key in self.client.index_keys("v1", "Node",
+                                              "by-accelerator"):
+                for node in self.client.index("v1", "Node",
+                                              "by-accelerator", key):
+                    seen[name_of(node)] = node
+            return sorted((attributes_of(n) for n in seen.values()),
+                          key=lambda a: a.name)
         return self.attributes(NodeFilter().tpu_only())

@@ -126,6 +126,9 @@ class StateManager:
         (applyDriverAutoUpgradeAnnotation analog, state_manager.go:423-477,
         without the reference's second node LIST)."""
         count = 0
+        # the per-reconcile node LIST the informer cache absorbs: behind
+        # a CachedClient this pass costs the apiserver only the drift
+        # patches, so a no-drift steady pass is read-free at any N
         for node in self.client.list("v1", "Node"):
             tpu = is_tpu_node(node)
             want = desired_node_labels(node, default_config, sandbox_enabled)

@@ -23,6 +23,7 @@ from ..api.clusterpolicy import (
     TPUClusterPolicySpec,
 )
 from ..api.tpudriver import KIND_TPU_DRIVER, V1ALPHA1, TPUDriverSpec
+from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..render import Renderer
 from ..runtime import (
     Controller,
@@ -71,6 +72,19 @@ class TPUDriverReconciler(Reconciler):
         return f"tpu-driver-{cr_name}"
 
     def reconcile(self, request: Request) -> Result:
+        import time as _time
+
+        started = _time.perf_counter()
+        try:
+            return self._reconcile(request)
+        finally:
+            # same per-controller series the Controller worker keeps; set
+            # here too so direct-driven runs (benchmarks, chaos runner)
+            # report durations without a Controller in the loop
+            OPERATOR_METRICS.reconcile_duration_by_controller.labels(
+                controller=self.name).set(_time.perf_counter() - started)
+
+    def _reconcile(self, request: Request) -> Result:
         cr = self.client.get_or_none(V1ALPHA1, KIND_TPU_DRIVER, request.name)
         if cr is None:
             # deleted: owned DaemonSets go with it via ownerRef GC
@@ -97,6 +111,9 @@ class TPUDriverReconciler(Reconciler):
             return Result()  # user must fix the CR; no requeue loop
 
         spec = TPUDriverSpec.from_obj(cr)
+        # full-cluster node LIST every reconcile: served from the informer
+        # store when the manager runs a CachedClient, so pool partitioning
+        # stays O(nodes) in-process instead of an apiserver round trip
         nodes = self.client.list("v1", "Node")
         pools = get_node_pools(nodes, restrict=spec.node_selector)
 

@@ -216,6 +216,34 @@ class UpgradeReconciler(Reconciler):
 
         return pod_ready(pod)
 
+    @staticmethod
+    def _drainable(pod: dict, names: tuple) -> bool:
+        """True when this pod holds TPU chips and must leave before a
+        libtpu swap — the reference's gpuPodSpecFilter (main.go:198-209):
+        prefix-matched resource requests (isolated google.com/tpu-isolated
+        and fractional google.com/vtpu consumers count too), completed
+        pods / daemon pods / the driver itself excluded."""
+        if get_nested(pod, "metadata", "deletionTimestamp"):
+            return False
+        # completed pods hold no chips (main.go:209 phase filter)
+        if get_nested(pod, "status", "phase",
+                      default="Running") in ("Succeeded", "Failed"):
+            return False
+        if labels_of(pod).get(L.UPGRADE_SKIP_DRAIN) == "true":
+            return False
+        if labels_of(pod).get("tpu.graft.dev/component") == "libtpu-driver":
+            return False
+        # daemon pods are not drained (kubectl drain --ignore-daemonsets)
+        owners = get_nested(pod, "metadata", "ownerReferences",
+                            default=[]) or []
+        if any(o.get("kind") == "DaemonSet" for o in owners):
+            return False
+        requests = {}
+        for ctr in get_nested(pod, "spec", "containers", default=[]) or []:
+            requests.update(get_nested(ctr, "resources", "requests",
+                                       default={}) or {})
+        return any(str(r).startswith(n) for r in requests for n in names)
+
     def _tpu_workload_pods_by_node(
             self, resource_names: Optional[tuple] = None,
     ) -> Dict[str, List[dict]]:
@@ -230,35 +258,25 @@ class UpgradeReconciler(Reconciler):
         out: Dict[str, List[dict]] = {}
         for pod in self.client.list("v1", "Pod"):
             node_name = get_nested(pod, "spec", "nodeName")
-            if not node_name:
-                continue
-            if get_nested(pod, "metadata", "deletionTimestamp"):
-                continue
-            # completed pods hold no chips (main.go:209 phase filter)
-            if get_nested(pod, "status", "phase",
-                          default="Running") in ("Succeeded", "Failed"):
-                continue
-            if labels_of(pod).get(L.UPGRADE_SKIP_DRAIN) == "true":
-                continue
-            if labels_of(pod).get("tpu.graft.dev/component") == "libtpu-driver":
-                continue
-            # daemon pods are not drained (kubectl drain --ignore-daemonsets)
-            owners = get_nested(pod, "metadata", "ownerReferences",
-                                default=[]) or []
-            if any(o.get("kind") == "DaemonSet" for o in owners):
-                continue
-            requests = {}
-            for ctr in get_nested(pod, "spec", "containers", default=[]) or []:
-                requests.update(get_nested(ctr, "resources", "requests",
-                                           default={}) or {})
-            # prefix match like the reference's gpuPodSpecFilter
-            # (nvidia.com/gpu* + nvidia.com/mig-*, main.go:198-207):
-            # isolated (google.com/tpu-isolated) and fractional
-            # (google.com/vtpu) consumers hold chips too and must leave
-            # before a libtpu swap
-            if any(str(r).startswith(n) for r in requests for n in names):
+            if node_name and self._drainable(pod, names):
                 out.setdefault(node_name, []).append(pod)
         return out
+
+    def _tpu_workload_pods_on(self, node_name: str,
+                              resource_names: Optional[tuple] = None,
+    ) -> Optional[List[dict]]:
+        """Index fast path for the drain set: when the client is a
+        CachedClient, its pod-by-node index answers "which pods hold
+        chips on THIS node" in O(pods-on-node) — no cluster-wide scan.
+        Returns None when the client has no such index (the caller falls
+        back to :meth:`_tpu_workload_pods_by_node`)."""
+        index = getattr(self.client, "index", None)
+        if index is None or not self.client.has_index("v1", "Pod", "by-node"):
+            return None
+        names = tuple(resource_names or ()) + (L.TPU_RESOURCE,
+                                               L.VTPU_RESOURCE)
+        return [pod for pod in index("v1", "Pod", "by-node", node_name)
+                if self._drainable(pod, names)]
 
     # -- node label/annotation writes --------------------------------------
 
@@ -464,22 +482,29 @@ class UpgradeReconciler(Reconciler):
                  if any(m.pod is not None for m in u)
                  or any(m.state for m in u)]
 
-        # one cluster-wide pod LIST per reconcile at most, and only when
-        # something is actually draining
+        # at most one cluster-wide pod LIST per reconcile, and only when
+        # something is actually draining; with a CachedClient the by-node
+        # pod index answers per node in O(pods-on-node) instead
         workload_pods: Optional[Dict[str, List[dict]]] = None
+
+        # the configured plugin resource names: renamed shared/
+        # isolated/vTPU resources must still land in the drain set
+        dp = spec.device_plugin
+        iso = spec.isolated_device_plugin
+        drain_resource_names = tuple(n for n in (
+            dp.resource_name if dp else None,
+            iso.resource_name if iso else None,
+            iso.vtpu_resource_name if iso else None) if n)
 
         def drain_pods_on(node_name: str) -> List[dict]:
             nonlocal workload_pods
+            indexed = self._tpu_workload_pods_on(
+                node_name, resource_names=drain_resource_names)
+            if indexed is not None:
+                return indexed
             if workload_pods is None:
-                # the configured plugin resource names: renamed shared/
-                # isolated/vTPU resources must still land in the drain set
-                dp = spec.device_plugin
-                iso = spec.isolated_device_plugin
                 workload_pods = self._tpu_workload_pods_by_node(
-                    resource_names=tuple(n for n in (
-                        dp.resource_name if dp else None,
-                        iso.resource_name if iso else None,
-                        iso.vtpu_resource_name if iso else None) if n))
+                    resource_names=drain_resource_names)
             return workload_pods.get(node_name, [])
 
         budget = max(1, policy.max_parallel_upgrades or 1)

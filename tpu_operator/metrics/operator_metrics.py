@@ -98,6 +98,23 @@ class OperatorMetrics:
             "tpu_operator_chaos_invariant_violations_total",
             "Cluster invariant violations caught by the chaos checker",
             labelnames=("invariant",))
+        # concurrent-reconcile observability (runtime/manager.py workers=N
+        # + runtime/workqueue.py): queue depth and latency per controller,
+        # and per-controller reconcile wall time (the existing unlabeled
+        # tpu_operator_reconciliation_duration_seconds stays as the
+        # ClusterPolicy headline series)
+        self.workqueue_depth = g(
+            "tpu_operator_workqueue_depth",
+            "Items waiting in a controller's workqueue (incl. delayed)",
+            labelnames=("controller",))
+        self.workqueue_queue_duration = g(
+            "tpu_operator_workqueue_queue_duration_seconds",
+            "Queue latency of the most recently dequeued item",
+            labelnames=("controller",))
+        self.reconcile_duration_by_controller = g(
+            "tpu_operator_reconcile_duration_seconds",
+            "Wall time of the last reconcile, per controller",
+            labelnames=("controller",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

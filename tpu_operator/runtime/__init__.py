@@ -11,6 +11,7 @@ from .client import (  # noqa: F401
     TooManyRequestsError,
     WatchEvent,
 )
+from .cache import CachedClient, Index  # noqa: F401
 from .fake import FakeClient  # noqa: F401
 from .manager import (  # noqa: F401
     Controller,

@@ -1,0 +1,508 @@
+"""Informer-backed client-side cache: the mini controller-runtime cache.
+
+Plays the role of controller-runtime's shared informer cache (the piece
+``manager.py`` deliberately skipped in the seed): ``CachedClient`` wraps
+any :class:`~tpu_operator.runtime.client.Client` and serves ``get``/``list``
+from per-(apiVersion, kind) watch-fed stores, so a steady-state reconcile
+pass costs the apiserver *writes only* — O(states), not O(states × nodes).
+
+Design, mapped to client-go:
+
+* **Informer per kind, created lazily.** The first ``get``/``list``/
+  ``watch`` of a (apiVersion, kind) subscribes a store-feeding watch on the
+  inner client. The watch's initial ADDED replay doubles as the initial
+  LIST (both the fake and the HTTP client replay current state on
+  subscribe), so warming an informer costs exactly one list-equivalent.
+* **resourceVersion-monotonic ingest.** Store upserts never move an object
+  to an older resourceVersion — the guard that makes the replay/live-event
+  race benign (the fake delivers the subscribe-time replay outside its
+  store lock, so a newer MODIFIED can legally arrive before an older
+  replayed ADDED).
+* **Write-through.** Every write passes to the inner client and the
+  returned (authoritative) object is upserted into the store, giving
+  read-your-writes even while a watch is down: ``get`` after your own
+  ``update`` never returns a staler resourceVersion.
+* **Heal-by-relist.** A dropped-then-resumed watch replays ADDED for every
+  live object. An ADDED for a key the store already holds at the *same*
+  resourceVersion cannot happen on a healthy stream (creates mint fresh
+  RVs; our own write echoes are recognised via the write-through ledger),
+  so it is the signature of a resumed stream — the store marks itself
+  dirty and the next read relists through the inner client and prunes
+  keys that vanished during the gap (the 410-Gone relist analog; the
+  chaos plane's ``watch-flap`` scenario drives exactly this path).
+* **Copy-on-read.** Readers get deep copies; reconcilers mutate their
+  result dicts freely without corrupting the shared store, same contract
+  as the inner clients.
+* **Pluggable indexes.** ``Index(name, key_func)`` per kind; built-ins
+  cover pod-by-node, pod-by-owner-uid, node-by-accelerator-label, and an
+  automatic by-label index that turns plain ``{k: v}`` label-selector
+  lists into bucket intersections instead of full scans.
+
+Everything above is threading-safe; under the single-threaded chaos
+runner it is also fully deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from ..api import labels as L
+from .client import Client, ListOptions, NotFoundError, WatchEvent
+from .objects import (
+    deepcopy_obj,
+    get_nested,
+    is_namespaced,
+    labels_of,
+    match_labels,
+    name_of,
+    namespace_of,
+    obj_key,
+)
+
+
+class Index:
+    """A named secondary index: ``key_func(obj)`` yields the bucket keys the
+    object files under (zero keys = not indexed). The analog of client-go's
+    ``cache.Indexers`` entry."""
+
+    def __init__(self, name: str, key_func: Callable[[dict], Iterable[str]]):
+        self.name = name
+        self.key_func = key_func
+
+    def keys(self, obj: dict) -> tuple:
+        return tuple(k for k in self.key_func(obj) if k)
+
+
+# The automatic per-kind label index: one bucket per "key=value" label pair.
+# A plain-dict label-selector list intersects its pairs' buckets instead of
+# scanning the store.
+BY_LABEL = "by-label"
+
+
+def _label_pairs(obj: dict) -> Iterable[str]:
+    return [f"{k}={v}" for k, v in labels_of(obj).items()]
+
+
+def _pod_node(obj: dict) -> Iterable[str]:
+    node = get_nested(obj, "spec", "nodeName")
+    return [node] if node else []
+
+
+def _owner_uids(obj: dict) -> Iterable[str]:
+    return [r.get("uid") for r in
+            get_nested(obj, "metadata", "ownerReferences", default=[]) or []
+            if r.get("uid")]
+
+
+#: Bucket for TPU nodes exposing google.com/tpu capacity without the
+#: accelerator label — keeps the by-accelerator bucket union equal to
+#: the full TPU node set (nodeinfo's is_tpu predicate), so index-backed
+#: callers never miss an unlabeled node.
+UNLABELED_TPU = "(unlabeled)"
+
+
+def _node_accelerator(obj: dict) -> Iterable[str]:
+    accel = labels_of(obj).get(L.GKE_TPU_ACCELERATOR)
+    if accel:
+        return [accel]
+    if get_nested(obj, "status", "allocatable", L.TPU_RESOURCE,
+                  default=None):
+        return [UNLABELED_TPU]
+    return []
+
+
+#: Secondary indexes installed on every informer of the matching kind
+#: (callers can pass ``extra_indexes`` for more). The by-label index is
+#: always installed and not listed here.
+DEFAULT_INDEXES: dict[tuple, tuple] = {
+    ("v1", "Pod"): (Index("by-node", _pod_node),
+                    Index("by-owner-uid", _owner_uids)),
+    ("v1", "Node"): (Index("by-accelerator", _node_accelerator),),
+}
+
+
+def _rv_int(obj: Optional[dict]) -> Optional[int]:
+    rv = get_nested(obj or {}, "metadata", "resourceVersion")
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return None
+
+
+class _Store:
+    """One informer's object store + indexes. All mutation under ``lock``."""
+
+    def __init__(self, api_version: str, kind: str, indexes: tuple):
+        self.api_version = api_version
+        self.kind = kind
+        self.lock = threading.RLock()
+        self.objects: dict[tuple, dict] = {}          # (ns, name) -> obj
+        self.indexes: dict[str, Index] = {BY_LABEL: Index(BY_LABEL, _label_pairs)}
+        for idx in indexes:
+            self.indexes[idx.name] = idx
+        self._buckets: dict[str, dict[str, set]] = {n: {} for n in self.indexes}
+        self._obj_keys: dict[tuple, dict[str, tuple]] = {}  # key -> {index: keys}
+        # write-through ledger: key -> resourceVersion we wrote; lets the
+        # ingest path tell "echo of our own write" from "resumed-stream
+        # replay" when an ADDED arrives at an RV we already hold
+        self.written_rvs: dict[tuple, str] = {}
+        self.needs_relist = False
+        self.relist_lock = threading.Lock()
+        self.relist_total = 0
+        self.started = threading.Event()
+
+    # -- keys ---------------------------------------------------------------
+
+    def key_of(self, obj: dict) -> tuple:
+        ns = namespace_of(obj) if is_namespaced(self.kind) else ""
+        return (ns, name_of(obj))
+
+    # -- mutation (callers hold no lock) ------------------------------------
+
+    def upsert(self, obj: dict) -> str:
+        """RV-monotonic insert/replace. Returns ``"new"``, ``"replaced"``,
+        ``"same"`` (identical RV already held) or ``"stale"`` (older than
+        held — dropped)."""
+        key = self.key_of(obj)
+        new_rv = _rv_int(obj)
+        with self.lock:
+            cur = self.objects.get(key)
+            if cur is not None:
+                cur_rv = _rv_int(cur)
+                if new_rv is not None and cur_rv is not None:
+                    if new_rv < cur_rv:
+                        return "stale"
+                    if new_rv == cur_rv:
+                        return "same"
+            self._unindex(key)
+            self.objects[key] = obj
+            self._index(key, obj)
+            return "replaced" if cur is not None else "new"
+
+    def remove(self, obj_or_key) -> None:
+        key = (obj_or_key if isinstance(obj_or_key, tuple)
+               else self.key_of(obj_or_key))
+        with self.lock:
+            if self.objects.pop(key, None) is not None:
+                self._unindex(key)
+            self.written_rvs.pop(key, None)
+
+    def _index(self, key: tuple, obj: dict) -> None:
+        filed = {}
+        for name, idx in self.indexes.items():
+            keys = idx.keys(obj)
+            if keys:
+                filed[name] = keys
+                buckets = self._buckets[name]
+                for k in keys:
+                    buckets.setdefault(k, set()).add(key)
+        if filed:
+            self._obj_keys[key] = filed
+
+    def _unindex(self, key: tuple) -> None:
+        for name, keys in self._obj_keys.pop(key, {}).items():
+            buckets = self._buckets[name]
+            for k in keys:
+                bucket = buckets.get(k)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del buckets[k]
+
+    # -- reads (lock held by caller via ``with store.lock``) ---------------
+
+    def select_by_label_locked(self, selector: dict) -> list:
+        """Bucket-intersect a plain {k: v} selector. O(result), not O(store)."""
+        smallest: Optional[set] = None
+        buckets = self._buckets[BY_LABEL]
+        for k, v in selector.items():
+            bucket = buckets.get(f"{k}={v}")
+            if not bucket:
+                return []
+            if smallest is None or len(bucket) < len(smallest):
+                smallest = bucket
+        if smallest is None:  # empty selector: everything matches
+            return list(self.objects.values())
+        pairs = {f"{k}={v}" for k, v in selector.items()}
+        out = []
+        for key in smallest:
+            filed = self._obj_keys.get(key, {}).get(BY_LABEL, ())
+            if pairs.issubset(filed):
+                out.append(self.objects[key])
+        return out
+
+
+class CachedClient(Client):
+    """Informer-backed read cache over any ``Client``. See module docstring.
+
+    Reads (``get``/``list``/``index``) are served from watch-fed stores;
+    writes pass through to ``inner`` and write-through into the store.
+    ``watch`` registrations are delegated to ``inner`` *after* the kind's
+    informer is subscribed, so by the time a controller's handler fires,
+    the cache already reflects that event — a reconcile triggered by an
+    event never reads a cache older than the event itself.
+    """
+
+    def __init__(self, inner: Client,
+                 extra_indexes: Optional[dict] = None):
+        self.inner = inner
+        self._stores: dict[tuple, _Store] = {}
+        self._meta = threading.Lock()
+        self._cancels: list[Callable[[], None]] = []
+        self._extra = dict(extra_indexes or {})
+        self._closed = False
+        # observability for the bench/tests: reads served without touching
+        # the apiserver, and heals performed
+        self.cache_reads = 0
+        self.relists = 0
+
+    # -- informer lifecycle -------------------------------------------------
+
+    def _ensure(self, api_version: str, kind: str) -> _Store:
+        gvk = (api_version, kind)
+        with self._meta:
+            store = self._stores.get(gvk)
+            if store is None:
+                indexes = (tuple(DEFAULT_INDEXES.get(gvk, ()))
+                           + tuple(self._extra.get(gvk, ())))
+                store = _Store(api_version, kind, indexes)
+                self._stores[gvk] = store
+                creator = True
+            else:
+                creator = False
+        if creator:
+            # subscribe outside the meta lock: the inner watch replays
+            # ADDED for every live object synchronously, feeding the store
+            # its initial state (the informer's initial LIST)
+            cancel = self.inner.watch(api_version, kind,
+                                      self._ingest_handler(store))
+            with self._meta:
+                self._cancels.append(cancel)
+            store.started.set()
+        else:
+            store.started.wait(timeout=30.0)
+        return store
+
+    def _ingest_handler(self, store: _Store):
+        def handler(event: WatchEvent):
+            if event.type == "DELETED":
+                store.remove(event.obj)
+                return
+            # the hub shares one event object between subscribers; own our copy
+            obj = deepcopy_obj(event.obj)
+            outcome = store.upsert(obj)
+            if event.type == "ADDED" and outcome in ("same", "stale"):
+                key = store.key_of(obj)
+                rv = get_nested(obj, "metadata", "resourceVersion")
+                with store.lock:
+                    own_echo = store.written_rvs.get(key) == rv
+                    if own_echo:
+                        store.written_rvs.pop(key, None)
+                if not own_echo:
+                    # replayed state from a resumed stream: deletions that
+                    # happened during the gap are invisible to the replay,
+                    # so schedule a relist to prune them
+                    store.needs_relist = True
+        return handler
+
+    def _maybe_relist(self, store: _Store) -> None:
+        if not store.needs_relist:
+            return
+        with store.relist_lock:
+            if not store.needs_relist:
+                return
+            self._relist(store)
+
+    def _relist(self, store: _Store) -> None:
+        """Full list through the inner client + prune: the 410-Gone heal.
+        May raise (the inner client is allowed to fail); the dirty flag
+        stays set so the next read retries."""
+        with store.lock:
+            pre = {k: _rv_int(o) for k, o in store.objects.items()}
+        listed = self.inner.list(store.api_version, store.kind)
+        listed_keys = set()
+        for obj in listed:
+            listed_keys.add(store.key_of(obj))
+            store.upsert(obj)
+        with store.lock:
+            for key in list(store.objects):
+                if key in listed_keys or key not in pre:
+                    continue  # seen by the list, or newer than our snapshot
+                if _rv_int(store.objects[key]) == pre[key]:
+                    store.remove(key)
+            store.needs_relist = False
+            store.relist_total += 1
+        self.relists += 1
+
+    def resync(self) -> None:
+        """Force a relist of every cached kind (client-go resync analog)."""
+        for store in list(self._stores.values()):
+            with store.relist_lock:
+                self._relist(store)
+
+    # -- reads: served from the store ---------------------------------------
+
+    def get(self, api_version, kind, name, namespace=None,
+            metadata_only=False):
+        if self._closed:
+            return self.inner.get(api_version, kind, name, namespace=namespace,
+                                  metadata_only=metadata_only)
+        store = self._ensure(api_version, kind)
+        self._maybe_relist(store)
+        ns = namespace or "" if is_namespaced(kind) else ""
+        with store.lock:
+            obj = store.objects.get((ns, name))
+            if obj is not None:
+                obj = deepcopy_obj(obj)
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+        self.cache_reads += 1
+        return obj
+
+    def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        if self._closed:
+            return self.inner.list(api_version, kind, opts)
+        store = self._ensure(api_version, kind)
+        self._maybe_relist(store)
+        opts = opts or ListOptions()
+        sel = opts.label_selector
+        plain_selector = (
+            sel is not None and isinstance(sel, dict) and sel
+            and "matchLabels" not in sel and "matchExpressions" not in sel)
+        out = []
+        with store.lock:
+            if plain_selector:
+                candidates = store.select_by_label_locked(sel)
+                sel_checked = True
+            else:
+                candidates = store.objects.values()
+                sel_checked = sel is None
+            for obj in candidates:
+                if opts.namespace and namespace_of(obj) != opts.namespace:
+                    continue
+                if not sel_checked and not match_labels(labels_of(obj), sel):
+                    continue
+                if opts.field_selector:
+                    fs = opts.field_selector
+                    if ("metadata.name" in fs
+                            and name_of(obj) != fs["metadata.name"]):
+                        continue
+                    if ("metadata.namespace" in fs
+                            and namespace_of(obj) != fs["metadata.namespace"]):
+                        continue
+                out.append(deepcopy_obj(obj))
+        out.sort(key=obj_key)
+        self.cache_reads += 1
+        return out
+
+    def index(self, api_version: str, kind: str, index_name: str,
+              key: str) -> list:
+        """All cached objects of (api_version, kind) filed under ``key`` in
+        ``index_name`` — O(result) with copy-on-read, e.g.
+        ``index("v1", "Pod", "by-node", node_name)``."""
+        store = self._ensure(api_version, kind)
+        self._maybe_relist(store)
+        with store.lock:
+            if index_name not in store.indexes:
+                raise KeyError(
+                    f"no index {index_name!r} on {api_version}/{kind}")
+            keys = store._buckets[index_name].get(key, ())
+            out = [deepcopy_obj(store.objects[k]) for k in keys]
+        out.sort(key=obj_key)
+        self.cache_reads += 1
+        return out
+
+    def index_keys(self, api_version: str, kind: str,
+                   index_name: str) -> list:
+        """Sorted bucket keys currently populated in ``index_name`` —
+        e.g. every distinct accelerator type in the cluster via
+        ``index_keys("v1", "Node", "by-accelerator")``. Unioning
+        ``index()`` over these keys yields every indexed object without
+        scanning unindexed ones."""
+        store = self._ensure(api_version, kind)
+        self._maybe_relist(store)
+        with store.lock:
+            if index_name not in store.indexes:
+                raise KeyError(
+                    f"no index {index_name!r} on {api_version}/{kind}")
+            return sorted(k for k, v in
+                          store._buckets[index_name].items() if v)
+
+    def has_index(self, api_version: str, kind: str, index_name: str) -> bool:
+        gvk = (api_version, kind)
+        indexes = (tuple(DEFAULT_INDEXES.get(gvk, ()))
+                   + tuple(self._extra.get(gvk, ())))
+        return any(i.name == index_name for i in indexes)
+
+    # -- introspection (chaos invariants / bench) ---------------------------
+
+    def cached_kinds(self) -> list:
+        with self._meta:
+            return sorted(self._stores)
+
+    def store_snapshot(self, api_version: str, kind: str) -> dict:
+        """(ns, name) -> resourceVersion for every cached object of the
+        kind; no informer is created if none exists."""
+        store = self._stores.get((api_version, kind))
+        if store is None:
+            return {}
+        with store.lock:
+            return {k: get_nested(o, "metadata", "resourceVersion")
+                    for k, o in store.objects.items()}
+
+    # -- writes: pass through + write-through ---------------------------------
+
+    def _write_through(self, obj: dict) -> dict:
+        store = self._stores.get((obj.get("apiVersion", ""),
+                                  obj.get("kind", "")))
+        if store is not None:
+            copy = deepcopy_obj(obj)
+            key = store.key_of(copy)
+            rv = get_nested(copy, "metadata", "resourceVersion")
+            with store.lock:
+                if store.upsert(copy) in ("new", "replaced") and rv:
+                    store.written_rvs[key] = rv
+        return obj
+
+    def create(self, obj):
+        return self._write_through(self.inner.create(obj))
+
+    def update(self, obj):
+        return self._write_through(self.inner.update(obj))
+
+    def update_status(self, obj):
+        return self._write_through(self.inner.update_status(obj))
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        return self._write_through(
+            self.inner.patch(api_version, kind, name, patch,
+                             namespace=namespace))
+
+    def delete(self, api_version, kind, name, namespace=None):
+        self.inner.delete(api_version, kind, name, namespace=namespace)
+        store = self._stores.get((api_version, kind))
+        if store is not None:
+            ns = namespace or "" if is_namespaced(kind) else ""
+            store.remove((ns, name))
+
+    # -- watch / lifecycle ----------------------------------------------------
+
+    def watch(self, api_version, kind, handler):
+        # informer first: its store handler is subscribed before the
+        # caller's, so the cache is never behind the event a controller
+        # is reacting to
+        self._ensure(api_version, kind)
+        return self.inner.watch(api_version, kind, handler)
+
+    def close(self):
+        self._closed = True
+        with self._meta:
+            cancels, self._cancels = self._cancels, []
+        for cancel in cancels:
+            try:
+                cancel()
+            except Exception:  # pragma: no cover - defensive teardown
+                pass
+        if hasattr(self.inner, "close"):
+            self.inner.close()

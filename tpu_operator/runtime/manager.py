@@ -5,10 +5,17 @@ entrypoint (cmd/gpu-operator/main.go:72-220): reconcilers register watches
 with predicates, events map to requests on a rate-limited workqueue, worker
 threads drive Reconcile, and the manager serves /healthz and /metrics.
 
-Deliberate simplifications, matching how the reference actually runs:
-MaxConcurrentReconciles is 1 per controller (clusterpolicy_controller.go:357
-sets the same), and caches are read-through (every Get/List hits the client,
-which for the fake client is in-memory anyway).
+Two knobs the seed deliberately pinned are now open:
+
+* ``workers=N`` per controller (MaxConcurrentReconciles analog; the
+  reference pins 1, clusterpolicy_controller.go:357, but the runtime no
+  longer has to). Per-key serialization is preserved however many workers
+  drain the queue — the WorkQueue's processing/dirty sets guarantee a key
+  is never reconciled by two workers at once.
+* Reads can be served from an informer-backed cache instead of
+  read-through: wrap the client in :class:`~.cache.CachedClient` before
+  handing it to the manager and every controller Get/List is O(cache),
+  with only writes reaching the apiserver.
 """
 
 from __future__ import annotations
@@ -19,6 +26,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Iterable, Optional
 
+from ..metrics.operator_metrics import OPERATOR_METRICS
 from .client import Client, WatchEvent
 from .objects import get_nested, name_of, namespace_of
 from .workqueue import RateLimiter, WorkQueue
@@ -126,36 +134,59 @@ def enqueue_constant(name: str, namespace: str = ""):
 
 
 class Controller:
-    """One reconciler + its watches + its queue + its worker thread."""
+    """One reconciler + its watches + its queue + its worker threads.
+
+    ``workers`` is the MaxConcurrentReconciles analog: N worker threads
+    drain one queue. Distinct keys reconcile concurrently; the same key
+    never does (WorkQueue's processing set defers a re-add of an in-flight
+    key to its ``done``)."""
 
     def __init__(self, name: str, reconciler: Reconciler, client: Client,
-                 rate_limiter: Optional[RateLimiter] = None):
+                 rate_limiter: Optional[RateLimiter] = None,
+                 workers: int = 1):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
         self.name = name
         self.reconciler = reconciler
         self.client = client
+        self.workers = workers
         self.queue = WorkQueue(rate_limiter or RateLimiter(0.1, 3.0))
         self._watch_cancels: list[Callable[[], None]] = []
+        # _last_seen feeds predicates their "old" object; watch events can
+        # arrive from any publishing thread, so all access is under a lock
         self._last_seen: dict[tuple, dict] = {}
+        self._seen_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
         self._stopped = threading.Event()
+        # reconcile counters shared by N workers: guarded, not bare ints
+        self._stats_lock = threading.Lock()
         self.reconcile_errors = 0
         self.reconcile_total = 0
+
+    def _count_reconcile(self, error: bool) -> None:
+        with self._stats_lock:
+            self.reconcile_total += 1
+            if error:
+                self.reconcile_errors += 1
 
     def watch(self, api_version: str, kind: str,
               predicate: Callable[[WatchEvent, Optional[dict]], bool] = any_event,
               mapper: Callable[[WatchEvent], Iterable[Request]] = enqueue_object) -> None:
         def handler(event: WatchEvent):
             key = (api_version, kind, namespace_of(event.obj), name_of(event.obj))
-            old = self._last_seen.get(key)
-            if event.type == "DELETED":
-                self._last_seen.pop(key, None)
-            else:
-                self._last_seen[key] = event.obj
+            with self._seen_lock:
+                old = self._last_seen.get(key)
+                if event.type == "DELETED":
+                    self._last_seen.pop(key, None)
+                else:
+                    self._last_seen[key] = event.obj
             try:
                 if not predicate(event, old):
                     return
                 for req in mapper(event):
                     self.queue.add(req)
+                OPERATOR_METRICS.workqueue_depth.labels(
+                    controller=self.name).set(len(self.queue))
             except Exception:  # watch handlers must never kill the stream
                 log.exception("[%s] watch handler failed for %s/%s",
                               self.name, kind, name_of(event.obj))
@@ -163,13 +194,17 @@ class Controller:
         self._watch_cancels.append(self.client.watch(api_version, kind, handler))
 
     def _worker(self):
+        import time as _time
         while not self._stopped.is_set():
             req = self.queue.get(timeout=0.5)
             if req is None:
                 continue
+            OPERATOR_METRICS.workqueue_queue_duration.labels(
+                controller=self.name).set(self.queue.last_wait)
+            started = _time.perf_counter()
             try:
-                self.reconcile_total += 1
                 result = self.reconciler.reconcile(req)
+                self._count_reconcile(error=False)
                 if result and result.requeue_after > 0:
                     self.queue.forget(req)
                     self.queue.add_after(req, result.requeue_after)
@@ -180,16 +215,22 @@ class Controller:
                 else:
                     self.queue.forget(req)
             except Exception:
-                self.reconcile_errors += 1
+                self._count_reconcile(error=True)
                 log.exception("[%s] reconcile %s failed", self.name, req)
                 self.queue.add_rate_limited(req)
             finally:
+                OPERATOR_METRICS.reconcile_duration_by_controller.labels(
+                    controller=self.name).set(_time.perf_counter() - started)
                 self.queue.done(req)
+                OPERATOR_METRICS.workqueue_depth.labels(
+                    controller=self.name).set(len(self.queue))
 
     def start(self):
-        t = threading.Thread(target=self._worker, name=f"ctrl-{self.name}", daemon=True)
-        t.start()
-        self._threads.append(t)
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"ctrl-{self.name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def stop(self):
         self._stopped.set()
@@ -218,14 +259,7 @@ class Controller:
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            with self.queue._cond:
-                delayed = self.queue._delayed
-                if horizon is not None:
-                    cut = time.monotonic() + horizon
-                    delayed = [d for d in delayed if d[0] <= cut]
-                busy = (self.queue._queue or self.queue._processing
-                        or delayed)
-            if not busy:
+            if self.queue.snapshot().idle(horizon=horizon):
                 return True
             time.sleep(0.01)
         return False
@@ -281,8 +315,10 @@ class Manager:
         os._exit(1)
 
     def add_reconciler(self, reconciler: Reconciler,
-                       rate_limiter: Optional[RateLimiter] = None) -> Controller:
-        ctrl = Controller(reconciler.name, reconciler, self.client, rate_limiter)
+                       rate_limiter: Optional[RateLimiter] = None,
+                       workers: int = 1) -> Controller:
+        ctrl = Controller(reconciler.name, reconciler, self.client,
+                          rate_limiter, workers=workers)
         self.controllers.append(ctrl)
         reconciler.setup_controller(ctrl, self)  # type: ignore[attr-defined]
         return ctrl

@@ -11,6 +11,8 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+from collections import deque
+from dataclasses import dataclass
 from typing import Any, Optional
 
 
@@ -38,24 +40,53 @@ class RateLimiter:
             return self._failures.get(item, 0)
 
 
+@dataclass(frozen=True)
+class QueueSnapshot:
+    """Point-in-time view of a WorkQueue (``WorkQueue.snapshot()``): what is
+    queued, what a worker holds, and what is parked with its due time
+    (``time.monotonic`` clock). Lets callers like ``Controller.wait_idle``
+    reason about idleness without touching queue internals."""
+
+    queued: tuple
+    processing: tuple
+    delayed: tuple  # of (due_monotonic, item)
+
+    def idle(self, horizon: Optional[float] = None) -> bool:
+        """True when nothing is queued or in flight. With ``horizon``,
+        delayed items due more than ``horizon`` seconds out don't count —
+        a parked periodic resync shouldn't make the queue look busy."""
+        if self.queued or self.processing:
+            return False
+        if horizon is None:
+            return not self.delayed
+        cut = time.monotonic() + horizon
+        return not any(due <= cut for due, _ in self.delayed)
+
+
 class WorkQueue:
     """Thread-safe delaying queue with dedup of pending items.
 
     Semantics match client-go's workqueue closely enough for our manager:
     an item queued while being processed is re-queued when done; duplicate
-    adds collapse.
+    adds collapse. Multiple consumers are safe — ``get``'s processing set
+    plus ``add``'s dirty marking give per-item serialization however many
+    workers drain the queue.
     """
 
     def __init__(self, rate_limiter: Optional[RateLimiter] = None):
         self.rate_limiter = rate_limiter or RateLimiter()
         self._cond = threading.Condition()
-        self._queue: list[Any] = []
+        self._queue: deque[Any] = deque()
         self._pending: set = set()
         self._processing: set = set()
         self._dirty: set = set()
         self._delayed: list[tuple[float, int, Any]] = []
+        self._enqueued_at: dict[Any, float] = {}
         self._seq = 0
         self._shutdown = False
+        # queue latency of the most recently dequeued item (seconds spent
+        # between add and get) — the workqueue_queue_duration observable
+        self.last_wait = 0.0
 
     def add(self, item: Any) -> None:
         with self._cond:
@@ -67,6 +98,7 @@ class WorkQueue:
             if item in self._pending:
                 return
             self._pending.add(item)
+            self._enqueued_at.setdefault(item, time.monotonic())
             self._queue.append(item)
             self._cond.notify()
 
@@ -97,6 +129,7 @@ class WorkQueue:
                 heapq.heappop(self._delayed)
                 if item not in self._pending and item not in self._processing:
                     self._pending.add(item)
+                    self._enqueued_at.setdefault(item, now)
                     self._queue.append(item)
                 elif item in self._processing:
                     self._dirty.add(item)
@@ -112,9 +145,12 @@ class WorkQueue:
             while True:
                 wait = self._promote_delayed_locked()
                 if self._queue:
-                    item = self._queue.pop(0)
+                    item = self._queue.popleft()
                     self._pending.discard(item)
                     self._processing.add(item)
+                    added = self._enqueued_at.pop(item, None)
+                    if added is not None:
+                        self.last_wait = time.monotonic() - added
                     return item
                 if self._shutdown:
                     return None
@@ -132,8 +168,17 @@ class WorkQueue:
                 self._dirty.discard(item)
                 if item not in self._pending:
                     self._pending.add(item)
+                    self._enqueued_at.setdefault(item, time.monotonic())
                     self._queue.append(item)
                     self._cond.notify()
+
+    def snapshot(self) -> QueueSnapshot:
+        """Consistent point-in-time view of queued/processing/delayed."""
+        with self._cond:
+            return QueueSnapshot(
+                queued=tuple(self._queue),
+                processing=tuple(self._processing),
+                delayed=tuple((due, item) for due, _, item in self._delayed))
 
     def shutdown(self) -> None:
         with self._cond:
