@@ -394,6 +394,11 @@ def _controlplane_doc() -> dict | None:
             "steady_requests_cached": r["steady_requests_cached"],
             "steady_verbs_cached": r["steady_verbs_cached"],
             "steady_cache_reads": r["steady_cache_reads"],
+            # reconcile latency percentiles over the steady passes, from
+            # the tpu_operator_reconcile_duration_seconds histogram
+            "reconcile_latency_ms": (
+                {k: round(v, 4) for k, v in r["reconcile_latency_ms"].items()}
+                if r.get("reconcile_latency_ms") else None),
             "vs_baseline": round(
                 INSTALL_BUDGET_S / max(r["install_to_ready_s"], 1e-9), 2)
             if r["ready"] else 0.0,

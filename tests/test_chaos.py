@@ -112,3 +112,44 @@ class TestBrokenControllerIsCaught:
         # every other reconcile dying is survivable: retries land the rest
         assert isinstance(v["ok"], bool)
         assert "violations" in v
+
+
+class TestVerdictEmbedsTraces:
+    def test_upgrade_under_fire_verdict_carries_complete_trace(self):
+        """The flight recorder rides the chaos verdict: the slowest trace
+        must be complete (root + child spans, including a client verb
+        span with its cache/api source tag) and, being stamped by the
+        virtual clock, byte-identical across same-seed runs."""
+        runs = [run_scenario("upgrade-under-fire", nodes=24, seed=5)
+                for _ in range(2)]
+        payloads = [json.dumps(v, indent=2, sort_keys=True) for v in runs]
+        assert payloads[0] == payloads[1]
+
+        v = runs[0]
+        assert v["ok"] is True
+        slowest = v["traces"]["slowest"]
+        assert slowest is not None
+        root = slowest["root"]
+        assert root["name"] == "reconcile"
+        assert len(root["children"]) >= 3
+        assert slowest["controller"] and slowest["key"]
+        assert slowest["outcome"] in ("ok", "error")
+
+        def walk(span):
+            yield span
+            for child in span.get("children", []):
+                yield from walk(child)
+
+        client_spans = [s for s in walk(root)
+                        if s["name"].startswith("client:")]
+        assert client_spans, "no client verb span in the slowest trace"
+        assert all(s["tags"]["source"] in ("cache", "api")
+                   for s in client_spans)
+        # the scenario injects apiserver faults, so reconciles DO fail;
+        # each failed trace is pinned and shipped whole
+        for failed in v["traces"]["failed"]:
+            assert failed["outcome"] == "error"
+            assert failed["error"]
+            assert failed["root"]["name"] == "reconcile"
+        # virtual-clock timestamps: no wall-clock leakage in durations
+        assert slowest["duration_s"] == root["duration_s"]

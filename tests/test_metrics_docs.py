@@ -1,0 +1,98 @@
+"""Metric <-> documentation parity.
+
+Two-way contract between the Prometheus series the code registers and
+the series OPERATIONS.md documents: every `tpu_operator_*` token in the
+docs must be a real series (no doc drift after a rename), and every
+registered operator series must appear in OPERATIONS.md's table (no
+silent series additions).
+"""
+
+import pathlib
+import re
+
+from prometheus_client import CollectorRegistry
+
+from tpu_operator.metrics.operator_metrics import OperatorMetrics
+from tpu_operator.validator.metrics import NodeMetrics
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# name, optionally followed by a {...} group (labels, or a brace
+# expansion when the name ends with "_"); whitespace allowed inside
+# braces because the docs wrap long groups across lines
+TOKEN_RE = re.compile(r"(tpu_operator_[a-z0-9_]+)(\{([a-zA-Z0-9_,\s]+)\})?")
+
+
+def registered_families():
+    """(name, type) for every operator + node-exporter family."""
+    reg = CollectorRegistry()
+    OperatorMetrics(registry=reg)
+    fams = [(f.name, f.type) for f in reg.collect()]
+    node = NodeMetrics(node_name="doc-parity")
+    for attr in vars(node).values():
+        if hasattr(attr, "_name") and hasattr(attr, "_type"):
+            fams.append((attr._name, attr._type))
+    return fams
+
+
+def accepted_sample_names():
+    """Every name a doc may legitimately use for a registered family."""
+    names = set()
+    for name, typ in registered_families():
+        names.add(name)
+        if typ == "counter":
+            names.add(name + "_total")
+        elif typ == "histogram":
+            names.update({name + s for s in ("_bucket", "_sum", "_count")})
+    return names
+
+
+def doc_tokens(text):
+    """All series names a doc references, brace groups expanded."""
+    out = set()
+    for name, _, group in TOKEN_RE.findall(text):
+        if name.endswith("_"):
+            if not group:
+                continue  # wildcard like tpu_operator_chaos_*
+            for item in group.split(","):
+                item = item.strip()
+                if item:
+                    out.add(name + item)
+        else:
+            out.add(name)  # {controller} etc. is a label annotation
+    return out
+
+
+def test_docs_reference_only_real_series():
+    accepted = accepted_sample_names()
+    for doc in ("OPERATIONS.md", "MIGRATION.md"):
+        tokens = doc_tokens((REPO / doc).read_text())
+        assert tokens, f"{doc} mentions no tpu_operator_ series at all?"
+        unknown = sorted(tokens - accepted)
+        assert not unknown, (
+            f"{doc} references series that the code does not register "
+            f"(stale after a rename?): {unknown}")
+
+
+def test_operations_documents_every_operator_series():
+    text = (REPO / "OPERATIONS.md").read_text()
+    tokens = doc_tokens(text)
+    missing = []
+    for name, typ in registered_families():
+        shown = name + "_total" if typ == "counter" else name
+        if shown not in tokens and name not in tokens:
+            missing.append(shown)
+    assert not missing, (
+        "series registered in code but absent from OPERATIONS.md "
+        f"(add them to the series table): {sorted(missing)}")
+
+
+def test_operations_series_count_is_current():
+    reg = CollectorRegistry()
+    OperatorMetrics(registry=reg)
+    n = len(list(reg.collect()))
+    text = (REPO / "OPERATIONS.md").read_text()
+    m = re.search(r"\((\d+) series:", text)
+    assert m, "OPERATIONS.md lost its '(N series:' summary"
+    assert int(m.group(1)) == n, (
+        f"OPERATIONS.md says {m.group(1)} series, the registry has {n}")

@@ -1,0 +1,472 @@
+"""Tracing plane tests: span trees, ring/pinning discipline, the kill
+switch, TracingClient verb spans + source tagging, the EventRecorder 409
+retry, must-gather's metrics/traces files, and the tpuop-cfg trace
+renderer."""
+
+import json
+
+import pytest
+
+from tpu_operator.api import new_cluster_policy
+from tpu_operator.api import labels as L
+from tpu_operator.runtime import (
+    CachedClient,
+    ConflictError,
+    FakeClient,
+    Request,
+)
+from tpu_operator.runtime.tracing import (
+    TRACER,
+    Tracer,
+    TracingClient,
+    env_trace_enabled,
+)
+
+NS = "tpu-operator"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def make_tpu_client():
+    c = FakeClient()
+    c.add_node("tpu-0", labels={
+        L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+        L.GKE_TPU_TOPOLOGY: "2x2x1",
+        L.GKE_ACCELERATOR_COUNT: "4"},
+        allocatable={"google.com/tpu": "4"})
+    return c
+
+
+class TestTracerCore:
+    def test_span_tree_structure_and_tags(self):
+        clk = FakeClock()
+        t = Tracer(clock=clk, enabled=True)
+        with t.trace("ctl", "ns/key", queue_wait_s=0.25):
+            clk.advance(1.0)
+            with t.span("child-a", color="red"):
+                clk.advance(2.0)
+                with t.span("grandchild"):
+                    clk.advance(0.5)
+            with t.span("child-b"):
+                t.tag("late", "tag")
+                clk.advance(1.0)
+        [tr] = t.traces()
+        assert tr["controller"] == "ctl" and tr["key"] == "ns/key"
+        assert tr["outcome"] == "ok" and tr["error"] is None
+        assert tr["queue_wait_s"] == 0.25
+        root = tr["root"]
+        assert root["name"] == "reconcile"
+        assert root["duration_s"] == pytest.approx(4.5)
+        a, b = root["children"]
+        assert a["name"] == "child-a" and a["tags"] == {"color": "red"}
+        assert a["duration_s"] == pytest.approx(2.5)
+        assert a["children"][0]["name"] == "grandchild"
+        assert a["children"][0]["duration_s"] == pytest.approx(0.5)
+        assert b["tags"] == {"late": "tag"}
+
+    def test_error_trace_records_and_reraises(self):
+        t = Tracer(clock=FakeClock(), enabled=True)
+        with pytest.raises(RuntimeError):
+            with t.trace("ctl", "k"):
+                with t.span("step"):
+                    raise RuntimeError("kaboom")
+        [tr] = t.traces()
+        assert tr["outcome"] == "error"
+        assert "RuntimeError: kaboom" in tr["error"]
+        # the span the exception passed through carries it too
+        assert tr["root"]["children"][0]["error"] == tr["error"]
+
+    def test_nested_trace_is_passthrough(self):
+        # a Controller worker opens the trace; the reconciler wrapper's
+        # own trace() must not open a second one
+        t = Tracer(clock=FakeClock(), enabled=True)
+        with t.trace("outer", "k") as outer:
+            with t.trace("inner", "k") as inner:
+                assert inner is None
+                with t.span("work"):
+                    pass
+            assert outer is not None
+        assert len(t.traces()) == 1
+        assert t.traces()[0]["controller"] == "outer"
+        assert t.traces()[0]["root"]["children"][0]["name"] == "work"
+
+    def test_span_without_trace_is_noop(self):
+        t = Tracer(clock=FakeClock(), enabled=True)
+        with t.span("orphan") as sp:
+            assert sp is None
+        t.tag("no", "crash")
+        assert t.traces() == []
+
+    def test_ring_bounded_and_pins_survive_churn(self):
+        clk = FakeClock()
+        t = Tracer(capacity=8, failed_capacity=4, slow_keep=2,
+                   clock=clk, enabled=True)
+        # one slow trace and one failed trace, early
+        with t.trace("ctl", "slow"):
+            clk.advance(100.0)
+        with pytest.raises(ValueError):
+            with t.trace("ctl", "failed"):
+                raise ValueError("pinned")
+        # churn the ring far past capacity with fast ok traces
+        for i in range(50):
+            with t.trace("ctl", f"fast-{i}"):
+                clk.advance(0.001)
+        all_traces = t.traces()
+        # bounded: ring(8) + pins, nowhere near 52
+        assert len(all_traces) <= 8 + 4 + 2
+        keys = {tr["key"] for tr in all_traces}
+        assert "slow" in keys, "slowest trace evicted by churn"
+        assert "failed" in keys, "failed trace evicted by churn"
+        assert t.slowest_trace()["key"] == "slow"
+        failed = t.failed_traces()
+        assert [tr["key"] for tr in failed] == ["failed"]
+        assert failed[0]["outcome"] == "error"
+
+    def test_slowest_tie_breaks_to_earliest(self):
+        clk = FakeClock()
+        t = Tracer(clock=clk, enabled=True)
+        for key in ("first", "second"):
+            with t.trace("ctl", key):
+                clk.advance(1.0)
+        assert t.slowest_trace()["key"] == "first"
+
+    def test_traces_filters(self):
+        clk = FakeClock()
+        t = Tracer(clock=clk, enabled=True)
+        with t.trace("a", "k1"):
+            clk.advance(0.5)
+        with t.trace("b", "k2"):
+            clk.advance(0.001)
+        with pytest.raises(RuntimeError):
+            with t.trace("b", "k3"):
+                raise RuntimeError("x")
+        assert [tr["key"] for tr in t.traces()] == ["k3", "k2", "k1"]
+        assert [tr["key"] for tr in t.traces(controller="b")] == ["k3", "k2"]
+        assert [tr["key"] for tr in t.traces(min_ms=100)] == ["k1"]
+        assert [tr["key"] for tr in t.traces(outcome="error")] == ["k3"]
+        assert [tr["key"] for tr in t.traces(limit=2)] == ["k3", "k2"]
+
+    def test_reset_clears_and_restarts_seq(self):
+        clk = FakeClock()
+        t = Tracer(clock=clk, enabled=True)
+        with t.trace("ctl", "k"):
+            clk.advance(1.0)
+        assert t.traces()[0]["id"] == 0
+        t.reset()
+        assert t.traces() == [] and t.slowest_trace() is None
+        with t.trace("ctl", "k2"):
+            clk.advance(1.0)
+        assert t.traces()[0]["id"] == 0  # seq restarted
+
+    def test_kill_switch(self):
+        t = Tracer(clock=FakeClock(), enabled=False)
+        with t.trace("ctl", "k") as tr:
+            assert tr is None
+            with t.span("child") as sp:
+                assert sp is None
+        assert t.traces() == []
+
+    def test_env_kill_switch_parsing(self):
+        for off in ("0", "false", "no", "off", "False", " OFF "):
+            assert not env_trace_enabled({"OPERATOR_TRACE": off})
+        for on in ("1", "true", "yes", "on", ""):
+            assert env_trace_enabled({"OPERATOR_TRACE": on})
+        assert env_trace_enabled({})  # default: on
+
+    def test_operator_cli_no_trace_flag_defaults_from_env(self, monkeypatch):
+        from tpu_operator.cli.operator import build_parser
+
+        monkeypatch.setenv("OPERATOR_TRACE", "0")
+        assert build_parser().parse_args([]).no_trace
+        monkeypatch.setenv("OPERATOR_TRACE", "1")
+        args = build_parser().parse_args([])
+        assert not args.no_trace
+        assert build_parser().parse_args(["--no-trace"]).no_trace
+
+
+class TestTracingClient:
+    def test_read_source_cache_vs_api(self):
+        t = Tracer(clock=FakeClock(), enabled=True)
+        fake = make_tpu_client()
+        cached = CachedClient(fake)
+        tc = TracingClient(cached, tracer=t)
+        with t.trace("ctl", "k"):
+            tc.list("v1", "Node")
+            tc.get("v1", "Node", "tpu-0")
+        spans = t.traces()[0]["root"]["children"]
+        assert [s["name"] for s in spans] == ["client:list", "client:get"]
+        assert all(s["tags"]["source"] == "cache" for s in spans)
+        cached.close()
+        # a closed cache reads through: source flips to api
+        with t.trace("ctl", "k2"):
+            tc.list("v1", "Node")
+        [sp] = t.traces(limit=1)[0]["root"]["children"]
+        assert sp["tags"]["source"] == "api"
+
+    def test_uncached_reads_and_writes_are_api(self):
+        t = Tracer(clock=FakeClock(), enabled=True)
+        tc = TracingClient(make_tpu_client(), tracer=t)
+        with t.trace("ctl", "k"):
+            tc.list("v1", "Node")
+            tc.create({"apiVersion": "v1", "kind": "ConfigMap",
+                       "metadata": {"name": "cm", "namespace": NS}})
+            cm = tc.get("v1", "ConfigMap", "cm", NS)
+            cm.setdefault("data", {})["k"] = "v"
+            tc.update(cm)
+            tc.patch("v1", "ConfigMap", "cm", {"data": {"k2": "v2"}}, NS)
+            tc.delete("v1", "ConfigMap", "cm", NS)
+        spans = t.traces()[0]["root"]["children"]
+        assert [s["name"] for s in spans] == [
+            "client:list", "client:create", "client:get", "client:update",
+            "client:patch", "client:delete"]
+        assert all(s["tags"]["source"] == "api" for s in spans)
+        writes = [s for s in spans if s["name"] != "client:list"
+                  and s["name"] != "client:get"]
+        assert all(s["tags"]["target"] == "cm" for s in writes)
+
+    def test_verb_error_lands_on_span(self):
+        from tpu_operator.runtime import NotFoundError
+
+        t = Tracer(clock=FakeClock(), enabled=True)
+        tc = TracingClient(FakeClient(), tracer=t)
+        with pytest.raises(NotFoundError):
+            with t.trace("ctl", "k"):
+                try:
+                    tc.get("v1", "ConfigMap", "missing", NS)
+                finally:
+                    pass
+        [sp] = t.traces()[0]["root"]["children"]
+        assert sp["error"] and "NotFoundError" in sp["error"]
+
+    def test_non_verb_surface_delegates(self):
+        fake = make_tpu_client()
+        cached = CachedClient(fake)
+        tc = TracingClient(cached)
+        try:
+            # informer index surface reaches the cache through the wrapper
+            tc.list("v1", "Node")
+            assert tc.has_index("v1", "Node", "by-accelerator")
+            assert tc.index("v1", "Node", "by-accelerator",
+                            "tpu-v5p-slice")
+            assert tc.cache_reads >= 1
+            assert hasattr(tc, "close")
+        finally:
+            cached.close()
+        # a bare FakeClient has no close(): hasattr must stay honest so
+        # Manager.stop's close() probe doesn't explode
+        assert not hasattr(TracingClient(FakeClient()), "close")
+
+    def test_verb_latency_histogram_observed(self):
+        from tpu_operator.metrics.registry import histogram_buckets
+
+        tc = TracingClient(make_tpu_client())  # process-global metrics
+        before = histogram_buckets(
+            "tpu_operator_client_verb_duration_seconds",
+            {"verb": "list", "kind": "Node", "source": "api"})
+        n_before = max(before.values()) if before else 0.0
+        tc.list("v1", "Node")  # outside any trace: histogram still fires
+        after = histogram_buckets(
+            "tpu_operator_client_verb_duration_seconds",
+            {"verb": "list", "kind": "Node", "source": "api"})
+        assert max(after.values()) == n_before + 1
+
+
+class TestWorkQueueWait:
+    def test_get_with_wait_returns_per_item_wait(self):
+        import time
+
+        from tpu_operator.runtime import WorkQueue
+
+        q = WorkQueue()
+        q.add("item")
+        time.sleep(0.02)
+        item, waited = q.get_with_wait(timeout=1.0)
+        assert item == "item"
+        assert waited >= 0.02
+        assert q.last_wait == waited
+        q.done("item")
+        assert q.get_with_wait(timeout=0.01) == (None, 0.0)
+
+
+class TestEventRecorderConflict:
+    def _recorder_and_node(self):
+        from tpu_operator.runtime.events import EventRecorder
+
+        fake = make_tpu_client()
+        node = fake.get("v1", "Node", "tpu-0")
+        return EventRecorder(fake, namespace=NS), fake, node
+
+    def test_conflict_retries_once_and_keeps_both_bumps(self):
+        recorder, fake, node = self._recorder_and_node()
+        recorder.event(node, "Warning", "TestReason", "msg")
+
+        real_update = fake.update
+        raced = {"done": False}
+
+        def racing_update(obj):
+            if not raced["done"] and obj.get("kind") == "Event":
+                raced["done"] = True
+                # the concurrent worker's bump lands first: the caller's
+                # in-flight update now carries a stale resourceVersion
+                other = fake.get("v1", "Event",
+                                 obj["metadata"]["name"], NS)
+                other["count"] = int(other["count"]) + 1
+                real_update(other)
+            return real_update(obj)
+
+        fake.update = racing_update
+        try:
+            recorder.event(node, "Warning", "TestReason", "msg")
+        finally:
+            fake.update = real_update
+        [ev] = [e for e in fake.list("v1", "Event")
+                if e.get("reason") == "TestReason"]
+        # create(1) + racing worker(+1) + this record's retried bump(+1):
+        # without the 409 retry the last bump is silently dropped
+        assert ev["count"] == 3
+
+    def test_dropped_event_tags_active_span(self):
+        recorder, fake, node = self._recorder_and_node()
+
+        def always_conflict(obj):
+            raise ConflictError("persistent conflict")
+
+        fake.update = always_conflict
+        recorder.event(node, "Warning", "DropReason", "msg")  # creates
+        t = Tracer(clock=FakeClock(), enabled=True)
+        import tpu_operator.runtime.events as events_mod
+        import tpu_operator.runtime.tracing as tracing_mod
+
+        prev = tracing_mod.TRACER
+        tracing_mod.TRACER = t
+        try:
+            with t.trace("ctl", "k"):
+                recorder.event(node, "Warning", "DropReason", "msg")
+        finally:
+            tracing_mod.TRACER = prev
+        root = t.traces()[0]["root"]
+        assert "event_dropped" in (root.get("tags") or {}), root
+        assert "DropReason" in root["tags"]["event_dropped"]
+
+
+class TestMustGatherObservability:
+    def test_bundle_contains_metrics_and_traces(self, tmp_path):
+        from tpu_operator.cli import must_gather
+
+        prev = TRACER.enabled
+        TRACER.enabled = True
+        try:
+            rc = must_gather.main(["-o", str(tmp_path), "--fake-demo"])
+        finally:
+            TRACER.enabled = prev
+        assert rc == 0
+        prom = (tmp_path / "metrics" / "metrics.prom").read_text()
+        assert "tpu_operator_reconcile_duration_seconds_bucket" in prom
+        assert "tpu_operator_reconciliation_total" in prom
+        doc = json.loads((tmp_path / "traces" / "traces.json").read_text())
+        assert doc["count"] == len(doc["traces"]) > 0
+        # the demo reconcile is in there, as a full span tree
+        demo = [t for t in doc["traces"]
+                if t["controller"] == "tpuclusterpolicy"]
+        assert demo and demo[0]["root"]["children"]
+        summary = json.loads((tmp_path / "summary.json").read_text())
+        assert summary["metrics_rendered"] and summary["traces"] > 0
+
+
+class TestTraceCLI:
+    def _trace_doc(self):
+        clk = FakeClock()
+        t = Tracer(clock=clk, enabled=True)
+        fake = make_tpu_client()
+        tc = TracingClient(fake, tracer=t)
+        with t.trace("tpuclusterpolicy", "tpu-cluster-policy",
+                     queue_wait_s=0.002):
+            clk.advance(0.5)
+            with t.span("state:libtpu-driver"):
+                tc.list("v1", "Node")
+                clk.advance(0.25)
+        with pytest.raises(RuntimeError):
+            with t.trace("tpu-upgrade", "tpu-cluster-policy"):
+                raise RuntimeError("drain timeout")
+        return {"count": 2, "traces": t.traces()}
+
+    def test_render_trace_is_indented_span_tree(self):
+        from tpu_operator.cli.tpuop_cfg import render_trace
+
+        doc = self._trace_doc()
+        ok = [t for t in doc["traces"] if t["outcome"] == "ok"][0]
+        out = render_trace(ok)
+        lines = out.splitlines()
+        assert lines[0].startswith("trace #")
+        assert "tpuclusterpolicy" in lines[0]
+        assert "queue_wait=2.000ms" in lines[0]
+        assert lines[1].startswith("  reconcile")
+        assert lines[2].startswith("    state:libtpu-driver")
+        assert lines[3].startswith("      client:list")
+        assert "source=api" in lines[3]
+
+    def test_cli_reads_file_and_filters(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = tmp_path / "traces.json"
+        f.write_text(json.dumps(self._trace_doc()))
+        rc = main(["trace", "-f", str(f), "--outcome", "error"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tpu-upgrade" in out and "drain timeout" in out
+        assert "tpuclusterpolicy" not in out
+        rc = main(["trace", "-f", str(f), "--controller", "nope"])
+        assert rc == 0
+        assert "no traces matched" in capsys.readouterr().out
+        rc = main(["trace", "-f", str(tmp_path / "missing.json")])
+        assert rc == 1
+
+
+class TestWorkerTraceIntegration:
+    def test_worker_opens_root_with_queue_wait(self):
+        """A Manager-driven reconcile's trace root comes from the worker
+        (queue_wait_s present) and the reconciler wrapper does not stack
+        a second trace."""
+        import time
+
+        from tpu_operator.controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+        )
+        from tpu_operator.runtime import Manager
+
+        from conftest import load_factor
+
+        fake = make_tpu_client()
+        prev = TRACER.enabled
+        TRACER.enabled = True
+        mgr = Manager(fake, namespace=NS)
+        mgr.add_reconciler(ClusterPolicyReconciler(client=fake,
+                                                   namespace=NS))
+        mgr.start()
+        try:
+            fake.create(new_cluster_policy())
+            deadline = time.time() + 30.0 * load_factor()
+            got = None
+            while time.time() < deadline and got is None:
+                for tr in TRACER.traces(controller="tpuclusterpolicy"):
+                    if (tr["queue_wait_s"] is not None
+                            and tr["root"]["children"]):
+                        got = tr
+                        break
+                time.sleep(0.05)
+            assert got is not None, "no worker-rooted trace recorded"
+            assert got["root"]["name"] == "reconcile"
+            names = [s["name"] for s in got["root"]["children"]]
+            assert any(n.startswith("state:") for n in names)
+        finally:
+            mgr.stop()
+            TRACER.enabled = prev

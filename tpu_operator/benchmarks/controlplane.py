@@ -99,6 +99,16 @@ def run_scale_bench(n_tpu: int = 500,
     state = (cr.get("status") or {}).get("state")
     n_states = len(rec.state_manager.states)
 
+    # reconcile-latency percentiles ride the new per-controller duration
+    # histogram: snapshot its cumulative buckets here, diff at the end,
+    # so the percentiles cover exactly the steady passes measured below
+    # (the install reconciles above are excluded)
+    from ..metrics.registry import histogram_buckets, quantiles_from_buckets
+
+    buckets_before = histogram_buckets(
+        "tpu_operator_reconcile_duration_seconds",
+        labels={"controller": rec.name})
+
     # steady state: hash-skip pass, nothing rewritten. Wall time is the
     # min of three passes — a scheduler hiccup on a loaded CI box should
     # not define the steady-state figure. Request counts come from the
@@ -133,6 +143,17 @@ def run_scale_bench(n_tpu: int = 500,
         reads_before = cached.cache_reads
     cached.close()
 
+    buckets_after = histogram_buckets(
+        "tpu_operator_reconcile_duration_seconds",
+        labels={"controller": rec.name})
+    steady_buckets = {le: buckets_after.get(le, 0.0)
+                      - buckets_before.get(le, 0.0)
+                      for le in buckets_after}
+    qs = quantiles_from_buckets(steady_buckets, (0.50, 0.95, 0.99))
+    latency_ms = (None if qs is None else
+                  {"p50": qs[0] * 1000.0, "p95": qs[1] * 1000.0,
+                   "p99": qs[2] * 1000.0})
+
     return {
         "n_tpu_nodes": n_tpu,
         "n_states": n_states,
@@ -147,6 +168,10 @@ def run_scale_bench(n_tpu: int = 500,
         "steady_requests_cached": sum(verbs_cached.values()),
         "steady_verbs_cached": verbs_cached,
         "steady_cache_reads": cache_reads,
+        # percentiles over the 6 steady passes (3 read-through + 3
+        # cached), from the reconcile-duration histogram's bucket deltas
+        # — histogram-resolution figures, not exact order statistics
+        "reconcile_latency_ms": latency_ms,
     }
 
 

@@ -369,21 +369,44 @@ def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
 
 def _run_scenario(scenario: str, nodes: int, seed: int,
                   steps: Optional[int], cached: bool) -> dict:
+    from ..runtime.tracing import TRACER
+
+    # the scenario owns the process-wide flight recorder for its
+    # duration: span timestamps come from the virtual clock and sequence
+    # ids restart at 0, so the traces embedded in the verdict are part of
+    # the deterministic output (byte-identical per seed)
+    clock = VirtualClock()
+    prev_clock, prev_enabled = TRACER.clock, TRACER.enabled
+    TRACER.reset(clock=clock, enabled=True)
+    try:
+        return _run_scenario_impl(scenario, nodes, seed, steps, cached,
+                                  clock)
+    finally:
+        TRACER.reset(clock=prev_clock, enabled=prev_enabled)
+
+
+def _run_scenario_impl(scenario: str, nodes: int, seed: int,
+                       steps: Optional[int], cached: bool,
+                       clock: VirtualClock) -> dict:
+    from ..runtime.tracing import TRACER, TracingClient
+
     n_steps = steps or DEFAULT_STEPS
     fake = build_cluster(n_tpu=nodes)
-    clock = VirtualClock()
     chaos = ChaosClient(fake, clock)
     # controllers read through the cache (which reads through the chaos
     # client, so informer relists still eat armed faults); the adversary
     # and the checker keep talking to the unwrapped fake
     client = CachedClient(chaos) if cached else chaos
+    # the reconcilers' client verbs get trace spans; the checker and the
+    # verdict's relist counter keep the bare client
+    traced = TracingClient(client)
     fake.create(new_cluster_policy(spec={
         "upgradePolicy": {"autoUpgrade": True,
                           "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}}))
-    prec = ClusterPolicyReconciler(client=client, namespace=NAMESPACE)
-    urec = UpgradeReconciler(client=client, namespace=NAMESPACE, now=clock)
-    ctrls = [_SyncController(prec, client, clock),
-             _SyncController(urec, client, clock)]
+    prec = ClusterPolicyReconciler(client=traced, namespace=NAMESPACE)
+    urec = UpgradeReconciler(client=traced, namespace=NAMESPACE, now=clock)
+    ctrls = [_SyncController(prec, traced, clock),
+             _SyncController(urec, traced, clock)]
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
 
@@ -424,6 +447,13 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
             "soak_passes": soak,
             "convergence_virtual_s": conv_s,
             "violations": violations,
+            # flight-recorder evidence: the slowest reconcile (virtual
+            # duration — latency faults advance the clock) and every
+            # failed one, each a complete span tree down to client verbs
+            "traces": {
+                "slowest": TRACER.slowest_trace(),
+                "failed": TRACER.failed_traces(),
+            },
             "ok": bool(converged and not violations),
         }
 

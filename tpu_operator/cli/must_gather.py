@@ -4,8 +4,9 @@
 
 Dumps everything a support engineer needs into a directory tree: the CRs
 with status/conditions, operand DaemonSets + pods, TPU node labels and
-upgrade states, operator metrics, and the validator barrier files when run
-on a node.
+upgrade states, operator metrics (metrics/metrics.prom), the reconcile
+flight recorder (traces/traces.json), and the validator barrier files
+when run on a node.
 """
 
 from __future__ import annotations
@@ -108,6 +109,31 @@ def gather(client, out_dir: pathlib.Path) -> dict:
                 (d / f.name).write_text(f.read_text())
         summary["validation_files"] = sorted(
             f.name for f in vd.iterdir() if f.is_file())
+
+    # the operator's own observability: the /metrics exposition and the
+    # flight recorder, so a bundle carries the latency/trace evidence,
+    # not just API objects (the docstring's "operator metrics" promise)
+    try:
+        from ..metrics.registry import render_prometheus
+
+        d = out_dir / "metrics"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "metrics.prom").write_text(render_prometheus())
+        summary["metrics_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"metrics: {e}")
+    try:
+        from ..runtime.tracing import TRACER
+
+        d = out_dir / "traces"
+        d.mkdir(parents=True, exist_ok=True)
+        traces = TRACER.traces()
+        (d / "traces.json").write_text(
+            json.dumps({"count": len(traces), "traces": traces},
+                       indent=2, sort_keys=True))
+        summary["traces"] = len(traces)
+    except Exception as e:
+        summary["errors"].append(f"traces: {e}")
 
     (out_dir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary

@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(os.environ.get("OPERATOR_WORKERS", "1")),
                    help="reconcile workers per controller "
                         "(MaxConcurrentReconciles analog)")
+    from ..runtime.tracing import env_trace_enabled
+
+    p.add_argument("--no-trace", action="store_true",
+                   default=not env_trace_enabled(),
+                   help="disable reconcile tracing (flight recorder + "
+                        "/debug/traces); also OPERATOR_TRACE=0. The "
+                        "latency histograms stay on either way")
     p.add_argument("--kubeconfig", default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
@@ -108,6 +115,16 @@ def main(argv=None) -> int:
     else:
         from ..runtime import CachedClient
         api = CachedClient(client)
+
+    from ..runtime.tracing import TRACER, TracingClient
+
+    if args.no_trace:
+        TRACER.enabled = False
+    else:
+        TRACER.enabled = True
+        # outermost wrapper: every controller verb gets a trace span and
+        # a latency sample, tagged cache-hit vs apiserver round-trip
+        api = TracingClient(api)
 
     mgr = Manager(api, namespace=args.namespace,
                   health_port=args.health_port,

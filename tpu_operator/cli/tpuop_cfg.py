@@ -7,6 +7,8 @@ plus the Helm-verb slot of deployments/gpu-operator/templates/*).
     tpuop-cfg diff [all] [--values f]
     tpuop-cfg install|upgrade [--values f] [--wait [--timeout 300]]
     tpuop-cfg uninstall [--purge-crds]
+    tpuop-cfg trace [--url http://mgr:8080 | -f traces.json]
+                    [--controller C] [--min-ms N] [--outcome error]
 
 ``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
 schema conformance against the generated CRD (unknown fields, wrong
@@ -299,6 +301,97 @@ def _lifecycle_verbs(args, client, docs, log) -> int:
     return 0
 
 
+def render_trace(trace: dict) -> str:
+    """One flight-recorder trace as an indented span tree (text)."""
+
+    def ms(v) -> str:
+        return f"{(v or 0.0) * 1000.0:.3f}ms"
+
+    lines = []
+    head = (f"trace #{trace.get('id')} {trace.get('controller')} "
+            f"{trace.get('key')} outcome={trace.get('outcome')} "
+            f"duration={ms(trace.get('duration_s'))}")
+    if trace.get("queue_wait_s") is not None:
+        head += f" queue_wait={ms(trace['queue_wait_s'])}"
+    if trace.get("error"):
+        head += f" error={trace['error']!r}"
+    lines.append(head)
+
+    def walk(span: dict, depth: int) -> None:
+        line = (f"{'  ' * depth}{span.get('name')}  "
+                f"{ms(span.get('duration_s'))}")
+        tags = span.get("tags") or {}
+        if tags:
+            line += "  [" + " ".join(
+                f"{k}={tags[k]}" for k in sorted(tags)) + "]"
+        if span.get("error"):
+            line += f"  !{span['error']}"
+        lines.append(line)
+        for child in span.get("children") or []:
+            walk(child, depth + 1)
+
+    root = trace.get("root")
+    if root:
+        walk(root, 1)
+    return "\n".join(lines)
+
+
+def _trace(args) -> int:
+    """Fetch traces from a manager's /debug/traces (or a dumped
+    traces.json) and print them as indented span trees."""
+    import pathlib
+    import urllib.parse
+    import urllib.request
+
+    if args.file:
+        try:
+            data = json.loads(pathlib.Path(args.file).read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read traces from {args.file}: {e}",
+                  file=sys.stderr)
+            return 1
+        traces = data.get("traces", []) if isinstance(data, dict) else data
+        # the server-side filters, applied client-side for files
+        if args.controller:
+            traces = [t for t in traces
+                      if t.get("controller") == args.controller]
+        if args.min_ms is not None:
+            traces = [t for t in traces
+                      if (t.get("duration_s") or 0) * 1000.0 >= args.min_ms]
+        if args.outcome:
+            traces = [t for t in traces if t.get("outcome") == args.outcome]
+        if args.limit:
+            traces = traces[:args.limit]
+    else:
+        params = {}
+        if args.controller:
+            params["controller"] = args.controller
+        if args.min_ms is not None:
+            params["min_ms"] = str(args.min_ms)
+        if args.outcome:
+            params["outcome"] = args.outcome
+        if args.limit:
+            params["limit"] = str(args.limit)
+        url = args.url.rstrip("/") + "/debug/traces"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                data = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+        traces = data.get("traces", [])
+
+    if args.id is not None:
+        traces = [t for t in traces if t.get("id") == args.id]
+    if not traces:
+        print("no traces matched")
+        return 0
+    print("\n\n".join(render_trace(t) for t in traces))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     from .. import __version__
@@ -386,12 +479,33 @@ def main(argv=None) -> int:
                    help="also drop the CRDs after the CRs are gone")
     u.add_argument("--timeout", type=float, default=300.0)
 
+    t = sub.add_parser(
+        "trace", help="render reconcile traces from the manager's "
+                      "/debug/traces flight recorder (or a must-gather "
+                      "traces.json) as indented span trees")
+    t.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="manager health endpoint base URL")
+    t.add_argument("-f", "--file", default=None,
+                   help="read a traces.json dump instead of fetching")
+    t.add_argument("--controller", default=None,
+                   help="only traces from this controller")
+    t.add_argument("--min-ms", type=float, default=None,
+                   help="only traces at least this slow")
+    t.add_argument("--outcome", choices=("ok", "error"), default=None)
+    t.add_argument("--limit", type=int, default=None,
+                   help="at most N traces (newest first)")
+    t.add_argument("--id", type=int, default=None,
+                   help="render only the trace with this id")
+    t.add_argument("--timeout", type=float, default=10.0)
+
     args = p.parse_args(argv)
 
     if args.cmd in ("install", "upgrade", "uninstall"):
         return _lifecycle(args)
     if args.cmd == "status":
         return _status(args)
+    if args.cmd == "trace":
+        return _trace(args)
 
     if args.cmd == "diff":
         docs = _generate_docs(args)

@@ -101,17 +101,23 @@ class ClusterPolicyReconciler(Reconciler):
     def reconcile(self, request: Request) -> Result:
         import time as _time
 
+        from ..runtime.tracing import TRACER
+
         started = _time.perf_counter()
         try:
-            return self._reconcile(request)
+            # direct-driven runs (benchmarks, chaos runner, tests) get
+            # their trace root here; under a Controller worker the trace
+            # is already open and this is a passthrough
+            with TRACER.trace(self.name, str(request)):
+                return self._reconcile(request)
         finally:
             elapsed = _time.perf_counter() - started
             OPERATOR_METRICS.reconcile_duration.set(elapsed)
-            # the per-controller series the Controller worker also keeps;
-            # set here too so direct-driven runs (benchmarks, chaos
-            # runner) report durations without a Controller in the loop
+            # sole observation point of the per-controller duration
+            # histogram: exactly one sample per reconcile on both the
+            # worker-driven and direct-driven paths
             OPERATOR_METRICS.reconcile_duration_by_controller.labels(
-                controller=self.name).set(elapsed)
+                controller=self.name).observe(elapsed)
 
     def _reconcile(self, request: Request) -> Result:
         import time as _time

@@ -199,15 +199,24 @@ class StateManager:
                           cluster={"runtime": facts["containerRuntime"],
                                    **facts},
                           extra=extra or {})
+        from ..runtime.tracing import TRACER
+
         results: Dict[str, SyncResult] = {}
         for state in self.states:
             start = time.perf_counter()
-            try:
-                results[state.name] = state.sync(ctx)
-            except Exception as e:  # a broken state must not wedge the rest
-                log.exception("state %s sync failed", state.name)
-                results[state.name] = SyncResult(SyncStatus.ERROR, str(e))
-            finally:
-                OPERATOR_METRICS.operand_sync_duration.labels(
-                    state=state.name).set(time.perf_counter() - start)
+            # the span wraps the swallowing try: the exception never
+            # escapes, so the error is recorded on the span by hand
+            with TRACER.span("state:" + state.name) as sp:
+                try:
+                    results[state.name] = state.sync(ctx)
+                    if sp is not None:
+                        sp.tags["status"] = results[state.name].status.value
+                except Exception as e:  # a broken state must not wedge the rest
+                    log.exception("state %s sync failed", state.name)
+                    results[state.name] = SyncResult(SyncStatus.ERROR, str(e))
+                    if sp is not None:
+                        sp.error = f"{type(e).__name__}: {e}"
+                finally:
+                    OPERATOR_METRICS.operand_sync_duration.labels(
+                        state=state.name).set(time.perf_counter() - start)
         return results

@@ -74,15 +74,19 @@ class TPUDriverReconciler(Reconciler):
     def reconcile(self, request: Request) -> Result:
         import time as _time
 
+        from ..runtime.tracing import TRACER
+
         started = _time.perf_counter()
         try:
-            return self._reconcile(request)
+            # trace root for direct-driven runs; passthrough when the
+            # Controller worker already opened the trace at dequeue
+            with TRACER.trace(self.name, str(request)):
+                return self._reconcile(request)
         finally:
-            # same per-controller series the Controller worker keeps; set
-            # here too so direct-driven runs (benchmarks, chaos runner)
-            # report durations without a Controller in the loop
+            # sole observation point of the per-controller duration
+            # histogram (one sample per reconcile, every drive path)
             OPERATOR_METRICS.reconcile_duration_by_controller.labels(
-                controller=self.name).set(_time.perf_counter() - started)
+                controller=self.name).observe(_time.perf_counter() - started)
 
     def _reconcile(self, request: Request) -> Result:
         cr = self.client.get_or_none(V1ALPHA1, KIND_TPU_DRIVER, request.name)

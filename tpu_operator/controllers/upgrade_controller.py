@@ -356,9 +356,13 @@ class UpgradeReconciler(Reconciler):
         return min(present, key=_STAGE_ORDER.index)
 
     def _set_unit_state(self, members: List[_Member], state: str) -> None:
-        for m in members:
-            if m.state != state:
-                self._set_node_state(m.node, state)
+        from ..runtime.tracing import TRACER
+
+        with TRACER.span("fsm:" + state, unit=members[0].name,
+                         nodes=len(members)):
+            for m in members:
+                if m.state != state:
+                    self._set_node_state(m.node, state)
 
     def _stage_started(self, members: List[_Member]) -> Optional[float]:
         stamps = []
@@ -377,9 +381,13 @@ class UpgradeReconciler(Reconciler):
             self._annotate(m.node, **{L.UPGRADE_STAGE_STARTED: stamp})
 
     def _fail_unit(self, members: List[_Member], reason: str) -> None:
+        from ..runtime.tracing import TRACER
+
         stamp = str(self.now())
         log.error("upgrade unit [%s] failed: %s",
                   ",".join(m.name for m in members), reason)
+        TRACER.tag("upgrade_failed_unit", members[0].name)
+        TRACER.tag("upgrade_failed_reason", reason)
         for m in members:
             self._annotate(m.node, **{L.UPGRADE_FAILED_AT: stamp,
                                       L.UPGRADE_FAILED_REASON: reason,
@@ -392,6 +400,21 @@ class UpgradeReconciler(Reconciler):
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self, request: Request) -> Result:
+        import time as _time
+
+        from ..runtime.tracing import TRACER
+
+        started = _time.perf_counter()
+        try:
+            # trace root for direct-driven runs (rollout bench, chaos
+            # runner); passthrough under a Controller worker
+            with TRACER.trace(self.name, str(request)):
+                return self._reconcile(request)
+        finally:
+            OPERATOR_METRICS.reconcile_duration_by_controller.labels(
+                controller=self.name).observe(_time.perf_counter() - started)
+
+    def _reconcile(self, request: Request) -> Result:
         cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
         if cr is None:
             return Result()

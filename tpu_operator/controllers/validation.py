@@ -22,20 +22,25 @@ def validate_node_selectors(client: Client, cr: dict) -> None:
     """Raise when ``cr`` selects a node that another TPUDriver already
     selects. An empty nodeSelector selects ALL TPU nodes, so at most one CR
     may omit it."""
-    spec = TPUDriverSpec.from_obj(cr)
-    others: List[dict] = [
-        c for c in client.list(V1ALPHA1, KIND_TPU_DRIVER)
-        if name_of(c) != name_of(cr)
-    ]
-    nodes = client.list("v1", "Node")
-    for other in others:
-        other_spec = TPUDriverSpec.from_obj(other)
-        for node in nodes:
-            nl = labels_of(node)
-            mine = match_labels(nl, spec.node_selector or {})
-            theirs = match_labels(nl, other_spec.node_selector or {})
-            if mine and theirs:
-                raise ValidationError(
-                    f"TPUDriver {name_of(cr)!r} and {name_of(other)!r} both "
-                    f"select node {name_of(node)!r}; nodeSelectors must be "
-                    f"disjoint")
+    from ..runtime.tracing import TRACER
+
+    # the span context records the ValidationError (and re-raises it):
+    # a rejected CR shows up in the reconcile trace as this span
+    with TRACER.span("validate:node-selectors", target=name_of(cr)):
+        spec = TPUDriverSpec.from_obj(cr)
+        others: List[dict] = [
+            c for c in client.list(V1ALPHA1, KIND_TPU_DRIVER)
+            if name_of(c) != name_of(cr)
+        ]
+        nodes = client.list("v1", "Node")
+        for other in others:
+            other_spec = TPUDriverSpec.from_obj(other)
+            for node in nodes:
+                nl = labels_of(node)
+                mine = match_labels(nl, spec.node_selector or {})
+                theirs = match_labels(nl, other_spec.node_selector or {})
+                if mine and theirs:
+                    raise ValidationError(
+                        f"TPUDriver {name_of(cr)!r} and {name_of(other)!r} "
+                        f"both select node {name_of(node)!r}; nodeSelectors "
+                        f"must be disjoint")

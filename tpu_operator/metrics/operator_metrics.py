@@ -8,15 +8,23 @@ replaced by TPU-specific ones (chips/pools/upgrade-unit gauges).
 
 from __future__ import annotations
 
-from prometheus_client import Counter, Gauge
+from prometheus_client import Counter, Gauge, Histogram
 
 from .registry import REGISTRY
+
+# control-plane latency buckets: reconciles on a warm informer cache sit
+# in the sub-ms range, full apply passes in the tens of ms, and a live
+# apiserver round-trip or drain wait stretches into seconds
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class OperatorMetrics:
     def __init__(self, registry=REGISTRY):
         g = lambda name, doc, **kw: Gauge(name, doc, registry=registry, **kw)
         c = lambda name, doc, **kw: Counter(name, doc, registry=registry, **kw)
+        h = lambda name, doc, **kw: Histogram(
+            name, doc, registry=registry, buckets=LATENCY_BUCKETS, **kw)
         self.reconcile_total = c(
             "tpu_operator_reconciliation_total",
             "Total TPUClusterPolicy reconciliations")
@@ -111,10 +119,25 @@ class OperatorMetrics:
             "tpu_operator_workqueue_queue_duration_seconds",
             "Queue latency of the most recently dequeued item",
             labelnames=("controller",))
-        self.reconcile_duration_by_controller = g(
+        # tracing plane (runtime/tracing.py): the distribution series the
+        # last-write gauges above can't provide. The per-controller
+        # reconcile duration is a Histogram (was a gauge) so percentiles
+        # survive between scrapes; queue time and client verb latency get
+        # their own histograms. The verb histogram's source label splits
+        # informer-cache hits from real apiserver round-trips.
+        self.reconcile_duration_by_controller = h(
             "tpu_operator_reconcile_duration_seconds",
-            "Wall time of the last reconcile, per controller",
+            "Reconcile wall time, per controller",
             labelnames=("controller",))
+        self.workqueue_queue_latency = h(
+            "tpu_operator_workqueue_queue_time_seconds",
+            "Time items spent queued before a worker dequeued them",
+            labelnames=("controller",))
+        self.client_verb_duration = h(
+            "tpu_operator_client_verb_duration_seconds",
+            "API client verb latency, by verb/kind and whether the read "
+            "was served from the informer cache or the apiserver",
+            labelnames=("verb", "kind", "source"))
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -26,4 +26,5 @@ from .manager import (  # noqa: F401
     generation_changed,
     label_changed,
 )
+from .tracing import TRACER, Tracer, TracingClient  # noqa: F401
 from .workqueue import RateLimiter, WorkQueue  # noqa: F401

@@ -257,6 +257,12 @@ class CachedClient(Client):
         self.cache_reads = 0
         self.relists = 0
 
+    @property
+    def serves_cached_reads(self) -> bool:
+        """True while get/list are answered from the watch-fed stores —
+        the tracing layer's deterministic source=cache|api signal."""
+        return not self._closed
+
     # -- informer lifecycle -------------------------------------------------
 
     def _ensure(self, api_version: str, kind: str) -> _Store:

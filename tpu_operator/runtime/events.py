@@ -14,7 +14,7 @@ import datetime
 import logging
 from typing import Optional
 
-from .client import Client
+from .client import Client, ConflictError
 from .objects import name_of, namespace_of
 
 log = logging.getLogger("tpu_operator.events")
@@ -71,7 +71,20 @@ class EventRecorder:
             if existing is not None:
                 existing["count"] = int(existing.get("count", 1)) + 1
                 existing["lastTimestamp"] = now
-                self.client.update(existing)
+                try:
+                    self.client.update(existing)
+                except ConflictError:
+                    # concurrent workers race this read-modify-update;
+                    # retry once on a fresh read so the other worker's
+                    # count bump is not lost (beyond one retry, the
+                    # best-effort discipline applies)
+                    existing = self.client.get_or_none("v1", "Event",
+                                                       name, ns)
+                    if existing is None:
+                        raise
+                    existing["count"] = int(existing.get("count", 1)) + 1
+                    existing["lastTimestamp"] = _now()
+                    self.client.update(existing)
                 return
             self.client.create({
                 "apiVersion": "v1",
@@ -87,5 +100,10 @@ class EventRecorder:
                 "source": {"component": self.component},
             })
         except Exception as e:  # never fail the reconcile for an event
+            from .tracing import TRACER
+
+            # a dropped event is invisible in logs at default level; at
+            # least the reconcile's trace says it happened
+            TRACER.tag("event_dropped", f"{reason}: {e}")
             log.debug("event %s/%s not recorded: %s", reason,
                       name_of(obj), e)

@@ -3,7 +3,8 @@
 Plays the role of ctrl.NewManager + builder wiring in the reference's
 entrypoint (cmd/gpu-operator/main.go:72-220): reconcilers register watches
 with predicates, events map to requests on a rate-limited workqueue, worker
-threads drive Reconcile, and the manager serves /healthz and /metrics.
+threads drive Reconcile, and the manager serves /healthz, /metrics and
+the flight recorder at /debug/traces.
 
 Two knobs the seed deliberately pinned are now open:
 
@@ -194,16 +195,24 @@ class Controller:
         self._watch_cancels.append(self.client.watch(api_version, kind, handler))
 
     def _worker(self):
-        import time as _time
+        from .tracing import TRACER
         while not self._stopped.is_set():
-            req = self.queue.get(timeout=0.5)
+            req, waited = self.queue.get_with_wait(timeout=0.5)
             if req is None:
                 continue
             OPERATOR_METRICS.workqueue_queue_duration.labels(
-                controller=self.name).set(self.queue.last_wait)
-            started = _time.perf_counter()
+                controller=self.name).set(waited)
+            OPERATOR_METRICS.workqueue_queue_latency.labels(
+                controller=self.name).observe(waited)
             try:
-                result = self.reconciler.reconcile(req)
+                # the trace's root span opens here, at dequeue, carrying
+                # the queue wait; the reconciler's own wrapper (which
+                # also covers direct-driven runs) sees a trace is active
+                # and passes through. The duration *histogram* is
+                # observed in that wrapper — once per reconcile on every
+                # path — not here.
+                with TRACER.trace(self.name, str(req), queue_wait_s=waited):
+                    result = self.reconciler.reconcile(req)
                 self._count_reconcile(error=False)
                 if result and result.requeue_after > 0:
                     self.queue.forget(req)
@@ -219,8 +228,6 @@ class Controller:
                 log.exception("[%s] reconcile %s failed", self.name, req)
                 self.queue.add_rate_limited(req)
             finally:
-                OPERATOR_METRICS.reconcile_duration_by_controller.labels(
-                    controller=self.name).set(_time.perf_counter() - started)
                 self.queue.done(req)
                 OPERATOR_METRICS.workqueue_depth.labels(
                     controller=self.name).set(len(self.queue))
@@ -269,15 +276,46 @@ class _HealthHandler(BaseHTTPRequestHandler):
     manager: "Manager" = None  # type: ignore
 
     def do_GET(self):
-        if self.path in ("/healthz", "/readyz"):
+        from urllib.parse import parse_qs, urlparse
+
+        url = urlparse(self.path)
+        ctype = "text/plain; version=0.0.4"
+        if url.path in ("/healthz", "/readyz"):
             body, code = b"ok", 200
-        elif self.path == "/metrics":
+        elif url.path == "/metrics":
             from ..metrics.registry import render_prometheus
             body, code = render_prometheus().encode(), 200
+        elif url.path == "/debug/traces":
+            import json
+
+            from .tracing import TRACER
+
+            q = parse_qs(url.query)
+
+            def one(key):
+                vals = q.get(key)
+                return vals[-1] if vals else None
+
+            try:
+                min_ms = (float(one("min_ms"))
+                          if one("min_ms") is not None else None)
+                limit = (int(one("limit"))
+                         if one("limit") is not None else None)
+            except ValueError:
+                body, code = b'{"error": "min_ms/limit must be numbers"}', 400
+            else:
+                traces = TRACER.traces(controller=one("controller"),
+                                       min_ms=min_ms,
+                                       outcome=one("outcome"),
+                                       limit=limit)
+                body = json.dumps({"count": len(traces), "traces": traces},
+                                  sort_keys=True).encode()
+                code = 200
+            ctype = "application/json"
         else:
             body, code = b"not found", 404
         self.send_response(code)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -140,6 +140,15 @@ class WorkQueue:
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
         """Block for the next item; None on shutdown or timeout."""
+        return self.get_with_wait(timeout)[0]
+
+    def get_with_wait(self, timeout: Optional[float] = None
+                      ) -> tuple[Optional[Any], float]:
+        """Like :meth:`get`, plus the seconds the returned item spent
+        queued. The shared ``last_wait`` field is racy under N workers —
+        this per-item figure (computed under the lock) is what the
+        queue-time histogram and the reconcile trace's root span carry.
+        Returns ``(None, 0.0)`` on shutdown or timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while True:
@@ -149,15 +158,17 @@ class WorkQueue:
                     self._pending.discard(item)
                     self._processing.add(item)
                     added = self._enqueued_at.pop(item, None)
+                    waited = 0.0
                     if added is not None:
-                        self.last_wait = time.monotonic() - added
-                    return item
+                        waited = time.monotonic() - added
+                        self.last_wait = waited
+                    return item, waited
                 if self._shutdown:
-                    return None
+                    return None, 0.0
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return None
+                        return None, 0.0
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
 
