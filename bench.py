@@ -394,6 +394,11 @@ def _controlplane_doc() -> dict | None:
             "steady_requests_cached": r["steady_requests_cached"],
             "steady_verbs_cached": r["steady_verbs_cached"],
             "steady_cache_reads": r["steady_cache_reads"],
+            # zero-write steady state: writes the spec-hash/status skips
+            # suppressed across the cached passes, plus the render-memo
+            # hit ratio over the same window
+            "steady_writes_avoided": r.get("steady_writes_avoided"),
+            "render_cache": r.get("render_cache"),
             # reconcile latency percentiles over the steady passes, from
             # the tpu_operator_reconcile_duration_seconds histogram
             "reconcile_latency_ms": (

@@ -15,6 +15,7 @@ from tpu_operator.controllers.nodeinfo import (
 )
 from tpu_operator.runtime import FakeClient
 from tpu_operator.runtime.leaderelection import LeaderElector
+from tpu_operator.runtime.objects import thaw_obj
 
 
 def v5p_node(c, name, extra=None, **kw):
@@ -57,7 +58,7 @@ class TestNodeInfo:
     def test_schedulable_filter(self):
         c = FakeClient()
         v5p_node(c, "a")
-        node = c.get("v1", "Node", "a")
+        node = thaw_obj(c.get("v1", "Node", "a"))
         node["spec"]["unschedulable"] = True
         c.update(node)
         assert NodeInfoProvider(c).nodes(NodeFilter().schedulable()) == []

@@ -1,0 +1,92 @@
+"""Bench-record regression guard (tier-1, no benchmark run needed).
+
+The committed ``BENCH_LOCAL_r*.json`` records are the repo's perf
+history; this guard parses them and fails when the LATEST round's
+``steady_pass_cached_s`` (the zero-write cached steady pass,
+benchmarks.controlplane.run_scale_bench) regresses more than 25% vs the
+best round on record. Pure file-parsing: it runs in milliseconds,
+catching "someone committed a record with a perf cliff" at test time
+rather than at the next bench review.
+
+Rounds that predate the cached-steady figure carry no
+``steady_pass_cached_s`` key anywhere in the record; the guard skips
+gracefully until a round with the key is committed.
+"""
+
+import json
+import pathlib
+import re
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REGRESSION_HEADROOM = 1.25  # latest may be up to 25% slower than best
+
+
+def _bench_records():
+    """(round_number, parsed_json) for every committed local record."""
+    out = []
+    for path in sorted(REPO.glob("BENCH_LOCAL_r*.json")):
+        m = re.match(r"BENCH_LOCAL_r(\d+)\.json", path.name)
+        if not m:
+            continue
+        try:
+            out.append((int(m.group(1)), json.loads(path.read_text())))
+        except (OSError, ValueError):
+            continue  # an unreadable record must not mask the others
+    return sorted(out)
+
+
+def _cached_steady_figures(obj):
+    """Every steady_pass_cached_s in a record, wherever it nests —
+    record layout has drifted between rounds, so walk rather than
+    hard-code a path."""
+    found = []
+    if isinstance(obj, dict):
+        v = obj.get("steady_pass_cached_s")
+        if isinstance(v, (int, float)) and v > 0:
+            found.append(float(v))
+        for child in obj.values():
+            found.extend(_cached_steady_figures(child))
+    elif isinstance(obj, list):
+        for child in obj:
+            found.extend(_cached_steady_figures(child))
+    return found
+
+
+def test_cached_steady_pass_not_regressed():
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _cached_steady_figures(doc) for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records steady_pass_cached_s yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} steady_pass_cached_s={latest:.4f}s "
+        f"regressed >25% vs best on record ({best:.4f}s)")
+
+
+def test_records_parse_and_carry_controlplane_rider():
+    """Sanity on the guard's own inputs: the latest record parses and
+    carries a controlplane block somewhere (the rider bench.py attaches
+    to every emission) — otherwise the regression guard above would
+    skip forever without anyone noticing."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+
+    def has_controlplane(obj):
+        if isinstance(obj, dict):
+            return "controlplane" in obj or any(
+                has_controlplane(v) for v in obj.values())
+        if isinstance(obj, list):
+            return any(has_controlplane(v) for v in obj)
+        return False
+
+    latest_round, latest_doc = records[-1]
+    assert has_controlplane(latest_doc), (
+        f"BENCH_LOCAL_r{latest_round:02d}.json has no controlplane block")

@@ -3,7 +3,8 @@
 Four claims under test:
 
 1. Read path — warm gets/lists are served from the store with ZERO
-   apiserver get/list verbs, copy-on-read, and fake-identical selector
+   apiserver get/list verbs, copy-free frozen reads (mutation raises,
+   thaw_obj yields a private copy), and fake-identical selector
    semantics.
 2. Read-your-writes — a get immediately after the client's own
    update/update_status never observes a staler resourceVersion than
@@ -27,6 +28,13 @@ from tpu_operator.api import labels as L
 from tpu_operator.chaos.faults import ChaosClient
 from tpu_operator.chaos.runner import run_scenario
 from tpu_operator.runtime import CachedClient, FakeClient
+from tpu_operator.runtime.objects import (
+    FrozenDict,
+    FrozenList,
+    FrozenObjectError,
+    freeze_obj,
+    thaw_obj,
+)
 
 
 def _cm(name, data, namespace="tpu-operator"):
@@ -55,6 +63,42 @@ def cached(fake):
     cc.close()
 
 
+class TestFrozenObjects:
+    """freeze_obj/thaw_obj invariants the zero-copy read path rests on."""
+
+    def test_freeze_thaw_round_trip(self):
+        obj = {"metadata": {"labels": {"a": "1"}},
+               "spec": {"containers": [{"name": "c", "ports": [1, 2]}]}}
+        frozen = freeze_obj(obj)
+        assert isinstance(frozen, FrozenDict)
+        assert isinstance(frozen["spec"]["containers"], FrozenList)
+        for mutate in (lambda: frozen.update({}),
+                       lambda: frozen["spec"]["containers"].append({}),
+                       lambda: frozen["metadata"]["labels"].pop("a"),
+                       lambda: frozen.setdefault("status", {})):
+            with pytest.raises(FrozenObjectError):
+                mutate()
+        thawed = thaw_obj(frozen)
+        assert thawed == obj
+        assert type(thawed) is dict
+        assert type(thawed["spec"]["containers"]) is list
+        thawed["spec"]["containers"][0]["name"] = "other"  # mutable again
+        assert frozen["spec"]["containers"][0]["name"] == "c"
+
+    def test_frozen_objects_serialize_like_plain(self):
+        import json
+
+        import yaml
+
+        obj = freeze_obj({"kind": "ConfigMap", "data": {"k": ["v", 1]}})
+        plain = thaw_obj(obj)
+        assert json.dumps(obj, sort_keys=True) == \
+            json.dumps(plain, sort_keys=True)
+        dumped = yaml.safe_dump(obj)
+        assert dumped == yaml.safe_dump(plain)
+        assert "!!python" not in dumped  # no type tags leak into manifests
+
+
 class TestReadPath:
     def test_warm_reads_issue_zero_apiserver_verbs(self, fake, cached):
         for i in range(8):
@@ -72,10 +116,18 @@ class TestReadPath:
         assert "list" not in fake.verb_counts, fake.verb_counts
         assert "get" not in fake.verb_counts, fake.verb_counts
 
-    def test_copy_on_read_isolates_callers(self, fake, cached):
+    def test_frozen_reads_isolate_callers(self, fake, cached):
+        # copy-free reads: mutating a cached read raises loudly instead
+        # of corrupting the shared store (the old deepcopy-on-read
+        # isolation, without paying a deepcopy per read)
         fake.create(_cm("a", {"k": "1"}))
         got = cached.get("v1", "ConfigMap", "a", namespace="tpu-operator")
-        got["data"]["k"] = "corrupted"
+        with pytest.raises(FrozenObjectError):
+            got["data"]["k"] = "corrupted"
+        # thaw_obj is the sanctioned mutation path: a private copy that
+        # leaves the store untouched
+        mine = thaw_obj(got)
+        mine["data"]["k"] = "corrupted"
         again = cached.get("v1", "ConfigMap", "a", namespace="tpu-operator")
         assert again["data"] == {"k": "1"}
 
@@ -96,7 +148,7 @@ class TestReadPath:
 
 class TestReadYourWrites:
     def test_get_after_own_update_never_staler(self, fake, cached):
-        obj = cached.create(_cm("rv", {"n": "0"}))
+        obj = thaw_obj(cached.create(_cm("rv", {"n": "0"})))
         for i in range(1, 12):
             obj["data"]["n"] = str(i)
             written = cached.update(obj)
@@ -106,12 +158,12 @@ class TestReadYourWrites:
             got_rv = int(got["metadata"]["resourceVersion"])
             assert got_rv >= wrote_rv, (i, got_rv, wrote_rv)
             assert got["data"]["n"] == str(i)
-            obj = got
+            obj = thaw_obj(got)
 
     def test_update_status_write_through(self, fake, cached):
         fake.create({"apiVersion": "v1", "kind": "Node",
                      "metadata": {"name": "n1"}})
-        node = cached.get("v1", "Node", "n1")
+        node = thaw_obj(cached.get("v1", "Node", "n1"))
         node.setdefault("status", {})["phase"] = "Ready"
         written = cached.update_status(node)
         got = cached.get("v1", "Node", "n1")
@@ -136,8 +188,8 @@ class TestHealing:
             chaos.suspend_watch_streams()
             # mutate behind the cache's back — no stream is connected,
             # so these events are genuinely lost, not merely delayed
-            victim = fake.get("v1", "ConfigMap", "victim",
-                              namespace="tpu-operator")
+            victim = thaw_obj(fake.get("v1", "ConfigMap", "victim",
+                                       namespace="tpu-operator"))
             victim["data"]["k"] = "post-gap"
             victim = fake.update(victim)
             fake.create(_cm("born-in-gap", {"k": "1"}))

@@ -5,6 +5,7 @@ import pytest
 import yaml
 
 from tpu_operator.cli.tpuop_cfg import main, validate_cr
+from tpu_operator.runtime.objects import thaw_obj
 from tpu_operator.deploy.packaging import generate
 
 
@@ -503,7 +504,7 @@ class TestDiff:
         c = FakeClient()
         self._apply(c, self._docs())
         # the apiserver stamps rv/uid; an admission hook defaults a field
-        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep = thaw_obj(c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator"))
         dep["spec"]["revisionHistoryLimit"] = 10  # defaulted, not in docs
         c.update(dep)
         results = diff_bundle(c, self._docs())
@@ -515,7 +516,7 @@ class TestDiff:
 
         c = FakeClient()
         self._apply(c, self._docs())
-        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep = thaw_obj(c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator"))
         dep["spec"]["replicas"] = 5  # someone kubectl-edited the operator
         c.update(dep)
         results = diff_bundle(c, self._docs())
@@ -563,7 +564,7 @@ class TestDiff:
 
         c = FakeClient()
         self._apply(c, self._docs())
-        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep = thaw_obj(c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator"))
         ctr = dep["spec"]["template"]["spec"]["containers"][0]
         ctr["terminationMessagePath"] = "/dev/termination-log"
         ctr["ports"][0]["protocol"] = "TCP"
@@ -578,7 +579,7 @@ class TestDiff:
 
         c = FakeClient()
         self._apply(c, self._docs())
-        dep = c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator")
+        dep = thaw_obj(c.get("apps/v1", "Deployment", "tpu-operator", "tpu-operator"))
         dep["spec"]["replicas"] = 9
         c.update(dep)
         [drift] = [r for r in diff_bundle(c, self._docs())

@@ -33,10 +33,13 @@ class TestDeterminism:
         b = FaultPlan.build("node-churn", 2, nodes, 12)
         assert a.schedule_json() != b.schedule_json()
 
-    def test_same_seed_byte_identical_verdict(self):
+    @pytest.mark.parametrize("scenario", ["conflict-storm", "operand-drift"])
+    def test_same_seed_byte_identical_verdict(self, scenario):
         """The acceptance bar: two full runs emit byte-identical JSON —
-        a red verdict is its own reproducer."""
-        runs = [run_scenario("conflict-storm", nodes=32, seed=7)
+        a red verdict is its own reproducer. operand-drift rides along
+        because its repair path (spec-hash mismatch -> rewrite) must be
+        as deterministic as the fault schedule itself."""
+        runs = [run_scenario(scenario, nodes=32, seed=7)
                 for _ in range(2)]
         payloads = [json.dumps(v, indent=2, sort_keys=True) for v in runs]
         assert payloads[0] == payloads[1]

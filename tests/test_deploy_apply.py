@@ -7,6 +7,7 @@ import pytest
 from tpu_operator.deploy import apply as apply_mod
 from tpu_operator.runtime.client import NotFoundError
 from tpu_operator.runtime.fake import FakeClient
+from tpu_operator.runtime.objects import thaw_obj
 
 
 def doc(kind, name, api="v1", ns=None, **spec):
@@ -151,7 +152,7 @@ class TestWaitPolicyReady:
         c.create(cr)
         c.create(new_tpu_driver("pool-a"))  # no status yet
         assert wait_policy_ready_short(c) is False
-        live = c.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-a")
+        live = thaw_obj(c.get("tpu.graft.dev/v1alpha1", "TPUDriver", "pool-a"))
         live["status"] = {"state": "ready"}
         c.update(live)
         assert apply_mod.wait_policy_ready(c, timeout_s=2.0,

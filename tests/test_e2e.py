@@ -16,6 +16,7 @@ from tpu_operator.controllers.clusterpolicy_controller import (
 )
 from tpu_operator.controllers.upgrade_controller import UpgradeReconciler
 from tpu_operator.runtime import FakeClient, ListOptions, Manager, Request
+from tpu_operator.runtime.objects import thaw_obj
 
 
 def build_cluster(n_tpu=2):
@@ -125,7 +126,7 @@ class TestEndToEnd:
         assert rvs == rvs2, "DaemonSets churned with no spec change"
 
         # -- update-clusterpolicy mutation ------------------------------
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["libtpu"] = {"installDir": "/opt/mutated"}
         c.update(cr)
 
@@ -180,12 +181,12 @@ class TestEndToEnd:
                 return any(d["metadata"]["name"] == "libtpu-metrics-exporter"
                            for d in c.list("apps/v1", "DaemonSet"))
 
-            cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+            cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
             cr["spec"]["metricsExporter"] = {"enabled": False}
             c.update(cr)
             wait_for(c, lambda: not exporter_exists(),
                      "disabled operand was not removed")
-            cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+            cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
             cr["spec"]["metricsExporter"] = {"enabled": True}
             c.update(cr)
             wait_for(c, exporter_exists,

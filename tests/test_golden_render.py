@@ -16,6 +16,7 @@ import yaml
 from tpu_operator.api.clusterpolicy import TPUClusterPolicySpec, new_cluster_policy
 from tpu_operator.state.operands import build_states
 from tpu_operator.state.state import SyncContext
+from tpu_operator.runtime.objects import thaw_obj
 
 GOLDEN_DIR = pathlib.Path(__file__).parent / "testdata" / "golden"
 
@@ -189,15 +190,17 @@ def render_tpudriver_pools() -> str:
         "repository": "gcr.io/pools", "image": "libtpu",
         "version": "v7.7.7"}))
     TPUDriverReconciler(client=c).reconcile(Request(name="pools-driver"))
-    docs = [d for d in c.list("apps/v1", "DaemonSet")]
+    docs = [thaw_obj(d) for d in c.list("apps/v1", "DaemonSet")]
     for d in docs:  # strip server-assigned noise for a stable golden
         for k in ("resourceVersion", "uid", "creationTimestamp",
                   "generation"):
             d["metadata"].pop(k, None)
         d.pop("status", None)
-        # the apply hash covers the (random) owner uid — not golden-stable
+        # the apply hashes cover the (random) owner uid — not golden-stable
         d["metadata"].get("annotations", {}).pop(
             "tpu.graft.dev/last-applied-hash", None)
+        d["metadata"].get("annotations", {}).pop(
+            "tpu.graft.dev/spec-hash", None)
         for ref in d["metadata"].get("ownerReferences", []):
             ref.pop("uid", None)
     return yaml.safe_dump_all(sorted(docs, key=lambda d:

@@ -34,6 +34,7 @@ from tpu_operator.isolation.vtpu import (
     read_vtpu_file,
 )
 from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.runtime.objects import thaw_obj
 from tpu_operator.validator import barrier, components
 
 V5E_LABELS = {
@@ -555,7 +556,7 @@ class TestReconcileWithSandbox:
         rec.reconcile(Request(name="tpu-cluster-policy"))
         ds = {d["metadata"]["name"] for d in c.list("apps/v1", "DaemonSet")}
         assert "tpu-chip-fencing" in ds
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["sandboxWorkloads"]["enabled"] = False
         c.update(cr)
         c.simulate_kubelet(ready=True)

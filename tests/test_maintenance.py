@@ -13,6 +13,7 @@ from tpu_operator.api import (
 from tpu_operator.api.tpudriver import V1ALPHA1
 from tpu_operator.cli.maintenance import CRD_API, apply_crds, cleanup
 from tpu_operator.runtime import FakeClient
+from tpu_operator.runtime.objects import thaw_obj
 
 
 class TestApplyCRDs:
@@ -29,8 +30,8 @@ class TestApplyCRDs:
         hook must replace its schema, not fail on AlreadyExists."""
         c = FakeClient()
         apply_crds(c)
-        crd = c.get(CRD_API, "CustomResourceDefinition",
-                    "tpuclusterpolicies.tpu.graft.dev")
+        crd = thaw_obj(c.get(CRD_API, "CustomResourceDefinition",
+                             "tpuclusterpolicies.tpu.graft.dev"))
         # simulate an old revision: strip the schema down
         crd["spec"]["versions"][0]["schema"] = {
             "openAPIV3Schema": {"type": "object"}}

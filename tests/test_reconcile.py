@@ -23,6 +23,7 @@ from tpu_operator.controllers.state_manager import (
     is_tpu_node,
 )
 from tpu_operator.runtime import FakeClient, ListOptions, Manager, Request
+from tpu_operator.runtime.objects import thaw_obj
 
 
 V5P_LABELS = {
@@ -83,7 +84,7 @@ class TestNodeLabelling:
         sm = StateManager(client=c, namespace="tpu-operator")
         sm.label_tpu_nodes()
         # simulate node losing its accelerator (pool recreate)
-        node = c.get("v1", "Node", "tpu-0")
+        node = thaw_obj(c.get("v1", "Node", "tpu-0"))
         del node["metadata"]["labels"][L.GKE_TPU_ACCELERATOR]
         node["status"]["allocatable"] = {}
         c.update(node)
@@ -149,7 +150,7 @@ class TestReconcile:
         assert any(d["metadata"]["name"] == "libtpu-metrics-exporter"
                    for d in c.list("apps/v1", "DaemonSet"))
         # disable the metrics exporter
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"] = {"metricsExporter": {"enabled": False}}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -172,7 +173,7 @@ class TestReconcile:
         c = make_cluster()
         c.create(new_cluster_policy())
         rec, _ = reconcile_once(c)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"] = {"libtpu": {"installDir": "/opt/custom"}}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -294,7 +295,7 @@ class TestRound2Fixes:
             "upgradePolicy": {"autoUpgrade": True}}))
         rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
         rec.reconcile(Request(name="tpu-cluster-policy"))
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"] = {"autoUpgrade": False}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -318,7 +319,7 @@ class TestRound2Fixes:
         c.create(new_cluster_policy(spec={"psa": {"enabled": True}}))
         rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
         rec.reconcile(Request(name="tpu-cluster-policy"))
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["psa"] = {"enabled": False}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -354,7 +355,7 @@ class TestStaleConditionalObjects:
         rbac = "rbac.authorization.k8s.io/v1"
         assert c.get(rbac, "ClusterRole", "tpu-device-plugin")
         assert c.get(rbac, "ClusterRoleBinding", "tpu-device-plugin")
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"] = {"devicePlugin": {}}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -370,7 +371,7 @@ class TestStaleConditionalObjects:
         mon = "monitoring.coreos.com/v1"
         monitors = c.list(mon, "ServiceMonitor")
         assert monitors, "serviceMonitor: true rendered no ServiceMonitor"
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"] = {"operator": {"serviceMonitor": False}}
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))

@@ -28,6 +28,7 @@ from tpu_operator.runtime.objects import (
     get_nested,
     match_labels,
     set_owner_reference,
+    thaw_obj,
 )
 
 
@@ -86,10 +87,10 @@ class TestFakeClient:
         c.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
                   "metadata": {"name": "d", "namespace": "default"},
                   "spec": {"x": 1}})
-        ds = c.get("apps/v1", "DaemonSet", "d", "default")
+        ds = thaw_obj(c.get("apps/v1", "DaemonSet", "d", "default"))
         assert ds["metadata"]["generation"] == 1
         ds["status"] = {"numberReady": 0}
-        ds = c.update(ds)
+        ds = thaw_obj(c.update(ds))
         assert ds["metadata"]["generation"] == 1
         ds["spec"]["x"] = 2
         ds = c.update(ds)
@@ -98,7 +99,7 @@ class TestFakeClient:
     def test_update_status_ignores_spec(self):
         c = FakeClient()
         c.create(make_cm("a", data={"k": "v"}))
-        obj = c.get("v1", "ConfigMap", "a", "default")
+        obj = thaw_obj(c.get("v1", "ConfigMap", "a", "default"))
         obj["data"] = {"k": "CHANGED"}
         obj["status"] = {"ok": True}
         c.update_status(obj)
@@ -310,7 +311,7 @@ class TestController:
             c.create(make_cm("a"))
             mgr.wait_idle(5)
             n = len(rec.seen)
-            obj = c.get("v1", "ConfigMap", "a", "default")
+            obj = thaw_obj(c.get("v1", "ConfigMap", "a", "default"))
             obj["status"] = {"tick": 1}
             c.update_status(obj)  # no generation change
             mgr.wait_idle(5)

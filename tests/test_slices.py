@@ -8,6 +8,7 @@ from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
 )
 from tpu_operator.runtime import FakeClient, ListOptions, Request
+from tpu_operator.runtime.objects import thaw_obj
 
 # 2x2x2 = 8 chips at 4 chips/host = a 2-host v5p slice
 SLICE_LABELS = {
@@ -41,8 +42,8 @@ def cr_slices(c):
 
 
 def set_validator_pod_ready(c, node, ready):
-    pod = c.get("v1", "Pod", f"tpu-operator-validator-{node}",
-                "tpu-operator")
+    pod = thaw_obj(c.get("v1", "Pod", f"tpu-operator-validator-{node}",
+                         "tpu-operator"))
     pod["status"]["conditions"] = [
         {"type": "Ready", "status": "True" if ready else "False"}]
     c.update_status(pod)
@@ -97,7 +98,7 @@ def test_slice_row_carries_upgrade_state():
     assert row["upgradeState"] == ""
     # the worst member state dominates the row
     for node, state in (("slice-a-0", "done"), ("slice-a-1", "failed")):
-        n = c.get("v1", "Node", node)
+        n = thaw_obj(c.get("v1", "Node", node))
         n["metadata"]["labels"][L.UPGRADE_STATE] = state
         c.update(n)
     rec.reconcile(req)
@@ -130,8 +131,8 @@ def test_terminating_validator_pod_does_not_validate():
     rec.reconcile(req)
     [row] = cr_slices(c)
     assert row["validated"] is True
-    pod = c.get("v1", "Pod", "tpu-operator-validator-slice-a-0",
-                "tpu-operator")
+    pod = thaw_obj(c.get("v1", "Pod", "tpu-operator-validator-slice-a-0",
+                         "tpu-operator"))
     pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
     c.update(pod)
     rec.reconcile(req)

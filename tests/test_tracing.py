@@ -15,6 +15,7 @@ from tpu_operator.runtime import (
     FakeClient,
     Request,
 )
+from tpu_operator.runtime.objects import thaw_obj
 from tpu_operator.runtime.tracing import (
     TRACER,
     Tracer,
@@ -219,7 +220,7 @@ class TestTracingClient:
             tc.list("v1", "Node")
             tc.create({"apiVersion": "v1", "kind": "ConfigMap",
                        "metadata": {"name": "cm", "namespace": NS}})
-            cm = tc.get("v1", "ConfigMap", "cm", NS)
+            cm = thaw_obj(tc.get("v1", "ConfigMap", "cm", NS))
             cm.setdefault("data", {})["k"] = "v"
             tc.update(cm)
             tc.patch("v1", "ConfigMap", "cm", {"data": {"k2": "v2"}}, NS)
@@ -317,8 +318,8 @@ class TestEventRecorderConflict:
                 raced["done"] = True
                 # the concurrent worker's bump lands first: the caller's
                 # in-flight update now carries a stale resourceVersion
-                other = fake.get("v1", "Event",
-                                 obj["metadata"]["name"], NS)
+                other = thaw_obj(fake.get("v1", "Event",
+                                          obj["metadata"]["name"], NS))
                 other["count"] = int(other["count"]) + 1
                 real_update(other)
             return real_update(obj)

@@ -17,7 +17,7 @@ from tpu_operator.controllers.upgrade_controller import (
     UpgradeReconciler,
 )
 from tpu_operator.runtime import FakeClient, ListOptions, Request
-from tpu_operator.runtime.objects import get_nested, labels_of, name_of
+from tpu_operator.runtime.objects import get_nested, labels_of, name_of, thaw_obj
 
 
 def build_converged_cluster(n_nodes=2, auto_upgrade=True):
@@ -42,7 +42,7 @@ def build_converged_cluster(n_nodes=2, auto_upgrade=True):
 def change_driver_spec(c, prec):
     """Bump the libtpu config so the driver DS template changes; OnDelete
     keeps existing pods on the old revision."""
-    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
     spec = cr.get("spec") or {}
     spec["libtpu"] = {"installDir": "/opt/new-libtpu"}
     cr["spec"] = spec
@@ -111,6 +111,7 @@ class TestUpgradeFSM:
         c.simulate_kubelet(ready=True)
         # force the recreated validator pod NotReady: validation must hold
         for pod in rec._validator_pods_by_node().get("tpu-0", []):
+            pod = thaw_obj(pod)
             for cond in get_nested(pod, "status", "conditions",
                                    default=[]) or []:
                 if cond.get("type") == "Ready":
@@ -242,6 +243,7 @@ class TestSliceGroupedUpgrades:
         c.simulate_kubelet(ready=True)
         # force h1's recreated validator NotReady
         for pod in rec._validator_pods_by_node().get("slice-h1", []):
+            pod = thaw_obj(pod)
             for cond in get_nested(pod, "status", "conditions",
                                    default=[]) or []:
                 if cond.get("type") == "Ready":
@@ -327,7 +329,7 @@ class TestEvictionDrain:
     def test_drain_force_deletes_at_deadline(self):
         clock = [1000.0]
         c, prec = build_converged_cluster(n_nodes=1)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"]["drainForce"] = True
         c.update(cr)
         add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
@@ -360,7 +362,7 @@ class TestEvictionDrain:
     def test_drain_respects_custom_timeout(self):
         clock = [0.0]
         c, prec = build_converged_cluster(n_nodes=1)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"]["drainTimeoutSeconds"] = 10
         c.update(cr)
         add_tpu_pod(c, "guarded", "tpu-0", labels={"app": "guarded"})
@@ -474,7 +476,7 @@ class TestReviewRegressions:
         """With no validator gate deployed, a unit must still not pass
         validation while its driver pod is absent mid-restart."""
         c, prec = build_converged_cluster(n_nodes=1)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["validator"] = {"enabled": False}
         c.update(cr)
         prec.reconcile(Request(name="tpu-cluster-policy"))
@@ -557,7 +559,7 @@ class TestFailureReleaseAndHealing:
         assert node_state(c, "tpu-0") == STATE_FAILED
         assert get_nested(c.get("v1", "Node", "tpu-0"), "spec",
                           "unschedulable") is True
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"]["autoUpgrade"] = False
         c.update(cr)
         rec.reconcile(Request(name="tpu-cluster-policy"))
@@ -624,7 +626,7 @@ class TestPerNodeUpgradeOptOut:
     def test_cr_annotation_pauses_whole_rollout(self):
         c, prec = build_converged_cluster(n_nodes=1)
         change_driver_spec(c, prec)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr.setdefault("metadata", {}).setdefault("annotations", {})[
             L.DRIVER_UPGRADE_ENABLED] = "false"
         c.update(cr)
@@ -662,7 +664,7 @@ class TestPerNodeUpgradeOptOut:
         c.patch("v1", "Node", "tpu-0",
                 {"metadata": {"annotations":
                               {L.DRIVER_UPGRADE_ENABLED: "false"}}})
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"] = {"autoUpgrade": False}
         c.update(cr)
         prec.reconcile(Request(name="tpu-cluster-policy"))
@@ -673,7 +675,7 @@ class TestPerNodeUpgradeOptOut:
             "annotations") or {}
         assert anns0.get(L.DRIVER_UPGRADE_ENABLED) == "false"
         assert L.DRIVER_UPGRADE_ENABLED not in anns1
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["upgradePolicy"] = {"autoUpgrade": True}
         c.update(cr)
         prec.reconcile(Request(name="tpu-cluster-policy"))
@@ -684,7 +686,7 @@ class TestPerNodeUpgradeOptOut:
     def test_sandbox_plane_halts_rollout(self):
         c, prec = build_converged_cluster(n_nodes=1)
         change_driver_spec(c, prec)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["sandboxWorkloads"] = {"enabled": True}
         c.update(cr)
         rec = UpgradeReconciler(client=c, namespace="tpu-operator")
@@ -756,7 +758,7 @@ class TestTPUDriverCRUpgradePath:
         drec.reconcile(Request(name="pool-a"))
         c.simulate_kubelet(ready=True)
         drec.reconcile(Request(name="pool-a"))
-        cr = c.get(V1ALPHA1, "TPUDriver", "pool-a")
+        cr = thaw_obj(c.get(V1ALPHA1, "TPUDriver", "pool-a"))
         assert cr["status"]["state"] == "ready"
 
         # change the driver flavor: OnDelete keeps the old pod running
@@ -824,7 +826,7 @@ class TestIsolatedPlaneDrain:
         """isolatedPlugin.resourceName / vtpuResourceName are CR knobs; a
         renamed resource's pods must still land in the drain set."""
         c, prec = build_converged_cluster(n_nodes=1)
-        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
         cr["spec"]["isolatedDevicePlugin"] = {
             "resourceName": "example.com/tpu-dedicated"}
         c.update(cr)

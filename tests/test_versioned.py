@@ -15,6 +15,7 @@ from tpu_operator.api.versioned import (
 )
 from tpu_operator.runtime import FakeClient
 from tpu_operator.runtime.client import ConflictError, NotFoundError
+from tpu_operator.runtime.objects import thaw_obj
 
 
 class TestClusterPolicies:
@@ -54,7 +55,7 @@ class TestClusterPolicies:
 
     def test_typed_status_view(self):
         cs = new_simple_clientset(ClusterPolicy.new("p"))
-        raw = cs.dynamic.get(V1, KIND_CLUSTER_POLICY, "p")
+        raw = thaw_obj(cs.dynamic.get(V1, KIND_CLUSTER_POLICY, "p"))
         raw["status"] = {
             "state": "ready",
             "conditions": [{"type": "Ready", "status": "True",

@@ -10,8 +10,15 @@ from __future__ import annotations
 import datetime
 from typing import Optional
 
-from ..runtime.client import Client, ConflictError, NotFoundError
-from ..runtime.objects import get_nested, name_of, namespace_of, set_nested
+from ..runtime.client import SPEC_HASH_GATE, Client, ConflictError, NotFoundError
+from ..runtime.objects import (
+    FrozenDict,
+    get_nested,
+    name_of,
+    namespace_of,
+    set_nested,
+    thaw_obj,
+)
 
 COND_READY = "Ready"
 COND_ERROR = "Error"
@@ -53,7 +60,8 @@ def set_condition(cr: dict, type_: str, status: str, reason: str,
 
 
 def update_status_with_retry(client: Client, cr: dict,
-                              attempts: int = 3) -> None:
+                              attempts: int = 3,
+                              live: Optional[dict] = None) -> None:
     """Status write with retry-on-conflict (client-go
     retry.RetryOnConflict semantics): the CR's spec/metadata move under
     the reconciler constantly (users edit the spec, the upgrade
@@ -61,7 +69,20 @@ def update_status_with_retry(client: Client, cr: dict,
     reconcile a backoff requeue — on a busy cluster that starves
     convergence. Status is reconciler-owned, so re-getting the object
     and re-applying OUR status over the fresh resourceVersion is safe
-    last-writer-wins on fields nobody else writes."""
+    last-writer-wins on fields nobody else writes.
+
+    ``live`` (the cached read the reconciler started from) enables the
+    zero-write steady state: when the computed status equals the live
+    status, the write is skipped client-side — even a server-side no-op
+    update_status still counts as an apiserver request. Gated by
+    OPERATOR_SPEC_HASH like the skel's spec-hash skip."""
+    if (live is not None and SPEC_HASH_GATE.enabled
+            and (live.get("status") or {}) == (cr.get("status") or {})):
+        from ..metrics.operator_metrics import OPERATOR_METRICS
+
+        OPERATOR_METRICS.writes_avoided.labels(
+            kind=cr.get("kind", "")).inc()
+        return
     for attempt in range(attempts):
         try:
             client.update_status(cr)
@@ -80,28 +101,38 @@ def update_status_with_retry(client: Client, cr: dict,
                                    namespace_of(cr) or None)
             except NotFoundError:
                 return  # deleted between the conflict and the re-get
+            fresh = thaw_obj(fresh)
             fresh["status"] = cr.get("status") or {}
             cr = fresh
 
 
-def set_ready(client: Client, cr: dict, message: str = "") -> None:
+def set_ready(client: Client, cr: dict, message: str = "",
+              live: Optional[dict] = None) -> None:
     """Ready=True, Error=False (conditions.Updater.SetConditionsReady)."""
+    if isinstance(cr, FrozenDict):
+        live, cr = cr, thaw_obj(cr)  # frozen read passed straight in
     set_condition(cr, COND_READY, "True", REASON_RECONCILED, message)
     set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
-    update_status_with_retry(client, cr)
+    update_status_with_retry(client, cr, live=live)
 
 
-def set_not_ready(client: Client, cr: dict, reason: str, message: str) -> None:
+def set_not_ready(client: Client, cr: dict, reason: str, message: str,
+                  live: Optional[dict] = None) -> None:
+    if isinstance(cr, FrozenDict):
+        live, cr = cr, thaw_obj(cr)
     set_condition(cr, COND_READY, "False", reason, message)
     set_condition(cr, COND_ERROR, "False", REASON_RECONCILED, "")
-    update_status_with_retry(client, cr)
+    update_status_with_retry(client, cr, live=live)
 
 
-def set_error(client: Client, cr: dict, reason: str, message: str) -> None:
+def set_error(client: Client, cr: dict, reason: str, message: str,
+              live: Optional[dict] = None) -> None:
     """Ready=False, Error=True (SetConditionsError)."""
+    if isinstance(cr, FrozenDict):
+        live, cr = cr, thaw_obj(cr)
     set_condition(cr, COND_READY, "False", reason, message)
     set_condition(cr, COND_ERROR, "True", reason, message)
-    update_status_with_retry(client, cr)
+    update_status_with_retry(client, cr, live=live)
 
 
 def get_condition(cr: dict, type_: str) -> Optional[dict]:

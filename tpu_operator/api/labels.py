@@ -49,6 +49,12 @@ UPGRADE_FAILED_REASON = f"{DOMAIN}/upgrade.failed-reason"
 
 # --- annotations ----------------------------------------------------------
 LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog
+# stable hash of the rendered desired object (spec-hash write avoidance,
+# state/skel.py): a live object carrying the desired hash AND matching
+# the desired spec is skipped without any apiserver verb, so a converged
+# steady pass costs the apiserver zero requests. OPERATOR_SPEC_HASH=0 /
+# --no-spec-hash restores the pre-optimization write path.
+SPEC_HASH = f"{DOMAIN}/spec-hash"
 STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
 # per-node driver auto-upgrade opt-in, stamped "true" by the policy
 # reconciler; SET it to any other value ("false", "paused") on a node to

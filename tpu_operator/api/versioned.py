@@ -32,6 +32,7 @@ from dataclasses import dataclass
 from typing import Callable, Generic, List, Optional, Type, TypeVar
 
 from ..runtime.client import Client, ListOptions, WatchEvent
+from ..runtime.objects import FrozenDict, thaw_obj
 from .clusterpolicy import (
     KIND_CLUSTER_POLICY,
     V1,
@@ -112,7 +113,9 @@ class TypedObject(Generic[S]):
         if raw.get("kind") not in (None, self.kind):
             raise ValueError(
                 f"expected kind {self.kind}, got {raw.get('kind')}")
-        self.raw = raw
+        # client reads hand out frozen views; the wrapper is an editing
+        # unit, so take a private mutable copy on ingest
+        self.raw = thaw_obj(raw) if isinstance(raw, FrozenDict) else raw
         self._spec: Optional[S] = None
 
     # metadata ------------------------------------------------------------

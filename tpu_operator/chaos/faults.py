@@ -55,6 +55,8 @@ CHIP_RESTORE = "chip-restore"      # chips come back
 POD_CRASH = "pod-crash"            # an operand pod crash-loops
 MUTATE_POLICY = "mutate-policy"    # spec edit the operator must apply
 TRIGGER_ROLLOUT = "trigger-rollout"  # libtpu change -> fleet upgrade FSM
+OPERAND_DRIFT = "operand-drift"    # out-of-band spec edit to a live operand
+ANNOTATION_CLEAR = "annotation-clear"  # strip the spec-hash annotations
 
 
 @dataclass(frozen=True)
@@ -120,6 +122,7 @@ class FaultPlan:
             "node-churn": cls._node_churn,
             "upgrade-under-fire": cls._upgrade_under_fire,
             "chip-loss": cls._chip_loss,
+            "operand-drift": cls._operand_drift,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -209,6 +212,28 @@ class FaultPlan:
             if step % 7 == 4:
                 out.append(Fault(step, API_THROTTLE, count=1,
                                  seconds=float(rng.randrange(1, 4))))
+        return out
+
+    @classmethod
+    def _operand_drift(cls, rng, nodes, steps) -> List[Fault]:
+        """A config-management adversary edits live operand specs
+        out-of-band (the spec-hash annotation stays intact — the exact
+        case an annotation-only skip is blind to) and strips the
+        spec-hash annotations entirely; the operator must detect both
+        and re-converge. ``count`` doubles as the deterministic victim
+        index into the sorted DaemonSet list."""
+        out: List[Fault] = []
+        for step in range(steps):
+            if step % 3 == 0:
+                out.append(Fault(step, OPERAND_DRIFT,
+                                 arg=cls._marker(rng, "drift"),
+                                 count=rng.randrange(0, 16)))
+            if step % 4 == 1:
+                out.append(Fault(step, ANNOTATION_CLEAR,
+                                 count=rng.randrange(0, 16)))
+            if step % 5 == 3:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(1, 3)))
         return out
 
     @classmethod

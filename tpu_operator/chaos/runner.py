@@ -48,8 +48,10 @@ from ..runtime.objects import (
     name_of,
     namespace_of,
     set_nested,
+    thaw_obj,
 )
 from .faults import (
+    ANNOTATION_CLEAR,
     API_CONFLICT,
     API_LATENCY,
     API_THROTTLE,
@@ -61,6 +63,7 @@ from .faults import (
     NODE_FLAP,
     NODE_HEAL,
     NODE_REMOVE,
+    OPERAND_DRIFT,
     POD_CRASH,
     TRIGGER_ROLLOUT,
     WATCH_DROP,
@@ -72,7 +75,7 @@ from .faults import (
 from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
-             "upgrade-under-fire", "chip-loss")
+             "upgrade-under-fire", "chip-loss", "operand-drift")
 
 NAMESPACE = "tpu-operator"
 POLICY = "tpu-cluster-policy"
@@ -166,6 +169,7 @@ def _mutate_cr(fake: FakeClient, mutate: Callable[[dict], None]) -> None:
         cr = fake.get_or_none(V1, KIND_CLUSTER_POLICY, POLICY)
         if cr is None:
             return
+        cr = thaw_obj(cr)  # reads are frozen store snapshots
         mutate(cr)
         try:
             fake.update(cr)
@@ -178,6 +182,7 @@ def _set_node_ready(fake: FakeClient, name: str, ready: bool) -> bool:
     node = fake.get_or_none("v1", "Node", name)
     if node is None:
         return False
+    node = thaw_obj(node)
     set_nested(node, [{"type": "Ready",
                        "status": "True" if ready else "False"}],
                "status", "conditions")
@@ -219,6 +224,7 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
     elif kind == CHIP_LOSS:
         node = fake.get_or_none("v1", "Node", fault.arg)
         if node is not None:
+            node = thaw_obj(node)
             alloc = get_nested(node, "status", "allocatable",
                                default={}) or {}
             state["chips"].setdefault(fault.arg,
@@ -234,6 +240,7 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
         saved = state["chips"].pop(fault.arg, None)
         node = fake.get_or_none("v1", "Node", fault.arg)
         if saved is not None and node is not None:
+            node = thaw_obj(node)
             for field in ("allocatable", "capacity"):
                 cur = dict(get_nested(node, "status", field,
                                       default={}) or {})
@@ -249,7 +256,7 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
              and not get_nested(p, "metadata", "deletionTimestamp")),
             key=name_of)
         if pods:  # deterministic victim: first by name
-            victim = pods[0]
+            victim = thaw_obj(pods[0])
             set_nested(victim, "Pending", "status", "phase")
             set_nested(victim, [{"type": "Ready", "status": "False"}],
                        "status", "conditions")
@@ -268,6 +275,44 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
             "libtpu", {"installDir": fault.arg}))
         state["rollout"] = True
         applied = True
+    elif kind == OPERAND_DRIFT:
+        # out-of-band spec edit that leaves the spec-hash annotation
+        # INTACT — the blind spot of an annotation-only skip. The image
+        # is a field every desired container carries, so the operator's
+        # live-vs-desired check must see the mismatch and rewrite.
+        dss = sorted(fake.list("apps/v1", "DaemonSet",
+                               ListOptions(namespace=NAMESPACE)),
+                     key=name_of)
+        if dss:
+            victim = thaw_obj(dss[fault.count % len(dss)])
+            ctrs = get_nested(victim, "spec", "template", "spec",
+                              "containers", default=[]) or []
+            if ctrs:
+                ctrs[0]["image"] = f"chaos-drift/{fault.arg}"
+                try:
+                    fake.update(victim)
+                    state["drift"] = True
+                    applied = True
+                except ConflictError:
+                    pass
+    elif kind == ANNOTATION_CLEAR:
+        # strip the hash annotations entirely (a `kubectl annotate ...-`
+        # adversary): the skip must fail closed and restore them
+        dss = sorted(fake.list("apps/v1", "DaemonSet",
+                               ListOptions(namespace=NAMESPACE)),
+                     key=name_of)
+        if dss:
+            victim = thaw_obj(dss[fault.count % len(dss)])
+            anns = victim.setdefault("metadata", {}).get("annotations") or {}
+            cleared = bool(anns.pop(L.SPEC_HASH, None)) \
+                | bool(anns.pop(L.LAST_APPLIED_HASH, None))
+            if cleared:
+                try:
+                    fake.update(victim)
+                    state["drift"] = True
+                    applied = True
+                except ConflictError:
+                    pass
     if applied:
         chaos.record(kind)
 
@@ -332,6 +377,19 @@ def _converged(fake: FakeClient, state: dict) -> bool:
         return False
     if state["rollout"] and not _fleet_rolled(fake):
         return False
+    if state.get("drift"):
+        # drift must be healed: every operand carries the spec-hash
+        # annotation again and no container still runs a drifted image
+        for ds in fake.list("apps/v1", "DaemonSet",
+                            ListOptions(namespace=NAMESPACE)):
+            anns = get_nested(ds, "metadata", "annotations",
+                              default={}) or {}
+            if L.SPEC_HASH not in anns:
+                return False
+            for ctr in get_nested(ds, "spec", "template", "spec",
+                                  "containers", default=[]) or []:
+                if str(ctr.get("image", "")).startswith("chaos-drift/"):
+                    return False
     from ..controllers.slices import slice_status
 
     return all(r["validated"] for r in slice_status(fake, NAMESPACE))
@@ -410,7 +468,7 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
 
-    state = {"marker": None, "rollout": False, "chips": {}}
+    state = {"marker": None, "rollout": False, "chips": {}, "drift": False}
     resync = Request(name=POLICY)
     checker = InvariantChecker(fake, NAMESPACE,
                                cache=client if cached else None)
@@ -500,6 +558,15 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         converged = _converged(fake, state)
     if converged:
         conv_s = clock.t - faults_stopped_at
+        # one final resync pass before the settled audit: the production
+        # Manager's periodic-resync analog. Label-only transitions the
+        # upgrade controller makes late in a tick (the last unit flipping
+        # to done) don't match the policy's node-watch predicate, so the
+        # CR's status rows may legitimately trail the cluster by one
+        # pass — a liveness gap resync closes, not a lost write.
+        for c in ctrls:
+            c.add(resync)
+            c.drain()
         checker.check_settled(plan.steps + soak)
     else:
         conv_s = None

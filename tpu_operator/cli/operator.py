@@ -58,6 +58,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable reconcile tracing (flight recorder + "
                         "/debug/traces); also OPERATOR_TRACE=0. The "
                         "latency histograms stay on either way")
+    from ..runtime.client import env_spec_hash_enabled
+
+    p.add_argument("--no-spec-hash", action="store_true",
+                   default=not env_spec_hash_enabled(),
+                   help="disable spec-hash write avoidance: every "
+                        "reconcile re-issues the pre-optimization "
+                        "create/update/status writes; also "
+                        "OPERATOR_SPEC_HASH=0 (debugging escape hatch "
+                        "when a suspected skip masks operand drift)")
     p.add_argument("--kubeconfig", default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
@@ -115,6 +124,10 @@ def main(argv=None) -> int:
     else:
         from ..runtime import CachedClient
         api = CachedClient(client)
+
+    from ..runtime.client import SPEC_HASH_GATE
+
+    SPEC_HASH_GATE.enabled = not args.no_spec_hash
 
     from ..runtime.tracing import TRACER, TracingClient
 

@@ -34,7 +34,7 @@ from ..runtime import (
     generation_changed,
     label_changed,
 )
-from ..runtime.objects import get_nested, name_of, set_nested
+from ..runtime.objects import get_nested, name_of, set_nested, thaw_obj
 from ..state.state import SyncStatus
 from .state_manager import StateManager
 
@@ -122,8 +122,8 @@ class ClusterPolicyReconciler(Reconciler):
     def _reconcile(self, request: Request) -> Result:
         import time as _time
 
-        cr = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
-        if cr is None:
+        live = self.client.get_or_none(V1, KIND_CLUSTER_POLICY, request.name)
+        if live is None:
             self._first_seen.pop(request.name, None)
             self._ready_recorded.discard(request.name)
             self._prev_slices.pop(request.name, None)
@@ -138,6 +138,10 @@ class ClusterPolicyReconciler(Reconciler):
                 OPERATOR_METRICS.slices_validated.set(0)
                 self._slices_exporter = None
             return Result()
+        # the cached read is a shared frozen snapshot; the reconcile
+        # mutates status in place, so work on a private thawed copy and
+        # keep ``live`` for the status-write skip in conditions
+        cr = thaw_obj(live)
         if request.name not in self._first_seen:
             self._first_seen[request.name] = _time.monotonic()
             if get_nested(cr, "status", "state") is not None or \
@@ -161,7 +165,7 @@ class ClusterPolicyReconciler(Reconciler):
             conditions.set_error(
                 self.client, cr, "DuplicateResource",
                 f"only one {KIND_CLUSTER_POLICY} is allowed; "
-                f"{name_of(all_crs[0])!r} is active")
+                f"{name_of(all_crs[0])!r} is active", live=live)
             return Result()
 
         spec = TPUClusterPolicySpec.from_obj(cr)
@@ -195,7 +199,7 @@ class ClusterPolicyReconciler(Reconciler):
             conditions.set_not_ready(
                 self.client, cr, "NoTPUNodes",
                 "no nodes with cloud.google.com/gke-tpu-accelerator labels "
-                "or google.com/tpu capacity found")
+                "or google.com/tpu capacity found", live=live)
             OPERATOR_METRICS.reconcile_total.inc()
             return Result(requeue_after=REQUEUE_NO_TPU_NODES_S)
 
@@ -262,20 +266,22 @@ class ClusterPolicyReconciler(Reconciler):
             self._set_state(cr, STATE_NOT_READY)
             conditions.set_error(
                 self.client, cr, conditions.REASON_ERROR,
-                "; ".join(f"{n}: {r.message}" for n, r in errors.items()))
+                "; ".join(f"{n}: {r.message}" for n, r in errors.items()),
+                live=live)
             OPERATOR_METRICS.reconcile_failures.inc()
             return Result(requeue_after=REQUEUE_NOT_READY_S)
         if not_ready:
             self._set_state(cr, STATE_NOT_READY)
             conditions.set_not_ready(
                 self.client, cr, conditions.REASON_OPERANDS_NOT_READY,
-                "; ".join(f"{n}: {r.message}" for n, r in not_ready.items()))
+                "; ".join(f"{n}: {r.message}" for n, r in not_ready.items()),
+                live=live)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         self._set_state(cr, STATE_READY)
         conditions.set_ready(self.client, cr,
                              f"all {len(results)} states ready "
-                             f"on {tpu_nodes} TPU node(s)")
+                             f"on {tpu_nodes} TPU node(s)", live=live)
         from ..state.nodepool import get_node_pools
 
         OPERATOR_METRICS.reconcile_status.set(1)

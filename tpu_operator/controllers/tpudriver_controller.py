@@ -35,7 +35,7 @@ from ..runtime import (
     enqueue_owner,
     generation_changed,
 )
-from ..runtime.objects import name_of, set_nested
+from ..runtime.objects import name_of, set_nested, thaw_obj
 from ..state.nodepool import get_node_pools
 from ..state.operands import (
     MANIFESTS_ROOT,
@@ -89,10 +89,14 @@ class TPUDriverReconciler(Reconciler):
                 controller=self.name).observe(_time.perf_counter() - started)
 
     def _reconcile(self, request: Request) -> Result:
-        cr = self.client.get_or_none(V1ALPHA1, KIND_TPU_DRIVER, request.name)
-        if cr is None:
+        live = self.client.get_or_none(V1ALPHA1, KIND_TPU_DRIVER, request.name)
+        if live is None:
             # deleted: owned DaemonSets go with it via ownerRef GC
             return Result()
+        # cached reads are shared frozen snapshots; status is written in
+        # place below, so reconcile a private thawed copy and keep
+        # ``live`` for the conditions status-write skip
+        cr = thaw_obj(live)
 
         # a ClusterPolicy must exist to supply stack-wide defaults
         # (nvidiadriver_controller.go:80-125)
@@ -103,7 +107,8 @@ class TPUDriverReconciler(Reconciler):
             # server bumped rv on the first)
             set_nested(cr, STATE_NOT_READY, "status", "state")
             conditions.set_error(self.client, cr, "MissingClusterPolicy",
-                                 "no TPUClusterPolicy found; create one first")
+                                 "no TPUClusterPolicy found; create one first",
+                                 live=live)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
         policy_spec = TPUClusterPolicySpec.from_obj(policies[0])
 
@@ -111,7 +116,8 @@ class TPUDriverReconciler(Reconciler):
             validate_node_selectors(self.client, cr)
         except ValidationError as e:
             set_nested(cr, STATE_NOT_READY, "status", "state")
-            conditions.set_error(self.client, cr, "Conflict", str(e))
+            conditions.set_error(self.client, cr, "Conflict", str(e),
+                                 live=live)
             return Result()  # user must fix the CR; no requeue loop
 
         spec = TPUDriverSpec.from_obj(cr)
@@ -152,7 +158,8 @@ class TPUDriverReconciler(Reconciler):
         if not pools:
             set_nested(cr, STATE_NOT_READY, "status", "state")
             conditions.set_not_ready(self.client, cr, "NoMatchingNodes",
-                                     "nodeSelector matches no TPU nodes")
+                                     "nodeSelector matches no TPU nodes",
+                                     live=live)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         ok, msg = objects_ready(self.client, applied)
@@ -160,14 +167,14 @@ class TPUDriverReconciler(Reconciler):
             set_nested(cr, STATE_NOT_READY, "status", "state")
             conditions.set_not_ready(
                 self.client, cr,
-                conditions.REASON_OPERANDS_NOT_READY, msg)
+                conditions.REASON_OPERANDS_NOT_READY, msg, live=live)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
 
         set_nested(cr, STATE_READY, "status", "state")
         conditions.set_ready(
             self.client, cr,
             f"libtpu ready on {len(pools)} pool(s): "
-            + ", ".join(p.name for p in pools))
+            + ", ".join(p.name for p in pools), live=live)
         log.info("TPUDriver %s ready across pools %s", request.name,
                  [p.name for p in pools])
         return Result()

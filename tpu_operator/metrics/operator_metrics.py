@@ -138,6 +138,22 @@ class OperatorMetrics:
             "API client verb latency, by verb/kind and whether the read "
             "was served from the informer cache or the apiserver",
             labelnames=("verb", "kind", "source"))
+        # zero-write steady state (state/skel.py spec-hash gate +
+        # api/conditions.py status-write skip, render memo in
+        # state/operands.py): how much apiserver traffic and render CPU
+        # the converged path avoided — the observable face of the
+        # "0 requests per settled pass" contract
+        self.writes_avoided = c(
+            "tpu_operator_writes_avoided_total",
+            "Apiserver writes skipped because the live object already "
+            "matches the rendered spec-hash (incl. no-op status writes)",
+            labelnames=("kind",))
+        self.render_cache_hits = c(
+            "tpu_operator_render_cache_hits_total",
+            "Operand renders served from the memoized render cache")
+        self.render_cache_misses = c(
+            "tpu_operator_render_cache_misses_total",
+            "Operand renders that had to run the template engine")
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -405,13 +405,27 @@ def _render_nodes(nodes: List[_Node], scope: _Scope, out: List[str]) -> None:
                 _render_nodes(node.body, _Scope(scope.root, item), out)
 
 
+# parse memoization: every reconcile re-reads the same manifest sources,
+# and lex+parse is pure in the source text — one AST per distinct source
+# serves every Template instance process-wide. Only successful parses are
+# cached so error paths keep their name-prefixed TemplateError. The AST
+# is shared read-only (_render_nodes never mutates nodes), so concurrent
+# renders of the same template are safe; a racing double-parse just
+# stores the same AST twice.
+_AST_CACHE: dict = {}
+
+
 class Template:
     def __init__(self, source: str, name: str = "<template>"):
         self.name = name
-        try:
-            self.nodes = _parse(_lex(source))
-        except TemplateError as e:
-            raise TemplateError(f"{name}: {e}") from e
+        nodes = _AST_CACHE.get(source)
+        if nodes is None:
+            try:
+                nodes = _parse(_lex(source))
+            except TemplateError as e:
+                raise TemplateError(f"{name}: {e}") from e
+            _AST_CACHE[source] = nodes
+        self.nodes = nodes
 
     def render(self, data: Any) -> str:
         out: List[str] = []

@@ -17,15 +17,38 @@ import yaml
 from .engine import Template, TemplateError
 
 
+# per-directory template cache: a Renderer is constructed per state per
+# reconcile, but the manifest files on disk rarely change — re-reading
+# and re-parsing 3-8 templates per state per pass is steady wasted CPU.
+# The fingerprint (name, mtime_ns, size) per file invalidates the entry
+# the moment a template is edited, added, or removed, so tests that
+# write temp manifest dirs (and operators live-edited in dev) stay
+# correct. Templates are immutable after construction, so sharing the
+# parsed list across Renderer instances is safe.
+_TEMPLATE_CACHE: dict = {}
+
+
 class Renderer:
     def __init__(self, manifests_dir: str | pathlib.Path):
         self.dir = pathlib.Path(manifests_dir)
         if not self.dir.is_dir():
             raise FileNotFoundError(f"manifest dir {self.dir} does not exist")
-        self._templates = [
-            (p.name, Template(p.read_text(), name=str(p)))
-            for p in sorted(self.dir.glob("*.yaml"))
-        ]
+        paths = sorted(self.dir.glob("*.yaml"))
+        fingerprint = tuple(
+            (p.name, st.st_mtime_ns, st.st_size)
+            for p in paths for st in (p.stat(),))
+        # exposed so higher-level render memoization (state/operands.py)
+        # can key rendered output on template content, not just data
+        self.fingerprint = fingerprint
+        cached = _TEMPLATE_CACHE.get(str(self.dir))
+        if cached is not None and cached[0] == fingerprint:
+            self._templates = cached[1]
+        else:
+            self._templates = [
+                (p.name, Template(p.read_text(), name=str(p)))
+                for p in paths
+            ]
+            _TEMPLATE_CACHE[str(self.dir)] = (fingerprint, self._templates)
         if not self._templates:
             raise FileNotFoundError(f"no *.yaml templates under {self.dir}")
 

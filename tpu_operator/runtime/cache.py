@@ -30,9 +30,12 @@ Design, mapped to client-go:
   dirty and the next read relists through the inner client and prunes
   keys that vanished during the gap (the 410-Gone relist analog; the
   chaos plane's ``watch-flap`` scenario drives exactly this path).
-* **Copy-on-read.** Readers get deep copies; reconcilers mutate their
-  result dicts freely without corrupting the shared store, same contract
-  as the inner clients.
+* **Copy-free frozen reads.** Readers get the stored object itself as a
+  recursively frozen view (``objects.freeze_obj``) — zero copies on the
+  hot read path; an accidental in-place mutation raises
+  ``FrozenObjectError`` instead of corrupting the shared store. Callers
+  that edit a read result ``thaw_obj()`` it first (the same contract the
+  inner clients now follow).
 * **Pluggable indexes.** ``Index(name, key_func)`` per kind; built-ins
   cover pod-by-node, pod-by-owner-uid, node-by-accelerator-label, and an
   automatic by-label index that turns plain ``{k: v}`` label-selector
@@ -50,7 +53,9 @@ from typing import Callable, Iterable, Optional
 from ..api import labels as L
 from .client import Client, ListOptions, NotFoundError, WatchEvent
 from .objects import (
+    FrozenDict,
     deepcopy_obj,
+    freeze_obj,
     get_nested,
     is_namespaced,
     labels_of,
@@ -295,8 +300,11 @@ class CachedClient(Client):
             if event.type == "DELETED":
                 store.remove(event.obj)
                 return
-            # the hub shares one event object between subscribers; own our copy
-            obj = deepcopy_obj(event.obj)
+            # freeze-on-ingest: a fake/cached inner already publishes
+            # frozen views (shared zero-copy); a mutable event object is
+            # converted once here — leaves are immutable scalars, so
+            # structural sharing with other subscribers is safe
+            obj = freeze_obj(event.obj)
             outcome = store.upsert(obj)
             if event.type == "ADDED" and outcome in ("same", "stale"):
                 key = store.key_of(obj)
@@ -330,7 +338,7 @@ class CachedClient(Client):
         listed_keys = set()
         for obj in listed:
             listed_keys.add(store.key_of(obj))
-            store.upsert(obj)
+            store.upsert(freeze_obj(obj))
         with store.lock:
             for key in list(store.objects):
                 if key in listed_keys or key not in pre:
@@ -359,8 +367,6 @@ class CachedClient(Client):
         ns = namespace or "" if is_namespaced(kind) else ""
         with store.lock:
             obj = store.objects.get((ns, name))
-            if obj is not None:
-                obj = deepcopy_obj(obj)
         if obj is None:
             raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
         self.cache_reads += 1
@@ -397,7 +403,7 @@ class CachedClient(Client):
                     if ("metadata.namespace" in fs
                             and namespace_of(obj) != fs["metadata.namespace"]):
                         continue
-                out.append(deepcopy_obj(obj))
+                out.append(obj)
         out.sort(key=obj_key)
         self.cache_reads += 1
         return out
@@ -405,7 +411,7 @@ class CachedClient(Client):
     def index(self, api_version: str, kind: str, index_name: str,
               key: str) -> list:
         """All cached objects of (api_version, kind) filed under ``key`` in
-        ``index_name`` — O(result) with copy-on-read, e.g.
+        ``index_name`` — O(result), served as frozen views, e.g.
         ``index("v1", "Pod", "by-node", node_name)``."""
         store = self._ensure(api_version, kind)
         self._maybe_relist(store)
@@ -414,7 +420,7 @@ class CachedClient(Client):
                 raise KeyError(
                     f"no index {index_name!r} on {api_version}/{kind}")
             keys = store._buckets[index_name].get(key, ())
-            out = [deepcopy_obj(store.objects[k]) for k in keys]
+            out = [store.objects[k] for k in keys]
         out.sort(key=obj_key)
         self.cache_reads += 1
         return out
@@ -463,11 +469,16 @@ class CachedClient(Client):
         store = self._stores.get((obj.get("apiVersion", ""),
                                   obj.get("kind", "")))
         if store is not None:
-            copy = deepcopy_obj(obj)
-            key = store.key_of(copy)
-            rv = get_nested(copy, "metadata", "resourceVersion")
+            # a frozen inner result (FakeClient) IS the authoritative
+            # stored view — share it zero-copy; a mutable one (HTTP
+            # client) is copied then frozen so later caller edits can't
+            # reach the store
+            frozen = (obj if type(obj) is FrozenDict
+                      else freeze_obj(deepcopy_obj(obj)))
+            key = store.key_of(frozen)
+            rv = get_nested(frozen, "metadata", "resourceVersion")
             with store.lock:
-                if store.upsert(copy) in ("new", "replaced") and rv:
+                if store.upsert(frozen) in ("new", "replaced") and rv:
                     store.written_rvs[key] = rv
         return obj
 

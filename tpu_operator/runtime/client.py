@@ -87,6 +87,28 @@ class WatchEvent:
     obj: dict
 
 
+def env_spec_hash_enabled(env=None) -> bool:
+    """Spec-hash write avoidance defaults ON; OPERATOR_SPEC_HASH=0 (or
+    false/no/off) disables it — same spelling as the tracing kill switch."""
+    import os
+
+    val = (env or os.environ).get("OPERATOR_SPEC_HASH", "1")
+    return str(val).strip().lower() not in ("0", "false", "no", "off")
+
+
+class SpecHashGate:
+    """Process-wide switch for spec-hash write avoidance (state/skel.py
+    skip-on-match + api/conditions.py status-write skip). Disabled, the
+    control plane issues exactly the pre-optimization writes — the
+    debugging escape hatch when a suspected skip masks drift."""
+
+    def __init__(self):
+        self.enabled = env_spec_hash_enabled()
+
+
+SPEC_HASH_GATE = SpecHashGate()
+
+
 @dataclass
 class ListOptions:
     namespace: Optional[str] = None

@@ -15,7 +15,7 @@ import logging
 from typing import Optional
 
 from .client import Client, ConflictError
-from .objects import name_of, namespace_of
+from .objects import name_of, namespace_of, thaw_obj
 
 log = logging.getLogger("tpu_operator.events")
 
@@ -69,6 +69,7 @@ class EventRecorder:
             existing = self.client.get_or_none("v1", "Event", name, ns)
             now = _now()
             if existing is not None:
+                existing = thaw_obj(existing)  # cached reads are frozen
                 existing["count"] = int(existing.get("count", 1)) + 1
                 existing["lastTimestamp"] = now
                 try:
@@ -82,6 +83,7 @@ class EventRecorder:
                                                        name, ns)
                     if existing is None:
                         raise
+                    existing = thaw_obj(existing)
                     existing["count"] = int(existing.get("count", 1)) + 1
                     existing["lastTimestamp"] = _now()
                     self.client.update(existing)

@@ -26,6 +26,7 @@ from .client import (
 )
 from .objects import (
     deepcopy_obj,
+    freeze_obj,
     get_nested,
     is_namespaced,
     labels_of,
@@ -35,6 +36,7 @@ from .objects import (
     namespace_of,
     obj_key,
     set_nested,
+    thaw_obj,
 )
 from ..utils.hash import object_hash
 
@@ -80,9 +82,17 @@ class FakeClient(Client):
         return (api_version, kind, ns, name)
 
     def _publish(self, type_: str, obj: dict) -> None:
-        self.hub.publish(WatchEvent(type_, deepcopy_obj(obj)))
+        # stored objects are frozen views: sharing them with watch
+        # handlers is safe zero-copy (a mutating handler raises)
+        self.hub.publish(WatchEvent(type_, obj))
 
     # -- CRUD --------------------------------------------------------------
+    #
+    # Copy-free reads: the store holds frozen views (objects.freeze_obj)
+    # built once per WRITE; get/list/watch hand the stored view out
+    # directly instead of deepcopying per read. Callers that edit a read
+    # result thaw_obj() it first — in-place mutation raises
+    # FrozenObjectError rather than corrupting the store.
 
     def get(self, api_version, kind, name, namespace=None,
             metadata_only=False):
@@ -93,7 +103,7 @@ class FakeClient(Client):
             obj = self._store.get(self._key(api_version, kind, name, namespace))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
-            return deepcopy_obj(obj)
+            return obj
 
     def list(self, api_version, kind, opts: Optional[ListOptions] = None):
         self._count("list")
@@ -114,7 +124,7 @@ class FakeClient(Client):
                         continue
                     if "metadata.namespace" in fs and ns != fs["metadata.namespace"]:
                         continue
-                out.append(deepcopy_obj(obj))
+                out.append(obj)
         out.sort(key=obj_key)
         return out
 
@@ -135,6 +145,7 @@ class FakeClient(Client):
             meta["resourceVersion"] = self._next_rv()
             meta.setdefault("generation", 1)
             meta.setdefault("creationTimestamp", "1970-01-01T00:00:00Z")
+            obj = freeze_obj(obj)
             self._store[key] = obj
             # creating with an ownerReference to an already-deleted owner:
             # the real apiserver accepts this and the GC controller collects
@@ -152,7 +163,7 @@ class FakeClient(Client):
                             name_of(obj), namespace_of(obj) or None)
             except NotFoundError:
                 pass
-        return deepcopy_obj(obj)
+        return obj
 
     def update(self, obj):
         self._count("update")
@@ -178,13 +189,14 @@ class FakeClient(Client):
             # no-op writes don't bump the RV or emit events (real apiserver
             # semantics; prevents self-sustaining reconcile storms)
             if obj == cur:
-                return deepcopy_obj(cur)
+                return cur
             meta["resourceVersion"] = self._next_rv()
             if obj.get("spec") != cur.get("spec"):
                 meta["generation"] = cur_gen + 1
+            obj = freeze_obj(obj)
             self._store[key] = obj
         self._publish("MODIFIED", obj)
-        return deepcopy_obj(obj)
+        return obj
 
     def update_status(self, obj):
         self._count("update_status")
@@ -196,13 +208,14 @@ class FakeClient(Client):
                 raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
             new_status = deepcopy_obj(obj.get("status") or {})
             if (cur.get("status") or {}) == new_status:
-                return deepcopy_obj(cur)  # no-op: no RV bump, no event
-            cur = deepcopy_obj(cur)
+                return cur  # no-op: no RV bump, no event
+            cur = thaw_obj(cur)
             cur["status"] = new_status
             cur["metadata"]["resourceVersion"] = self._next_rv()
+            cur = freeze_obj(cur)
             self._store[key] = cur
         self._publish("MODIFIED", cur)
-        return deepcopy_obj(cur)
+        return cur
 
     def patch(self, api_version, kind, name, patch, namespace=None):
         self._count("patch")
@@ -218,14 +231,15 @@ class FakeClient(Client):
             merged.setdefault("metadata", {})["uid"] = get_nested(
                 cur, "metadata", "uid")
             if merged == cur:
-                return deepcopy_obj(cur)  # no-op patch
+                return cur  # no-op patch
             merged["metadata"]["resourceVersion"] = self._next_rv()
             if merged.get("spec") != cur.get("spec"):
                 merged["metadata"]["generation"] = (
                     get_nested(cur, "metadata", "generation", default=1) or 1) + 1
+            merged = freeze_obj(merged)
             self._store[key] = merged
         self._publish("MODIFIED", merged)
-        return deepcopy_obj(merged)
+        return merged
 
     def delete(self, api_version, kind, name, namespace=None):
         self._count("delete")
@@ -298,7 +312,7 @@ class FakeClient(Client):
     def simulate_pod_phase(self, name: str, namespace: str, phase: str) -> None:
         """Flip a standalone pod's phase (used to drive validator workload
         pods to Succeeded, the analog of validator/main.go:1173 waitForPod)."""
-        pod = self.get("v1", "Pod", name, namespace)
+        pod = thaw_obj(self.get("v1", "Pod", name, namespace))
         set_nested(pod, phase, "status", "phase")
         self.update_status(pod)
 
@@ -396,6 +410,7 @@ def _kubelet_tick_ds(client: Client, ds: Mapping, ready: bool,
                     or get_nested(existing, "status", "phase") != phase
                     or get_nested(existing, "status",
                                   "conditions") != ready_conds):
+                existing = thaw_obj(existing)
                 existing["metadata"]["labels"] = new_labels
                 set_nested(existing, phase, "status", "phase")
                 set_nested(existing, ready_conds, "status", "conditions")
@@ -437,5 +452,6 @@ def _kubelet_tick_ds(client: Client, ds: Mapping, ready: bool,
     }
     cur = ds.get("status") or {}
     if any(cur.get(k) != v for k, v in status.items()):
+        ds = thaw_obj(ds)
         ds["status"] = {**cur, **status}
         client.update_status(ds)

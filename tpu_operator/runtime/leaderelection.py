@@ -17,6 +17,7 @@ import uuid
 from typing import Callable, Optional
 
 from .client import Client, ConflictError, NotFoundError
+from .objects import thaw_obj
 
 log = logging.getLogger("tpu_operator.leaderelection")
 
@@ -76,6 +77,8 @@ class LeaderElector:
         """One CAS attempt; returns True when we hold the lease."""
         lease = self.client.get_or_none("coordination.k8s.io/v1", "Lease",
                                         self.name, self.namespace)
+        if lease is not None:
+            lease = thaw_obj(lease)  # reads are frozen views
         if lease is None:
             try:
                 self.client.create(self._lease_obj())

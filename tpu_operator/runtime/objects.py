@@ -19,17 +19,111 @@ def deepcopy_obj(obj: dict) -> dict:
     return copy.deepcopy(obj)
 
 
+# ---------------------------------------------------------------------------
+# Frozen views: copy-free snapshot reads.
+#
+# The clients used to deepcopy every object they handed out so a caller's
+# in-place edit could not corrupt the store — paying O(object) per READ.
+# Freeze-on-ingest inverts that: the store holds recursively immutable
+# dict/list views and hands them out zero-copy; any accidental mutation
+# raises FrozenObjectError instead of silently corrupting shared state,
+# and the copy moves to the (rare) write path. A caller that wants to
+# edit calls thaw_obj() — and copy.deepcopy() of a frozen view already
+# yields plain mutable structures, so deepcopy_obj doubles as a thaw.
+# ---------------------------------------------------------------------------
+
+
+class FrozenObjectError(TypeError):
+    """In-place mutation of a cached read. The object is a shared
+    zero-copy snapshot; ``thaw_obj()`` it (or deepcopy) before editing."""
+
+
+def _frozen(*_a, **_k):
+    raise FrozenObjectError(
+        "object is a shared frozen snapshot from the client cache; "
+        "thaw_obj() it before mutating")
+
+
+class FrozenDict(dict):
+    """A dict whose mutators raise. Equality/iteration/json/yaml behave
+    exactly like dict (same storage); only writes are refused."""
+
+    __slots__ = ()
+    __setitem__ = __delitem__ = _frozen
+    setdefault = pop = popitem = clear = update = __ior__ = _frozen
+
+    def __deepcopy__(self, memo):
+        return {k: copy.deepcopy(v, memo) for k, v in self.items()}
+
+    def __copy__(self):
+        return dict(self)
+
+    def __reduce__(self):  # pickle round-trips to a plain dict
+        return (dict, (dict(self),))
+
+
+class FrozenList(list):
+    __slots__ = ()
+    __setitem__ = __delitem__ = __iadd__ = __imul__ = _frozen
+    append = extend = insert = pop = remove = clear = sort = reverse = _frozen
+
+    def __deepcopy__(self, memo):
+        return [copy.deepcopy(v, memo) for v in self]
+
+    def __copy__(self):
+        return list(self)
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+def freeze_obj(obj: Any) -> Any:
+    """Recursively convert dicts/lists to frozen views (shared leaves)."""
+    t = type(obj)
+    if t is FrozenDict or t is FrozenList:
+        return obj
+    if t is dict:
+        return FrozenDict((k, freeze_obj(v)) for k, v in obj.items())
+    if t is list:
+        return FrozenList(freeze_obj(v) for v in obj)
+    if t is tuple:
+        return FrozenList(freeze_obj(v) for v in obj)
+    if isinstance(obj, dict):
+        return FrozenDict((k, freeze_obj(v)) for k, v in obj.items())
+    if isinstance(obj, list):
+        return FrozenList(freeze_obj(v) for v in obj)
+    return obj
+
+
+def thaw_obj(obj: Any) -> Any:
+    """Deep mutable copy of a (possibly frozen) object tree."""
+    return copy.deepcopy(obj)
+
+
+try:  # yaml resolves representers by exact type for dict/list; teach it
+    import yaml as _yaml
+
+    for _dumper in (_yaml.SafeDumper, _yaml.Dumper):
+        _dumper.add_representer(
+            FrozenDict, _yaml.representer.SafeRepresenter.represent_dict)
+        _dumper.add_representer(
+            FrozenList, _yaml.representer.SafeRepresenter.represent_list)
+except ImportError:  # pragma: no cover - yaml is a hard dep elsewhere
+    pass
+
+
 def get_nested(obj: Mapping, *path: str, default: Any = None) -> Any:
     """Walk ``path`` through nested mappings, returning ``default`` on miss.
 
     Hot path for the whole framework (tens of millions of calls in the
-    scale tier): plain dicts take a ``type() is dict`` fast path;
-    anything else falls back to the abc Mapping check (NOT
-    ``typing.Mapping``, whose ``__instancecheck__`` costs ~2µs/call and
-    dominated the 500-node install profile)."""
+    scale tier): plain dicts — and the clients' FrozenDict views — take
+    a ``type() is`` fast path; anything else falls back to the abc
+    Mapping check (NOT ``typing.Mapping``, whose ``__instancecheck__``
+    costs ~2µs/call and dominated the 500-node install profile)."""
     cur: Any = obj
     for key in path:
-        if type(cur) is dict:
+        t = type(cur)
+        if t is dict or t is FrozenDict:
             if key not in cur:
                 return default
         elif not isinstance(cur, _ABCMapping) or key not in cur:

@@ -45,17 +45,22 @@ node-labelling side lives in controllers/state_manager.py.
 
 from __future__ import annotations
 
+import collections
 import functools
 import os
 import pathlib
 import re
+import threading
 from typing import Callable, List, Optional
 
 from .. import __version__
 from ..api.clusterpolicy import ComponentSpec
 from ..api.image import image_path
 from ..api.labels import deploy_label
+from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..render import Renderer
+from ..runtime.objects import deepcopy_obj
+from ..utils.hash import object_hash
 from .skel import apply_objects, delete_state_objects, objects_ready
 from .state import State, SyncContext, SyncResult, SyncStatus
 
@@ -302,6 +307,46 @@ def template_kinds(state_dir: str) -> frozenset:
     return frozenset(kinds)
 
 
+# render memoization: a steady reconcile rebuilds identical render data
+# for every state every pass — re-running the template engine and YAML
+# parse on identical inputs is the second-largest steady-state cost
+# after apiserver traffic. Keyed on (state, manifest dir, template
+# fingerprint, data hash) so both a spec change AND a template edit on
+# disk miss. Entries store a private deepcopy and hits return one:
+# apply_objects and apply_common_config mutate rendered objects in
+# place, so handing out the cached instance would poison the cache.
+_RENDER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_RENDER_CACHE_MAX = 256
+_render_cache_lock = threading.Lock()
+
+
+def _render_memoized(state_name: str, renderer: Renderer,
+                     data: dict) -> List[dict]:
+    try:
+        key = (state_name, str(renderer.dir), renderer.fingerprint,
+               object_hash(data))
+    except TypeError:
+        # non-JSON-able render data (never true of the built-in states,
+        # but data_fn is user surface) — render uncached
+        key = None
+    if key is not None:
+        with _render_cache_lock:
+            cached = _RENDER_CACHE.get(key)
+            if cached is not None:
+                _RENDER_CACHE.move_to_end(key)
+        if cached is not None:
+            OPERATOR_METRICS.render_cache_hits.inc()
+            return deepcopy_obj(cached)
+    OPERATOR_METRICS.render_cache_misses.inc()
+    objects = apply_common_config(renderer.render_objects(data), data)
+    if key is not None:
+        with _render_cache_lock:
+            _RENDER_CACHE[key] = deepcopy_obj(objects)
+            while len(_RENDER_CACHE) > _RENDER_CACHE_MAX:
+                _RENDER_CACHE.popitem(last=False)
+    return objects
+
+
 class OperandState(State):
     """A state fully described by (manifest dir, data builder, enable flag)."""
 
@@ -324,10 +369,11 @@ class OperandState(State):
     def render(self, ctx: SyncContext) -> List[dict]:
         """Render the state's manifests with the shared config surface
         applied — the one render path sync, goldens and the everything-
-        overridden test all go through."""
+        overridden test all go through. Memoized on (state, templates,
+        data): identical inputs skip the template engine and YAML parse
+        entirely."""
         data = self._data_fn(ctx)
-        return apply_common_config(
-            self.renderer().render_objects(data), data)
+        return _render_memoized(self.name, self.renderer(), data)
 
     def sweep_kinds(self) -> frozenset:
         return template_kinds(str(self._root / f"state-{self.name}"))

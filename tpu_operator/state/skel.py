@@ -23,8 +23,9 @@ import threading
 import weakref
 from typing import Iterable, List, Optional, Tuple
 
-from ..api.labels import LAST_APPLIED_HASH, STATE_LABEL
-from ..runtime.client import Client, ListOptions, NotFoundError
+from ..api.labels import LAST_APPLIED_HASH, SPEC_HASH, STATE_LABEL
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime.client import SPEC_HASH_GATE, Client, ListOptions, NotFoundError
 from ..runtime.objects import (
     annotations_of,
     get_nested,
@@ -37,6 +38,42 @@ from ..runtime.objects import (
 from ..utils.hash import object_hash
 
 log = logging.getLogger("tpu_operator.state")
+
+
+def _subset_match(desired, live) -> bool:
+    """Recursive desired⊆live: every desired dict key must match in the
+    live object (live-only extras are tolerated — apiserver defaulting
+    only ADDS fields); lists and scalars compare exactly. This is the
+    drift check behind the spec-hash skip: an out-of-band edit to a live
+    object leaves its spec-hash annotation intact, so the annotation
+    alone cannot be trusted."""
+    if isinstance(desired, dict):
+        if not isinstance(live, dict):
+            return False
+        return all(k in live and _subset_match(v, live[k])
+                   for k, v in desired.items())
+    if isinstance(desired, list):
+        return (isinstance(live, list) and len(desired) == len(live)
+                and all(_subset_match(d, l) for d, l in zip(desired, live)))
+    return desired == live
+
+
+def _live_matches_desired(desired: dict, live: dict) -> bool:
+    """True when ``live`` still embodies ``desired``: every non-metadata
+    top-level section subset-matches, and the desired labels/annotations
+    are a subset of the live ones (live metadata legitimately carries
+    uid/resourceVersion/creationTimestamp on top)."""
+    for k, v in desired.items():
+        if k in ("status", "metadata"):
+            continue
+        if not _subset_match(v, live.get(k)):
+            return False
+    dmeta = desired.get("metadata") or {}
+    lmeta = live.get("metadata") or {}
+    for mk in ("labels", "annotations"):
+        if not _subset_match(dmeta.get(mk) or {}, lmeta.get(mk) or {}):
+            return False
+    return True
 
 
 # per-client state names that have had a full sweep since that client's
@@ -78,6 +115,10 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
         desired_hash = object_hash(
             {k: v for k, v in obj.items() if k != "status"})
         set_annotation(obj, LAST_APPLIED_HASH, desired_hash)
+        # the spec-hash contract (OPERATIONS.md): same stable hash, the
+        # annotation the zero-write skip below keys on. Stamped before
+        # create/update so every live operand carries it.
+        set_annotation(obj, SPEC_HASH, desired_hash)
         desired_keys.add((obj.get("apiVersion", ""), obj.get("kind", ""),
                           namespace_of(obj), name_of(obj)))
         existing = client.get_or_none(obj.get("apiVersion", ""),
@@ -87,8 +128,20 @@ def apply_objects(client: Client, owner: Optional[dict], state_name: str,
             applied.append(client.create(obj))
             log.info("[%s] created %s/%s", state_name, obj["kind"], name_of(obj))
             continue
-        if annotations_of(existing).get(LAST_APPLIED_HASH) == desired_hash:
-            applied.append(existing)  # hash-skip
+        if SPEC_HASH_GATE.enabled:
+            # zero-write skip: annotation match alone is not enough — an
+            # out-of-band spec edit keeps the stamp, so the live object
+            # must also still subset-match the rendered desired state.
+            # Both checks run on the cached read: skipping costs the
+            # apiserver nothing.
+            if (annotations_of(existing).get(SPEC_HASH) == desired_hash
+                    and _live_matches_desired(obj, existing)):
+                OPERATOR_METRICS.writes_avoided.labels(
+                    kind=obj.get("kind", "")).inc()
+                applied.append(existing)  # hash-skip
+                continue
+        elif annotations_of(existing).get(LAST_APPLIED_HASH) == desired_hash:
+            applied.append(existing)  # hash-skip (pre-spec-hash behavior)
             continue
         merged = dict(obj)
         merged.setdefault("metadata", {})
