@@ -423,8 +423,45 @@ def _controlplane_doc() -> dict | None:
                 "wall_s": round(ro["wall_s"], 2),
                 "rolled": ro["rolled"],
             }
+            # the same rollout, edge-triggered: the upgrade reconciler's
+            # real watch set drives targeted re-syncs, so a pass is one
+            # kubelet tick + whatever the events enqueue. rollout_passes
+            # / rollout_wall_s at top level are the headline convergence
+            # figures (the acceptance target: <=11 passes at 100 nodes)
+            roe = run_rollout_bench(ro_n, max_parallel=8,
+                                    edge_triggered=True)
+            doc["rollout_edge"] = {
+                "n_tpu_nodes": ro_n,
+                "passes": roe["passes"],
+                "wall_s": round(roe["wall_s"], 2),
+                "rolled": roe["rolled"],
+                "reconciles": roe["reconciles"],
+            }
+            doc["rollout_passes"] = roe["passes"]
+            doc["rollout_wall_s"] = round(roe["wall_s"], 2)
         except Exception as e:
             doc["rollout"] = {"error": f"{type(e).__name__}: {e}"}
+        # DAG-vs-serial install on a latency-charged apiserver: the
+        # O(critical path) claim, measured in the same run (its own try
+        # for the same reason as rollout's)
+        try:
+            from tpu_operator.benchmarks.controlplane import (
+                run_dag_compare_bench,
+            )
+
+            dg = run_dag_compare_bench(n)
+            doc["dag"] = {
+                "n_tpu_nodes": dg["n_tpu_nodes"],
+                "verb_latency_ms": dg["verb_latency_ms"],
+                "install_serial_s": round(dg["install_serial_s"], 2),
+                "install_dag_s": round(dg["install_dag_s"], 2),
+                "speedup": round(dg["speedup"], 2) if dg["speedup"] else None,
+                "ready": dg["ready"],
+                "dag_levels": dg["dag_levels"],
+                "critical_path": dg["critical_path"],
+            }
+        except Exception as e:
+            doc["dag"] = {"error": f"{type(e).__name__}: {e}"}
         # concurrent-reconcile datapoint: the same install through the
         # threaded Manager at workers=1 vs workers=2 over the cache (its
         # own try for the same reason as rollout's)

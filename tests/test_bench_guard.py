@@ -70,6 +70,42 @@ def test_cached_steady_pass_not_regressed():
         f"regressed >25% vs best on record ({best:.4f}s)")
 
 
+def _keyed_figures(obj, key):
+    """Every positive numeric `key` in a record, wherever it nests."""
+    found = []
+    if isinstance(obj, dict):
+        v = obj.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            found.append(float(v))
+        for child in obj.values():
+            found.extend(_keyed_figures(child, key))
+    elif isinstance(obj, list):
+        for child in obj:
+            found.extend(_keyed_figures(child, key))
+    return found
+
+
+def test_install_to_ready_not_regressed():
+    """Same contract as the cached-steady guard, for the install→ready
+    wall time the DAG scheduler is meant to keep low: the latest round's
+    install_to_ready_s may be at most 25% above the best on record.
+    Skips until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "install_to_ready_s")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records install_to_ready_s yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} install_to_ready_s={latest:.4f}s "
+        f"regressed >25% vs best on record ({best:.4f}s)")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

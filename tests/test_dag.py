@@ -1,0 +1,408 @@
+"""DAG operand scheduler: plan compilation, kill switch, journal
+contract, edge-triggered watch fan-out, and the workqueue/cache
+counters that ride this PR (state/scheduler.py + state_manager.py +
+runtime/workqueue.py + runtime/cache.py)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.clusterpolicy import (
+    KIND_CLUSTER_POLICY,
+    V1,
+    new_cluster_policy,
+)
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.state.operands import build_states
+from tpu_operator.state.scheduler import (
+    DAG_GATE,
+    DagPlan,
+    DependencyCycleError,
+    SyncJournal,
+    env_dag_enabled,
+    resolve_requires,
+    run_plan,
+)
+from tpu_operator.state.state import State, SyncContext, SyncResult, SyncStatus
+
+
+class _Stub(State):
+    """Minimal state: records its own sync into a shared log."""
+
+    def __init__(self, name, requires=None, log=None, gate=None):
+        self.name = name
+        self._requires = requires
+        self._log = log if log is not None else []
+        self._gate = gate  # optional Event to block on (concurrency probe)
+
+    def requires(self):
+        return self._requires
+
+    def sync(self, ctx):
+        if self._gate is not None:
+            self._gate.wait(5.0)
+        self._log.append(self.name)
+        return SyncResult(SyncStatus.READY, "ok")
+
+
+def _ctx(client=None):
+    from tpu_operator.api.clusterpolicy import TPUClusterPolicySpec
+
+    return SyncContext(client=client or FakeClient(),
+                       policy=new_cluster_policy(),
+                       spec=TPUClusterPolicySpec.from_obj(new_cluster_policy()),
+                       namespace="tpu-operator", cluster={}, extra={})
+
+
+@pytest.fixture
+def dag_gate():
+    """Restore the process-wide gate whatever a test does to it."""
+    prev_enabled, prev_rng = DAG_GATE.enabled, DAG_GATE.virtual_rng
+    yield DAG_GATE
+    DAG_GATE.enabled, DAG_GATE.virtual_rng = prev_enabled, prev_rng
+
+
+# -- plan compilation --------------------------------------------------------
+
+
+def test_default_graph_compiles_to_golden_levels():
+    """The shipped operand graph: 15 states, 5 waves, deterministic
+    declaration-order tie-breaks — the golden order the ISSUE pins."""
+    plan = DagPlan.build(build_states())
+    assert plan.levels == (
+        ("pre-requisites", "operator-metrics", "feature-discovery"),
+        ("libtpu-driver", "tpu-runtime", "topology-manager",
+         "chip-fencing"),
+        ("operator-validation", "tpu-health", "metrics-exporter",
+         "vtpu-device-manager"),
+        ("tpu-device-plugin", "node-status-exporter",
+         "isolated-validation"),
+        ("isolated-device-plugin",),
+    )
+    assert plan.order == tuple(n for wave in plan.levels for n in wave)
+    # the critical path is a real requires() chain ending at max depth
+    assert len(plan.critical_path) == len(plan.levels)
+    for earlier, later in zip(plan.critical_path, plan.critical_path[1:]):
+        assert earlier in plan.requires[later]
+
+
+def test_requires_none_chains_to_declaration_order():
+    """Undeclared states degenerate to the legacy linear chain, so a
+    graph nobody annotated behaves exactly like the old serial walk."""
+    states = [_Stub("a"), _Stub("b"), _Stub("c")]
+    reqs = resolve_requires(states)
+    assert reqs == {"a": (), "b": ("a",), "c": ("b",)}
+    plan = DagPlan.build(states)
+    assert plan.levels == (("a",), ("b",), ("c",))
+
+
+def test_cycle_fails_at_plan_build_with_named_cycle():
+    states = [_Stub("a", requires=["c"]), _Stub("b", requires=["a"]),
+              _Stub("c", requires=["b"])]
+    with pytest.raises(DependencyCycleError) as ei:
+        DagPlan.build(states)
+    msg = str(ei.value)
+    # a concrete cycle, not "somewhere": every member is named
+    for name in ("a", "b", "c"):
+        assert name in msg
+    assert "->" in msg
+
+
+def test_cycle_fails_state_manager_construction():
+    """The operator must refuse to start on a cyclic graph — not wedge
+    on the Nth reconcile."""
+    from tpu_operator.controllers.state_manager import StateManager
+
+    states = [_Stub("a", requires=["b"]), _Stub("b", requires=["a"])]
+    with pytest.raises(DependencyCycleError):
+        StateManager(client=FakeClient(), namespace="tpu-operator",
+                     states=states)
+
+
+def test_unknown_requirement_is_an_error():
+    with pytest.raises(ValueError, match="unknown state"):
+        DagPlan.build([_Stub("a", requires=["ghost"])])
+
+
+def test_duplicate_state_names_are_an_error():
+    with pytest.raises(ValueError, match="duplicate"):
+        DagPlan.build([_Stub("x"), _Stub("x")])
+
+
+# -- execution modes ---------------------------------------------------------
+
+
+def test_kill_switch_restores_exact_serial_sequence(dag_gate):
+    """OPERATOR_DAG=0 / --serial-states: the sync order is byte-for-byte
+    the declaration order, whatever the declared DAG says."""
+    from tpu_operator.controllers.state_manager import StateManager
+
+    log = []
+    states = [_Stub("a", log=log), _Stub("b", requires=[], log=log),
+              _Stub("c", requires=["a"], log=log),
+              _Stub("d", requires=[], log=log)]
+    sm = StateManager(client=FakeClient(), namespace="tpu-operator",
+                      states=states)
+    dag_gate.enabled = False
+    results = sm._sync_serial(_ctx())
+    assert log == ["a", "b", "c", "d"]
+    assert set(results) == {"a", "b", "c", "d"}
+
+
+def test_virtual_mode_respects_dependencies_and_is_seed_stable(dag_gate):
+    import random
+
+    states = [_Stub("a"), _Stub("b", requires=[]),
+              _Stub("c", requires=["a"]), _Stub("d", requires=[])]
+    plan = DagPlan.build(states)
+
+    def run(seed):
+        order = []
+        run_plan(plan, order.append, rng=random.Random(seed))
+        return order
+
+    for seed in range(8):
+        order = run(seed)
+        assert order.index("a") < order.index("c")
+        assert run(seed) == order  # same seed -> same interleaving
+    assert len({tuple(run(s)) for s in range(8)}) > 1  # seeds differ
+
+
+def test_parallel_mode_overlaps_independent_states(dag_gate):
+    """Two root states genuinely run concurrently: each blocks until the
+    other has started (an Event handshake a serial walk would deadlock
+    on — hence the generous timeout doubling as the failure signal)."""
+    ga, gb = threading.Event(), threading.Event()
+    log = []
+    seen = {}
+
+    class _Meet(_Stub):
+        def sync(self, ctx):
+            mine, theirs = seen[self.name]
+            mine.set()
+            assert theirs.wait(5.0), \
+                f"{self.name} never saw its sibling start"
+            log.append(self.name)
+            return SyncResult(SyncStatus.READY, "ok")
+
+    a, b = _Meet("a", requires=[]), _Meet("b", requires=[])
+    seen["a"], seen["b"] = (ga, gb), (gb, ga)
+    plan = DagPlan.build([a, b])
+    done = {}
+    run_plan(plan, lambda n: done.setdefault(
+        n, {"a": a, "b": b}[n].sync(None)))
+    assert sorted(log) == ["a", "b"]
+
+
+def test_journal_orders_requirements_before_dependents(dag_gate):
+    """The SyncJournal's sequence numbers prove the contract the chaos
+    dag-order invariant checks: every requirement's done_seq precedes
+    its dependent's start_seq — in parallel mode, under load."""
+    states = ([_Stub(f"root{i}", requires=[]) for i in range(4)]
+              + [_Stub(f"leaf{i}", requires=[f"root{i}"])
+                 for i in range(4)])
+    plan = DagPlan.build(states)
+    journal = SyncJournal()
+    for pass_id in (1, 2, 3):
+        run_plan(plan, lambda n: None, journal=journal, pass_id=pass_id)
+    entries = journal.drain()
+    assert len(entries) == 8 * 3
+    done = {}
+    for e in entries:
+        done.setdefault(e.pass_id, {})[e.state] = e.done_seq
+    for e in entries:
+        for req in e.requires:
+            assert done[e.pass_id][req] < e.start_seq, (
+                f"pass {e.pass_id}: {e.state} started before {req} "
+                f"finished")
+
+
+def test_dag_order_invariant_flags_violations():
+    """Feed the checker a journal where a dependent started before its
+    requirement finished; it must record exactly that."""
+    from tpu_operator.chaos.invariants import InvariantChecker
+    from tpu_operator.state.scheduler import JournalEntry
+
+    journal = SyncJournal()
+    journal.record(JournalEntry(pass_id=1, state="early", start_seq=1,
+                                done_seq=4, requires=()))
+    journal.record(JournalEntry(pass_id=1, state="eager", start_seq=2,
+                                done_seq=5, requires=("early",)))
+    checker = InvariantChecker(FakeClient(), "tpu-operator",
+                               journal=journal)
+    checker._check_dag(step=0)
+    assert [v.invariant for v in checker.violations] == ["dag-order"]
+    assert "eager" in checker.violations[0].detail
+
+    # and a clean journal (order respected) records nothing
+    journal.record(JournalEntry(pass_id=2, state="early", start_seq=10,
+                                done_seq=11, requires=()))
+    journal.record(JournalEntry(pass_id=2, state="patient", start_seq=12,
+                                done_seq=13, requires=("early",)))
+    checker2 = InvariantChecker(FakeClient(), "tpu-operator",
+                                journal=journal)
+    checker2._check_dag(step=0)
+    assert checker2.violations == []
+
+
+def test_env_kill_switch_parsing(monkeypatch):
+    for val, want in (("0", False), ("false", False), ("no", False),
+                      ("off", False), ("1", True), ("", True)):
+        monkeypatch.setenv("OPERATOR_DAG", val)
+        assert env_dag_enabled() is want, (val, want)
+    monkeypatch.delenv("OPERATOR_DAG")
+    assert env_dag_enabled() is True
+
+
+def test_cli_serial_states_flag_sets_gate(dag_gate):
+    from tpu_operator.cli.operator import build_parser
+
+    args = build_parser().parse_args(["--serial-states"])
+    assert args.serial_states is True
+    args = build_parser().parse_args([])
+    assert args.serial_states is (not env_dag_enabled())
+
+
+# -- end-to-end through the reconciler ---------------------------------------
+
+
+def _tpu_cluster(n=2):
+    c = FakeClient()
+    for i in range(n):
+        c.add_node(f"tpu-node-{i}",
+                   labels={L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+                           L.GKE_TPU_TOPOLOGY: "2x2x1",
+                           L.GKE_ACCELERATOR_COUNT: "4"},
+                   allocatable={"google.com/tpu": "4"})
+    return c
+
+
+def _converge(c, rec, req):
+    rec.reconcile(req)
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+
+
+def test_dag_and_serial_reconciles_agree(dag_gate):
+    """Same cluster, both modes: identical CR state and identical
+    per-state readiness — the modes differ in schedule only."""
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+
+    outcomes = {}
+    for mode in ("dag", "serial"):
+        dag_gate.enabled = mode == "dag"
+        c = _tpu_cluster()
+        c.create(new_cluster_policy())
+        rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+        _converge(c, rec, Request(name="tpu-cluster-policy"))
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        ready_msg = next(
+            (cond.get("message") for cond in
+             (cr.get("status") or {}).get("conditions", [])
+             if cond.get("type") == "Ready"), "")
+        outcomes[mode] = ((cr.get("status") or {}).get("state"), ready_msg)
+    assert outcomes["dag"] == outcomes["serial"]
+    assert outcomes["dag"][0] == "ready"
+
+
+def test_watch_sources_fan_out_triggers_resync():
+    """Each declared watch_sources() kind is wired into the controller:
+    an event on that kind enqueues the policy for a targeted re-sync."""
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.runtime.manager import Controller
+
+    c = _tpu_cluster()
+    c.create(new_cluster_policy())
+    rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    req = Request(name="tpu-cluster-policy")
+    _converge(c, rec, req)
+
+    assert rec.state_manager.watch_sources() == [
+        ("apps/v1", "DaemonSet"), ("v1", "Service"), ("v1", "Pod")]
+
+    ctrl = Controller("cp-test", rec, c)
+    rec.setup_controller(ctrl, None)
+    # registration replays ADDED for live objects; flush those
+    while ctrl.queue.get(timeout=0) is not None:
+        pass
+    # drain leftovers: every get must be paired with done
+    snap = ctrl.queue.snapshot()
+    for item in snap.processing:
+        ctrl.queue.done(item)
+
+    for kind, obj in (
+        ("Service", {"apiVersion": "v1", "kind": "Service",
+                     "metadata": {"name": "edge-svc",
+                                  "namespace": "tpu-operator"}}),
+        ("Pod", {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "edge-pod",
+                              "namespace": "tpu-operator"}}),
+        ("DaemonSet", {"apiVersion": "apps/v1", "kind": "DaemonSet",
+                       "metadata": {"name": "edge-ds",
+                                    "namespace": "tpu-operator",
+                                    # owned operands route via
+                                    # enqueue_owner, not the fan-out
+                                    "ownerReferences": [{
+                                        "apiVersion": V1,
+                                        "kind": KIND_CLUSTER_POLICY,
+                                        "name": "tpu-cluster-policy"}]}}),
+    ):
+        c.create(obj)
+        got = ctrl.queue.get(timeout=0)
+        assert got is not None, f"{kind} event did not enqueue a re-sync"
+        assert got.name == "tpu-cluster-policy"
+        ctrl.queue.done(got)
+        while True:  # absorb mapper fan-out duplicates
+            extra = ctrl.queue.get(timeout=0)
+            if extra is None:
+                break
+            ctrl.queue.done(extra)
+    ctrl.stop()
+
+
+def test_workqueue_coalescing_counts_absorbed_adds():
+    from tpu_operator.runtime.workqueue import WorkQueue
+
+    hits = []
+    q = WorkQueue(on_coalesced=lambda: hits.append(1))
+    q.add("k")
+    q.add("k")            # already pending -> coalesced
+    assert q.coalesced_total == 1
+    item = q.get(timeout=0)
+    assert item == "k"
+    q.add("k")            # in-flight: first re-add buys the dirty re-run
+    assert q.coalesced_total == 1
+    q.add("k")            # second re-add while dirty -> coalesced
+    q.add("k")
+    assert q.coalesced_total == 3
+    q.done("k")
+    assert q.get(timeout=0) == "k"  # the dirty re-run
+    q.done("k")
+    assert q.get(timeout=0) is None
+    assert len(hits) == 3
+
+
+def test_cache_relists_counter_increments():
+    from tpu_operator.metrics.registry import REGISTRY
+    from tpu_operator.runtime import CachedClient
+
+    def sample():
+        return REGISTRY.get_sample_value(
+            "tpu_operator_cache_relists_total", {"kind": "Node"}) or 0.0
+
+    c = _tpu_cluster()
+    cached = CachedClient(c)
+    cached.list("v1", "Node")   # warm the informer
+    before = sample()
+    relists_attr_before = cached.relists
+    cached.resync()
+    assert cached.relists > relists_attr_before
+    assert sample() > before
+    cached.close()

@@ -253,17 +253,148 @@ def run_concurrency_bench(n_tpu: int = 500, workers: int = 1,
     }
 
 
+class _LatencyClient:
+    """Charge a fixed wall latency per apiserver verb (a real sleep, so
+    it releases the GIL and parallel state syncs genuinely overlap it).
+    This is the wire-latency model ``run_dag_compare_bench`` needs: on
+    the zero-latency fake, a serial and a DAG install differ only by
+    Python CPU time, which the GIL serializes anyway — with per-verb
+    latency, the serial walk pays the *sum* of every state's verb naps
+    while the DAG walk pays only its critical path's. ``watch`` is
+    exempt (subscribing isn't a round-trip the reconcile path waits on);
+    everything else, reads included, naps once per call."""
+
+    def __init__(self, inner, per_verb_s: float):
+        self.inner = inner
+        self.per_verb_s = per_verb_s
+
+    def _nap(self):
+        time.sleep(self.per_verb_s)
+
+    def get(self, *a, **kw):
+        self._nap()
+        return self.inner.get(*a, **kw)
+
+    def get_or_none(self, *a, **kw):
+        self._nap()
+        return self.inner.get_or_none(*a, **kw)
+
+    def list(self, *a, **kw):
+        self._nap()
+        return self.inner.list(*a, **kw)
+
+    def create(self, *a, **kw):
+        self._nap()
+        return self.inner.create(*a, **kw)
+
+    def update(self, *a, **kw):
+        self._nap()
+        return self.inner.update(*a, **kw)
+
+    def update_status(self, *a, **kw):
+        self._nap()
+        return self.inner.update_status(*a, **kw)
+
+    def patch(self, *a, **kw):
+        self._nap()
+        return self.inner.patch(*a, **kw)
+
+    def delete(self, *a, **kw):
+        self._nap()
+        return self.inner.delete(*a, **kw)
+
+    def watch(self, *a, **kw):
+        return self.inner.watch(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def run_dag_compare_bench(n_tpu: int = 500,
+                          verb_latency_s: float = 0.015) -> Dict:
+    """Same install, serial walk vs DAG scheduler, on a latency-charged
+    apiserver — the datapoint behind "install-to-ready is O(critical
+    path), not O(states)".
+
+    Per mode: a fresh n_tpu cluster is pre-labeled through the RAW
+    client (the O(nodes) node-patch pass is identical in both modes and
+    isn't what the DAG parallelizes — charging it latency would only
+    dilute the comparison), then a reconciler over a
+    :class:`_LatencyClient` runs install -> all-operands-Ready with the
+    gate forced serial, then forced DAG. Returns both walls, the
+    speedup, and the plan's shape."""
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.state_manager import StateManager
+    from ..state.scheduler import DAG_GATE
+
+    def install(dag: bool):
+        c = build_cluster(n_tpu)
+        c.create(new_cluster_policy())
+        # pre-pass with the reconciler's own arguments (default spec:
+        # sandbox off, auto-upgrade off) so the measured reconcile's
+        # label pass finds zero drift and pays one LIST, no patches
+        pre = StateManager(client=c, namespace="tpu-operator")
+        pre.label_tpu_nodes("container", sandbox_enabled=False,
+                            upgrade_annotation=False)
+        rec = ClusterPolicyReconciler(
+            client=_LatencyClient(c, verb_latency_s),
+            namespace="tpu-operator")
+        req = Request(name="tpu-cluster-policy")
+        prev = DAG_GATE.enabled
+        DAG_GATE.enabled = dag
+        try:
+            t0 = time.perf_counter()
+            rec.reconcile(req)
+            c.simulate_kubelet(ready=True)
+            rec.reconcile(req)
+            wall = time.perf_counter() - t0
+        finally:
+            DAG_GATE.enabled = prev
+        cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+        return wall, (cr.get("status") or {}).get("state") == "ready"
+
+    serial_s, serial_ready = install(dag=False)
+    dag_s, dag_ready = install(dag=True)
+    from ..state.operands import build_states
+    from ..state.scheduler import DagPlan
+
+    plan = DagPlan.build(build_states())
+    return {
+        "n_tpu_nodes": n_tpu,
+        "verb_latency_ms": verb_latency_s * 1000.0,
+        "install_serial_s": serial_s,
+        "install_dag_s": dag_s,
+        "speedup": (serial_s / dag_s) if dag_s > 0 else None,
+        "ready": serial_ready and dag_ready,
+        "n_states": len(plan.order),
+        "dag_levels": len(plan.levels),
+        "critical_path": list(plan.critical_path),
+    }
+
+
 def run_rollout_bench(n_tpu: int = 100, max_parallel: int = 8,
-                      pass_budget: int = 50) -> Dict:
+                      pass_budget: int = 50,
+                      edge_triggered: bool = False) -> Dict:
     """Fleet driver-rollout throughput: bump the libtpu spec on a
     converged n_tpu-node cluster and drive the upgrade FSM
     (maxParallelUpgrades=max_parallel) until every TPU node is done and
     every driver pod runs the new template revision.
 
-    Returns {n_tpu_nodes, max_parallel, passes, wall_s, rolled} —
-    the scale datapoint the reference has no analog for (its upgrade
-    loop is driven by requeues against a live cluster and is never
-    measured). ``rolled`` False means the pass budget ran out first."""
+    ``edge_triggered=False`` (the default) drives the FSM the pre-DAG
+    way: one blind ``urec.reconcile`` per pass, however little changed.
+    ``edge_triggered=True`` registers the upgrade reconciler's real
+    watch set (CR generation, driver DaemonSets, driver/validator pods,
+    node upgrade-state labels) on a real :class:`~.manager.Controller`
+    and drains only what the watches enqueue — a pass does as many
+    targeted reconciles as events warrant, so one kubelet tick advances
+    a whole admitted batch and the fleet converges in O(batches) passes
+    instead of O(2x batches) blind polls.
+
+    Returns {n_tpu_nodes, max_parallel, passes, wall_s, rolled,
+    reconciles} — the scale datapoint the reference has no analog for
+    (its upgrade loop is driven by requeues against a live cluster and
+    is never measured). ``rolled`` False means the pass budget ran out
+    first."""
     from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
     from ..controllers.upgrade_controller import (
         STATE_DONE,
@@ -283,6 +414,46 @@ def run_rollout_bench(n_tpu: int = 100, max_parallel: int = 8,
     prec.reconcile(req)
     c.simulate_kubelet(ready=True)
     prec.reconcile(req)
+
+    ctrl = None
+    reconciles = 0
+    if edge_triggered:
+        from ..runtime.manager import Controller
+
+        # the real Controller's watch/queue wiring, drained inline (no
+        # worker threads — the bench stays deterministic and the pass
+        # count stays comparable to the serial loop's). Registered
+        # BEFORE the spec bump below, so the bump's generation change is
+        # itself the first edge.
+        ctrl = Controller("tpu-upgrade-bench", urec, c)
+        urec.setup_controller(ctrl, None)
+
+    def drain(budget: int = 200) -> int:
+        """Reconcile what the watches enqueued, inline. Timed requeues
+        stay parked (they are the liveness backstop, not the edge
+        path); an event-storm on the policy key collapses to one queued
+        item plus one dirty re-run — the workqueue's coalescing."""
+        done = 0
+        while done < budget:
+            item = ctrl.queue.get(timeout=0)
+            if item is None:
+                break
+            done += 1
+            try:
+                result = urec.reconcile(item)
+            except Exception:
+                ctrl.queue.add_rate_limited(item)
+            else:
+                if result and result.requeue_after > 0:
+                    ctrl.queue.forget(item)
+                    ctrl.queue.add_after(item, result.requeue_after)
+                elif result and result.requeue:
+                    ctrl.queue.add_rate_limited(item)
+                else:
+                    ctrl.queue.forget(item)
+            finally:
+                ctrl.queue.done(item)
+        return done
 
     cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
     cr["spec"]["libtpu"] = {"installDir": "/opt/rollout-marker"}
@@ -315,15 +486,25 @@ def run_rollout_bench(n_tpu: int = 100, max_parallel: int = 8,
     rolled = False
     while passes < pass_budget:
         passes += 1
-        urec.reconcile(req)
-        c.simulate_kubelet(ready=True)
+        if edge_triggered:
+            reconciles += drain()
+            c.simulate_kubelet(ready=True)
+            reconciles += drain()
+        else:
+            reconciles += 1
+            urec.reconcile(req)
+            c.simulate_kubelet(ready=True)
         if fleet_done():
             rolled = True
             break
+    if ctrl is not None:
+        ctrl.stop()
     return {
         "n_tpu_nodes": n_tpu,
         "max_parallel": max_parallel,
+        "edge_triggered": edge_triggered,
         "passes": passes,
         "wall_s": time.perf_counter() - t0,
         "rolled": rolled,
+        "reconciles": reconciles,
     }

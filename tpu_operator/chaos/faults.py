@@ -123,6 +123,7 @@ class FaultPlan:
             "upgrade-under-fire": cls._upgrade_under_fire,
             "chip-loss": cls._chip_loss,
             "operand-drift": cls._operand_drift,
+            "dag-race": cls._dag_race,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -234,6 +235,33 @@ class FaultPlan:
             if step % 5 == 3:
                 out.append(Fault(step, API_CONFLICT,
                                  count=rng.randrange(1, 3)))
+        return out
+
+    @classmethod
+    def _dag_race(cls, rng, nodes, steps) -> List[Fault]:
+        """Operand-sync faults aimed at parallel DAG branches: 409/503
+        bursts land mid-wave (the seeded virtual scheduler shuffles which
+        branch eats them per seed), operand drift forces re-applies on
+        one branch while siblings are mid-sync, and spec mutations keep
+        every state re-rendering. The dag-order invariant must hold — no
+        state may sync before all its ``requires()`` report ready —
+        whichever branch the fault lands on."""
+        out: List[Fault] = []
+        for step in range(steps):
+            if step % 2 == 0:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 3 == 0:
+                out.append(Fault(step, MUTATE_POLICY,
+                                 arg=cls._marker(rng, "race")))
+            if step % 3 == 1:
+                out.append(Fault(step, OPERAND_DRIFT,
+                                 arg=cls._marker(rng, "race-drift"),
+                                 count=rng.randrange(0, 16)))
+            if step % 5 == 2:
+                out.append(Fault(step, API_UNAVAILABLE, count=1))
+            if step % 4 == 3:
+                out.append(Fault(step, WATCH_DROP))
         return out
 
     @classmethod

@@ -31,6 +31,14 @@ point*, not just at convergence:
   settled, the cache must agree exactly: same keys, same
   resourceVersions, for every kind it caches — a dropped watch that
   resumed must leave no stale or phantom entries behind.
+- ``dag-order`` (when the runner hands over the state manager's
+  :class:`~tpu_operator.state.scheduler.SyncJournal`): within every sync
+  pass, no operand state may *start* syncing before every state in its
+  ``requires()`` has *finished* — the dependency contract the DAG
+  scheduler exists to uphold, checked against the journal's sequence
+  numbers rather than trusted. Journal entries accumulate per pass
+  across drains, so a pass split over two observation points cannot
+  false-positive.
 - ``convergence``: recorded by the runner when the cluster fails to
   reach all-Ready within the soak budget after faults stop.
 
@@ -71,13 +79,16 @@ class Violation:
 
 class InvariantChecker:
     def __init__(self, client: Client, namespace: str = "tpu-operator",
-                 cache=None):
+                 cache=None, journal=None):
         self.client = client
         self.namespace = namespace
         self.cache = cache  # CachedClient under test, or None
+        self.journal = journal  # state manager's SyncJournal, or None
         self.violations: List[Violation] = []
         self._last_rv: Dict[Tuple[str, str, str], int] = {}
         self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
+        # pass_id -> {state: done_seq}, accumulated across journal drains
+        self._dag_done: Dict[int, Dict[str, int]] = {}
 
     def record(self, invariant: str, step: int, detail: str) -> None:
         self.violations.append(Violation(invariant, step, detail))
@@ -95,6 +106,39 @@ class InvariantChecker:
         self._check_fsm(step, nodes)
         self._check_budget(step, nodes)
         self._check_cache(step, settled=False)
+        self._check_dag(step)
+
+    # -- DAG dependency order ----------------------------------------------
+
+    def _check_dag(self, step: int) -> None:
+        """No state starts before its requires() finished, per pass.
+
+        Journal entries are recorded at state *completion* (the scheduler
+        joins each wave before the next draws start sequences), so by the
+        time a dependent's entry exists, every prerequisite's entry from
+        the same pass exists too — a missing or later-finishing
+        prerequisite is a genuine ordering violation, not a drain
+        artifact."""
+        if self.journal is None:
+            return
+        entries = self.journal.drain()
+        for e in entries:
+            self._dag_done.setdefault(e.pass_id, {})[e.state] = e.done_seq
+        for e in entries:
+            done = self._dag_done.get(e.pass_id, {})
+            for req in e.requires:
+                done_seq = done.get(req)
+                if done_seq is None or done_seq > e.start_seq:
+                    self.record(
+                        "dag-order", step,
+                        f"pass {e.pass_id}: {e.state} started (seq "
+                        f"{e.start_seq}) before required state {req} "
+                        f"finished (seq {done_seq})")
+        # old passes can never gain new entries; keep the map bounded
+        if entries:
+            newest = max(e.pass_id for e in entries)
+            for pid in [p for p in self._dag_done if p < newest - 4]:
+                del self._dag_done[pid]
 
     # -- cache coherence ----------------------------------------------------
 
@@ -280,6 +324,7 @@ class InvariantChecker:
                             f"({len(cr_rows)} rows) disagrees with a fresh "
                             f"slice_status ({len(rows)} rows)")
         self._check_cache(step, settled=True)
+        self._check_dag(step)
 
 
 def namespace_key(obj: dict) -> str:

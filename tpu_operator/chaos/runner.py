@@ -75,7 +75,8 @@ from .faults import (
 from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
-             "upgrade-under-fire", "chip-loss", "operand-drift")
+             "upgrade-under-fire", "chip-loss", "operand-drift",
+             "dag-race")
 
 NAMESPACE = "tpu-operator"
 POLICY = "tpu-cluster-policy"
@@ -427,7 +428,10 @@ def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
 
 def _run_scenario(scenario: str, nodes: int, seed: int,
                   steps: Optional[int], cached: bool) -> dict:
+    import random
+
     from ..runtime.tracing import TRACER
+    from ..state.scheduler import DAG_GATE
 
     # the scenario owns the process-wide flight recorder for its
     # duration: span timestamps come from the virtual clock and sequence
@@ -436,10 +440,19 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
     clock = VirtualClock()
     prev_clock, prev_enabled = TRACER.clock, TRACER.enabled
     TRACER.reset(clock=clock, enabled=True)
+    # the DAG scheduler runs in VIRTUAL mode: waves execute sequentially
+    # in a seeded shuffle, so branch interleaving is adversarial (a fault
+    # lands on a different parallel branch per seed) yet the run stays
+    # single-threaded and byte-identical per seed. A fresh RNG per run
+    # makes back-to-back runs of the same seed identical too.
+    prev_dag, prev_rng = DAG_GATE.enabled, DAG_GATE.virtual_rng
+    DAG_GATE.enabled = True
+    DAG_GATE.virtual_rng = random.Random(f"dag:{scenario}:{seed}")
     try:
         return _run_scenario_impl(scenario, nodes, seed, steps, cached,
                                   clock)
     finally:
+        DAG_GATE.enabled, DAG_GATE.virtual_rng = prev_dag, prev_rng
         TRACER.reset(clock=prev_clock, enabled=prev_enabled)
 
 
@@ -471,7 +484,8 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     state = {"marker": None, "rollout": False, "chips": {}, "drift": False}
     resync = Request(name=POLICY)
     checker = InvariantChecker(fake, NAMESPACE,
-                               cache=client if cached else None)
+                               cache=client if cached else None,
+                               journal=prec.state_manager.journal)
 
     def tick() -> None:
         # the resync add is the informer-resync analog: the liveness

@@ -67,6 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "create/update/status writes; also "
                         "OPERATOR_SPEC_HASH=0 (debugging escape hatch "
                         "when a suspected skip masks operand drift)")
+    from ..state.scheduler import env_dag_enabled
+
+    p.add_argument("--serial-states", action="store_true",
+                   default=not env_dag_enabled(),
+                   help="disable the DAG operand scheduler: states sync "
+                        "one at a time in declaration order, as before; "
+                        "also OPERATOR_DAG=0 (debugging escape hatch "
+                        "when a suspected ordering race needs ruling "
+                        "out)")
     p.add_argument("--kubeconfig", default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
@@ -128,6 +137,10 @@ def main(argv=None) -> int:
     from ..runtime.client import SPEC_HASH_GATE
 
     SPEC_HASH_GATE.enabled = not args.no_spec_hash
+
+    from ..state.scheduler import DAG_GATE
+
+    DAG_GATE.enabled = not args.serial_states
 
     from ..runtime.tracing import TRACER, TracingClient
 

@@ -9,6 +9,7 @@ plus the Helm-verb slot of deployments/gpu-operator/templates/*).
     tpuop-cfg uninstall [--purge-crds]
     tpuop-cfg trace [--url http://mgr:8080 | -f traces.json]
                     [--controller C] [--min-ms N] [--outcome error]
+    tpuop-cfg dag [-o json]
 
 ``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
 schema conformance against the generated CRD (unknown fields, wrong
@@ -392,6 +393,41 @@ def _trace(args) -> int:
     return 0
 
 
+def _dag(args) -> int:
+    """Render the operand dependency DAG the scheduler compiles at
+    startup: every state with its requires(), the parallel sync waves
+    (level order = execution order), and the critical path that bounds
+    install-to-ready. Entirely offline — the plan is a pure function of
+    the state declarations, so what this prints IS what the operator
+    runs."""
+    from ..state.operands import build_states
+    from ..state.scheduler import DagPlan, DependencyCycleError
+
+    try:
+        plan = DagPlan.build(build_states())
+    except (DependencyCycleError, ValueError) as e:
+        print(f"INVALID operand DAG: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps({
+            "states": {name: list(reqs)
+                       for name, reqs in sorted(plan.requires.items())},
+            "levels": [list(level) for level in plan.levels],
+            "critical_path": list(plan.critical_path),
+        }, indent=2, sort_keys=True))
+        return 0
+    print(f"{len(plan.order)} states, {len(plan.levels)} waves, "
+          f"critical path {len(plan.critical_path)} deep")
+    for i, level in enumerate(plan.levels):
+        print(f"wave {i}:")
+        for name in level:
+            reqs = plan.requires[name]
+            print(f"  {name}"
+                  + (f"  <- {', '.join(reqs)}" if reqs else ""))
+    print("critical path: " + " -> ".join(plan.critical_path))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     from .. import __version__
@@ -498,6 +534,14 @@ def main(argv=None) -> int:
                    help="render only the trace with this id")
     t.add_argument("--timeout", type=float, default=10.0)
 
+    dg = sub.add_parser(
+        "dag", help="show the operand state dependency DAG the scheduler "
+                    "compiles at startup: sync waves, per-state "
+                    "requires(), and the critical path that bounds "
+                    "install-to-ready")
+    dg.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+
     args = p.parse_args(argv)
 
     if args.cmd in ("install", "upgrade", "uninstall"):
@@ -506,6 +550,8 @@ def main(argv=None) -> int:
         return _status(args)
     if args.cmd == "trace":
         return _trace(args)
+    if args.cmd == "dag":
+        return _dag(args)
 
     if args.cmd == "diff":
         docs = _generate_docs(args)

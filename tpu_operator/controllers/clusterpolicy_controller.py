@@ -88,6 +88,19 @@ class ClusterPolicyReconciler(Reconciler):
         # owned DaemonSets feed readiness back into the loop
         controller.watch("apps/v1", "DaemonSet",
                          mapper=enqueue_owner(V1, KIND_CLUSTER_POLICY))
+        # operand watch fan-out: every extra (apiVersion, kind) the
+        # states declare (State.watch_sources) edge-triggers a re-sync —
+        # a validator pod flipping Ready re-enqueues the policy NOW
+        # instead of after the 5 s not-ready requeue. Event storms
+        # collapse in the workqueue's pending-key coalescing.
+        watched = {(V1, KIND_CLUSTER_POLICY), ("v1", "Node"),
+                   ("apps/v1", "DaemonSet")}
+        for api_version, kind in self.state_manager.watch_sources():
+            if (api_version, kind) in watched:
+                continue
+            watched.add((api_version, kind))
+            controller.watch(api_version, kind,
+                             mapper=self._enqueue_all_policies)
 
     def _enqueue_all_policies(self, event: WatchEvent) -> Iterable[Request]:
         # runs on every matching node event; with the informer-backed

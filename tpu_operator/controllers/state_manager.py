@@ -22,6 +22,7 @@ from ..api.clusterpolicy import TPUClusterPolicySpec
 from ..runtime.client import Client
 from ..runtime.objects import get_nested, label_delta, labels_of, name_of
 from ..state.operands import build_states
+from ..state.scheduler import DAG_GATE, DagPlan, SyncJournal, run_plan
 from ..state.state import State, SyncContext, SyncResult, SyncStatus
 from .clusterinfo import ClusterInfo
 
@@ -115,6 +116,29 @@ class StateManager:
     # clusterinfo facts captured by the last sync() pass; the controller
     # publishes them on the CR's status.clusterInfo
     last_cluster_facts: Dict = field(default_factory=dict)
+    # start/done interleaving evidence for the chaos plane's dag-order
+    # invariant (state/scheduler.py SyncJournal)
+    journal: SyncJournal = field(default_factory=SyncJournal)
+
+    def __post_init__(self) -> None:
+        # compile the DAG here so a cyclic or dangling requires() graph
+        # fails operator startup with a named cycle, not the Nth
+        # reconcile with a wedged queue
+        self.plan = DagPlan.build(self.states)
+        self._pass_id = 0
+
+    def watch_sources(self) -> List[tuple]:
+        """Distinct (api_version, kind) pairs the states declare as
+        re-sync triggers, declaration order preserved — the controller
+        fans these out into real watches so operand-object events
+        edge-trigger targeted reconciles instead of waiting out the
+        requeue interval."""
+        out: List[tuple] = []
+        for state in self.states:
+            for src in state.watch_sources():
+                if src not in out:
+                    out.append(src)
+        return out
 
     def label_tpu_nodes(self, default_config: str = "container",
                         sandbox_enabled: bool = True,
@@ -188,7 +212,15 @@ class StateManager:
     def sync(self, policy: dict, spec: TPUClusterPolicySpec,
              extra: Optional[dict] = None) -> Dict[str, SyncResult]:
         """Drive every state once; returns per-state results (step() loop
-        analog, clusterpolicy_controller.go:155-179)."""
+        analog, clusterpolicy_controller.go:155-179).
+
+        With the DAG gate on (default) the states run wave-by-wave per
+        the compiled plan — concurrently in production, sequentially in
+        seeded order under the chaos runner's virtual mode. With
+        OPERATOR_DAG=0 / --serial-states the original serial walk runs
+        verbatim. Every path returns the results keyed in declaration
+        order, so condition messages joined over the dict are identical
+        whatever order the waves completed in."""
         # one facts() pass covers runtime detection too; the dict rides
         # the context (states may template on it) and is kept for the
         # controller's status.clusterInfo write
@@ -199,6 +231,15 @@ class StateManager:
                           cluster={"runtime": facts["containerRuntime"],
                                    **facts},
                           extra=extra or {})
+        if DAG_GATE.enabled:
+            results = self._sync_dag(ctx)
+        else:
+            results = self._sync_serial(ctx)
+        return {state.name: results[state.name] for state in self.states}
+
+    def _sync_serial(self, ctx: SyncContext) -> Dict[str, SyncResult]:
+        """The pre-DAG walk, kept exactly: one state at a time in
+        declaration order (the kill switch's contract)."""
         from ..runtime.tracing import TRACER
 
         results: Dict[str, SyncResult] = {}
@@ -219,4 +260,40 @@ class StateManager:
                 finally:
                     OPERATOR_METRICS.operand_sync_duration.labels(
                         state=state.name).set(time.perf_counter() - start)
+        return results
+
+    def _sync_dag(self, ctx: SyncContext) -> Dict[str, SyncResult]:
+        """Wave-parallel walk of the compiled plan. Per-state behavior
+        (swallowing try, span tagging, duration gauge) matches the
+        serial loop; only the execution order differs. Results land in a
+        plain dict — every worker writes a distinct key, and the waves
+        join before anyone reads."""
+        from ..runtime.tracing import TRACER
+
+        by_name = {state.name: state for state in self.states}
+        self._pass_id += 1
+        results: Dict[str, SyncResult] = {}
+        # the dispatching thread's innermost span: worker threads hang
+        # their state spans under it (their own stacks are empty)
+        handle = TRACER.current()
+
+        def run_one(name: str) -> None:
+            state = by_name[name]
+            start = time.perf_counter()
+            with TRACER.span_under(handle, "state:" + state.name) as sp:
+                try:
+                    results[state.name] = state.sync(ctx)
+                    if sp is not None:
+                        sp.tags["status"] = results[state.name].status.value
+                except Exception as e:  # a broken state must not wedge the rest
+                    log.exception("state %s sync failed", state.name)
+                    results[state.name] = SyncResult(SyncStatus.ERROR, str(e))
+                    if sp is not None:
+                        sp.error = f"{type(e).__name__}: {e}"
+                finally:
+                    OPERATOR_METRICS.operand_sync_duration.labels(
+                        state=state.name).set(time.perf_counter() - start)
+
+        run_plan(self.plan, run_one, journal=self.journal,
+                 pass_id=self._pass_id, rng=DAG_GATE.virtual_rng)
         return results

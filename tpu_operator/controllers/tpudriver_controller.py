@@ -67,6 +67,15 @@ class TPUDriverReconciler(Reconciler):
                          predicate=generation_changed)
         controller.watch("apps/v1", "DaemonSet",
                          mapper=enqueue_owner(V1ALPHA1, KIND_TPU_DRIVER))
+        # driver-pod phase flips decide per-pool readiness; edge-trigger
+        # them instead of waiting for the 5s not-ready requeue
+        controller.watch("v1", "Pod", mapper=self._enqueue_all_drivers)
+
+    def _enqueue_all_drivers(self, event):
+        # the informer-backed cache serves this LIST in-process, so a
+        # pod churn storm costs no apiserver traffic
+        for cr in self.client.list(V1ALPHA1, KIND_TPU_DRIVER):
+            yield Request(name=name_of(cr))
 
     def _state_label(self, cr_name: str) -> str:
         return f"tpu-driver-{cr_name}"

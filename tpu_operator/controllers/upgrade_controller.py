@@ -143,9 +143,21 @@ class UpgradeReconciler(Reconciler):
                                                   namespace=namespace)
 
     def setup_controller(self, controller: Controller, manager: Manager):
+        from ..runtime import label_changed
+
         controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed,
                          mapper=self._enqueue_policy)
         controller.watch("apps/v1", "DaemonSet", predicate=any_event,
+                         mapper=self._enqueue_policy)
+        # edge triggers for the FSM's two wait states: a driver/validator
+        # pod landing (or turning Ready) unblocks pod-restart-required /
+        # validation-required immediately, and an upgrade-state label
+        # flip on any node lets the budget admit the next unit in the
+        # same tick — instead of burning a REQUEUE_ACTIVE_S poll per hop
+        controller.watch("v1", "Pod", predicate=any_event,
+                         mapper=self._enqueue_policy)
+        controller.watch("v1", "Node",
+                         predicate=label_changed(L.UPGRADE_STATE),
                          mapper=self._enqueue_policy)
 
     def _enqueue_policy(self, event: WatchEvent):

@@ -154,6 +154,20 @@ class OperatorMetrics:
         self.render_cache_misses = c(
             "tpu_operator_render_cache_misses_total",
             "Operand renders that had to run the template engine")
+        # edge-triggered convergence (state DAG + operand watch fan-out):
+        # a watch-event storm on one key collapses to one queued item,
+        # and informer relists (the 410-Gone heal + resync) are counted
+        # per kind so a relist loop is visible on /metrics
+        self.workqueue_coalesced = c(
+            "tpu_operator_workqueue_coalesced_total",
+            "Redundant enqueues absorbed while the key was already "
+            "queued or already marked for re-run",
+            labelnames=("controller",))
+        self.cache_relists = c(
+            "tpu_operator_cache_relists_total",
+            "Informer cache relists (watch-gap heals and forced "
+            "resyncs), per cached kind",
+            labelnames=("kind",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -348,6 +348,9 @@ class CachedClient(Client):
             store.needs_relist = False
             store.relist_total += 1
         self.relists += 1
+        from ..metrics.operator_metrics import OPERATOR_METRICS
+
+        OPERATOR_METRICS.cache_relists.labels(kind=store.kind).inc()
 
     def resync(self) -> None:
         """Force a relist of every cached kind (client-go resync analog)."""

@@ -151,7 +151,10 @@ class Controller:
         self.reconciler = reconciler
         self.client = client
         self.workers = workers
-        self.queue = WorkQueue(rate_limiter or RateLimiter(0.1, 3.0))
+        self.queue = WorkQueue(
+            rate_limiter or RateLimiter(0.1, 3.0),
+            on_coalesced=OPERATOR_METRICS.workqueue_coalesced.labels(
+                controller=name).inc)
         self._watch_cancels: list[Callable[[], None]] = []
         # _last_seen feeds predicates their "old" object; watch events can
         # arrive from any publishing thread, so all access is under a lock

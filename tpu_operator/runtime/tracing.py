@@ -236,6 +236,41 @@ class Tracer:
             sp.end = self.clock()
             stack.pop()
 
+    def current(self):
+        """Opaque handle to this thread's innermost active (trace, span),
+        or None. A dispatcher captures it before fanning work out to
+        other threads and passes it to :meth:`span_under` — the span
+        stack is thread-local, so a worker thread cannot see the
+        dispatcher's open trace on its own."""
+        stack = self._stack()
+        return stack[-1] if self.enabled and stack else None
+
+    @contextmanager
+    def span_under(self, handle, name: str, **tags):
+        """Open a child span under a handle captured by :meth:`current`
+        on another thread. The new span is appended to the handle's span
+        (list.append is atomic; the dispatcher only reads children after
+        joining its workers) and pushed on the *calling* thread's stack,
+        so nested spans — TracingClient verbs inside a DAG state sync —
+        attach under it. No-op (yields None) for a None handle."""
+        if handle is None or not self.enabled:
+            yield None
+            return
+        tr, parent = handle
+        sp = Span(name, self.clock(), tags=dict(tags) if tags else None)
+        parent.children.append(sp)
+        stack = self._stack()
+        stack.append((tr, sp))
+        try:
+            yield sp
+        except BaseException as e:
+            if sp.error is None:
+                sp.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            sp.end = self.clock()
+            stack.pop()
+
     def tag(self, key: str, value) -> None:
         """Tag the innermost active span, if any (safe to call always)."""
         stack = self._stack()

@@ -13,7 +13,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 
 class RateLimiter:
@@ -73,7 +73,8 @@ class WorkQueue:
     workers drain the queue.
     """
 
-    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None,
+                 on_coalesced: Optional[Callable[[], None]] = None):
         self.rate_limiter = rate_limiter or RateLimiter()
         self._cond = threading.Condition()
         self._queue: deque[Any] = deque()
@@ -87,15 +88,35 @@ class WorkQueue:
         # queue latency of the most recently dequeued item (seconds spent
         # between add and get) — the workqueue_queue_duration observable
         self.last_wait = 0.0
+        # enqueues absorbed by dedup: the item was already queued, or
+        # already marked dirty behind an in-flight processing slot. The
+        # callback (Controller wires the per-controller Prometheus
+        # counter) runs under the queue lock — it must stay cheap.
+        self.coalesced_total = 0
+        self.on_coalesced = on_coalesced
+
+    def _coalesced_locked(self) -> None:
+        self.coalesced_total += 1
+        if self.on_coalesced is not None:
+            try:
+                self.on_coalesced()
+            except Exception:
+                pass  # an observer must never poison the queue lock
 
     def add(self, item: Any) -> None:
         with self._cond:
             if self._shutdown:
                 return
             if item in self._processing:
-                self._dirty.add(item)
+                # first re-add of an in-flight key buys exactly one
+                # re-run (the dirty mark); further adds are coalesced
+                if item in self._dirty:
+                    self._coalesced_locked()
+                else:
+                    self._dirty.add(item)
                 return
             if item in self._pending:
+                self._coalesced_locked()
                 return
             self._pending.add(item)
             self._enqueued_at.setdefault(item, time.monotonic())
@@ -132,7 +153,12 @@ class WorkQueue:
                     self._enqueued_at.setdefault(item, now)
                     self._queue.append(item)
                 elif item in self._processing:
-                    self._dirty.add(item)
+                    if item in self._dirty:
+                        self._coalesced_locked()
+                    else:
+                        self._dirty.add(item)
+                else:  # already pending: the promotion collapsed into it
+                    self._coalesced_locked()
             else:
                 wait = due - now
                 break

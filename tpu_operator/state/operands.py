@@ -353,15 +353,31 @@ class OperandState(State):
     def __init__(self, name: str, description: str,
                  data_fn: Callable[[SyncContext], dict],
                  enabled_fn: Optional[Callable[[SyncContext], bool]] = None,
-                 manifests_root: Optional[pathlib.Path] = None):
+                 manifests_root: Optional[pathlib.Path] = None,
+                 requires: Optional[List[str]] = None,
+                 watches: Optional[List[tuple]] = None):
         self.name = name
         self.description = description
         self._data_fn = data_fn
         self._enabled_fn = enabled_fn
         self._root = manifests_root or MANIFESTS_ROOT
+        # DAG edges (None = chain to list-order predecessor) and extra
+        # watch sources beyond the DaemonSet default
+        self._requires = requires
+        self._watches = watches
 
     def enabled(self, ctx: SyncContext) -> bool:
         return self._enabled_fn(ctx) if self._enabled_fn else True
+
+    def requires(self) -> Optional[List[str]]:
+        return None if self._requires is None else list(self._requires)
+
+    def watch_sources(self) -> List[tuple]:
+        out = super().watch_sources()
+        for src in self._watches or ():
+            if src not in out:
+                out.append(src)
+        return out
 
     def renderer(self) -> Renderer:
         return Renderer(self._root / f"state-{self.name}")
@@ -595,58 +611,81 @@ def _isolated_device_plugin_data(ctx: SyncContext) -> dict:
 
 def build_states(manifests_root: Optional[pathlib.Path] = None) -> List[State]:
     """Ordered state list (addState registrations,
-    state_manager.go:791-810 analog)."""
+    state_manager.go:791-810 analog).
+
+    ``requires`` declares the real dependency edges the serial order was
+    a linearization of: only chains the validation barrier actually
+    enforces on-node (driver before validation before plugin, fencing
+    before vTPU carving) are edges; everything else may sync in the same
+    wave. The declaration ORDER is still the canonical serial sequence —
+    the OPERATOR_DAG=0 kill switch walks it verbatim."""
     mk = lambda *a, **kw: OperandState(*a, manifests_root=manifests_root, **kw)
     return [
         mk("pre-requisites", "RuntimeClass registration",
-           _prerequisites_data),
+           _prerequisites_data, requires=[]),
         mk("operator-metrics", "operator metrics Service",
-           _operator_metrics_data),
+           _operator_metrics_data, requires=[],
+           watches=[("v1", "Service")]),
         mk("libtpu-driver", "libtpu install on TPU nodes",
            _libtpu_driver_data,
            enabled_fn=lambda ctx: ctx.spec.libtpu.is_enabled()
-           and not ctx.extra.get("tpudriver_crd_mode", False)),
+           and not ctx.extra.get("tpudriver_crd_mode", False),
+           requires=["pre-requisites"]),
         mk("tpu-runtime", "TPU device/runtime hookup",
            _tpu_runtime_data,
-           enabled_fn=lambda ctx: ctx.spec.tpu_runtime.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.tpu_runtime.is_enabled(),
+           requires=["pre-requisites"]),
         mk("operator-validation", "per-node validation gate",
            _validation_data,
-           enabled_fn=lambda ctx: ctx.spec.validator.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.validator.is_enabled(),
+           requires=["libtpu-driver", "tpu-runtime"],
+           watches=[("v1", "Pod")]),
         mk("tpu-device-plugin", "google.com/tpu device plugin",
            _device_plugin_data,
-           enabled_fn=lambda ctx: ctx.spec.device_plugin.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.device_plugin.is_enabled(),
+           requires=["operator-validation"]),
         mk("tpu-health", "standalone telemetry/health engine",
            _tpu_health_data,
-           enabled_fn=lambda ctx: ctx.spec.tpu_health.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.tpu_health.is_enabled(),
+           requires=["libtpu-driver"]),
         mk("metrics-exporter", "libtpu metrics exporter",
            _metrics_exporter_data,
-           enabled_fn=lambda ctx: ctx.spec.metrics_exporter.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.metrics_exporter.is_enabled(),
+           requires=["libtpu-driver"]),
         mk("feature-discovery", "TPU property labels",
            _feature_discovery_data,
-           enabled_fn=lambda ctx: ctx.spec.feature_discovery.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.feature_discovery.is_enabled(),
+           requires=[]),
         mk("node-status-exporter", "validation status metrics",
            _node_status_exporter_data,
-           enabled_fn=lambda ctx: ctx.spec.node_status_exporter.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.node_status_exporter.is_enabled(),
+           requires=["operator-validation"]),
         mk("topology-manager", "TPU slice shaping",
            _topology_manager_data,
-           enabled_fn=lambda ctx: ctx.spec.topology_manager.is_enabled()),
+           enabled_fn=lambda ctx: ctx.spec.topology_manager.is_enabled(),
+           requires=["pre-requisites"]),
         # --- isolated-workload plane (sandbox stack analog): deployed only
         # when sandboxWorkloads.enabled, routed to isolated/virtual nodes
         # by the workload-config deploy labels -------------------------------
         mk("chip-fencing", "fence chips out of the shared pool",
            _chip_fencing_data,
            enabled_fn=lambda ctx: _sandbox_enabled(ctx)
-           and ctx.spec.chip_fencing.is_enabled()),
+           and ctx.spec.chip_fencing.is_enabled(),
+           requires=["pre-requisites"]),
         mk("vtpu-device-manager", "fractional vTPU device inventory",
            _vtpu_device_manager_data,
            enabled_fn=lambda ctx: _sandbox_enabled(ctx)
-           and ctx.spec.vtpu_device_manager.is_enabled()),
+           and ctx.spec.vtpu_device_manager.is_enabled(),
+           requires=["chip-fencing"]),
         mk("isolated-validation", "fencing/vTPU validation gate",
            _isolated_validation_data,
            enabled_fn=lambda ctx: _sandbox_enabled(ctx)
-           and ctx.spec.validator.is_enabled()),
+           and ctx.spec.validator.is_enabled(),
+           requires=["libtpu-driver", "chip-fencing", "vtpu-device-manager"],
+           watches=[("v1", "Pod")]),
         mk("isolated-device-plugin", "fenced/vTPU pool device plugin",
            _isolated_device_plugin_data,
            enabled_fn=lambda ctx: _sandbox_enabled(ctx)
-           and ctx.spec.isolated_device_plugin.is_enabled()),
+           and ctx.spec.isolated_device_plugin.is_enabled(),
+           requires=["isolated-validation"]),
     ]

@@ -59,6 +59,13 @@ class State:
     def sync(self, ctx: SyncContext) -> SyncResult:  # pragma: no cover
         raise NotImplementedError
 
+    # names of states whose sync must complete earlier in the same pass
+    # (the DAG scheduler's edges). None = unspecified: the scheduler
+    # chains this state to its list-order predecessor, so an undeclared
+    # graph reproduces the serial walk exactly. [] = no dependencies.
+    def requires(self) -> Optional[List[str]]:
+        return None
+
     # (api_version, kind) pairs whose events should retrigger reconcile
     def watch_sources(self) -> List[tuple]:
         return [("apps/v1", "DaemonSet")]
