@@ -477,6 +477,32 @@ def _controlplane_doc() -> dict | None:
             doc["workers"]["n_tpu_nodes"] = cc_n
         except Exception as e:
             doc["workers"] = {"error": f"{type(e).__name__}: {e}"}
+        # slice-placement engine: per-decision latency and the scored-vs
+        # -first-fit steady-state utilization gap on a churning request
+        # stream (its own try for the same reason as rollout's).
+        # placement_p99_ms / fleet_utilization at top level are the
+        # headline figures tests/test_bench_guard.py tracks
+        try:
+            from tpu_operator.benchmarks.controlplane import (
+                run_placement_bench,
+            )
+
+            pl = run_placement_bench(n)
+            doc["placement"] = {
+                "n_tpu_nodes": pl["n_tpu_nodes"],
+                "n_requests": pl["n_requests"],
+                "placed": pl["placed"],
+                "unschedulable": pl["unschedulable"],
+                "p50_ms": round(pl["placement_p50_ms"], 3),
+                "p95_ms": round(pl["placement_p95_ms"], 3),
+                "first_fit_placed": pl["first_fit_placed"],
+            }
+            doc["placement_p99_ms"] = round(pl["placement_p99_ms"], 3)
+            doc["fleet_utilization"] = round(pl["fleet_utilization"], 4)
+            doc["fleet_utilization_first_fit"] = round(
+                pl["fleet_utilization_first_fit"], 4)
+        except Exception as e:
+            doc["placement"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

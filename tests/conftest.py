@@ -62,6 +62,8 @@ TIERS = ("unit", "e2e", "jax", "soak", "shell", "bench")
 def pytest_configure(config):
     for tier in TIERS:
         config.addinivalue_line("markers", f"{tier}: {tier} test tier")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -106,6 +106,27 @@ def test_install_to_ready_not_regressed():
         f"regressed >25% vs best on record ({best:.4f}s)")
 
 
+def test_placement_p99_not_regressed():
+    """Same contract again, for the slice-placement engine's per-decision
+    p99 (benchmarks.controlplane.run_placement_bench): the latest round's
+    placement_p99_ms may be at most 25% above the best on record. Skips
+    until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "placement_p99_ms")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records placement_p99_ms yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} placement_p99_ms={latest:.3f}ms "
+        f"regressed >25% vs best on record ({best:.3f}ms)")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

@@ -116,6 +116,7 @@ class TestValues:
 
         kinds = [d["kind"] for d in render_bundle(load_values())]
         assert kinds == ["CustomResourceDefinition",
+                         "CustomResourceDefinition",
                          "CustomResourceDefinition", "Namespace",
                          "ServiceAccount", "ClusterRole",
                          "ClusterRoleBinding", "Role", "RoleBinding",
@@ -232,6 +233,7 @@ class TestValues:
             "manifests/tpu-operator.clusterserviceversion.yaml",
             "manifests/tpu.graft.dev_tpuclusterpolicies.yaml",
             "manifests/tpu.graft.dev_tpudrivers.yaml",
+            "manifests/tpu.graft.dev_slicerequests.yaml",
             "metadata/annotations.yaml",
             "tests/scorecard/config.yaml",
         }
@@ -285,15 +287,16 @@ class TestValues:
         # alm-examples must be valid JSON holding sample CRs of both kinds
         examples = json.loads(csv["metadata"]["annotations"]["alm-examples"])
         assert {e["kind"] for e in examples} == \
-            {"TPUClusterPolicy", "TPUDriver"}
+            {"TPUClusterPolicy", "TPUDriver", "SliceRequest"}
 
         owned = csv["spec"]["customresourcedefinitions"]["owned"]
-        assert {c["kind"] for c in owned} == {"TPUClusterPolicy", "TPUDriver"}
+        assert {c["kind"] for c in owned} == \
+            {"TPUClusterPolicy", "TPUDriver", "SliceRequest"}
         # owned CRD names/versions must match the CRDs shipped in the
         # same bundle (the validate-csv drift gate, Makefile:233-236)
         crds = [d for d in docs
                 if d.get("kind") == "CustomResourceDefinition"]
-        assert len(crds) == 2
+        assert len(crds) == 3
         crd_names = {c["metadata"]["name"] for c in crds}
         assert {c["name"] for c in owned} == crd_names
         for o in owned:
@@ -439,7 +442,7 @@ class TestValues:
 class TestGenerate:
     def test_crds(self):
         docs = generate("crds")
-        assert [d["kind"] for d in docs] == ["CustomResourceDefinition"] * 2
+        assert [d["kind"] for d in docs] == ["CustomResourceDefinition"] * 3
 
     def test_operator_bundle_complete(self):
         docs = generate("operator")
@@ -451,7 +454,7 @@ class TestGenerate:
     def test_cli_emits_parseable_yaml(self, capsys):
         assert main(["generate", "all", "-n", "custom-ns"]) == 0
         docs = list(yaml.safe_load_all(capsys.readouterr().out))
-        assert len(docs) == 10
+        assert len(docs) == 11
         ns = [d for d in docs if d["kind"] == "Namespace"][0]
         assert ns["metadata"]["name"] == "custom-ns"
 

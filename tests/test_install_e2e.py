@@ -76,7 +76,8 @@ def test_install_to_all_ready_then_uninstall(cluster, capsys):
     # namespace, RBAC, operator Deployment, and the CR itself
     crds = ops.list("apiextensions.k8s.io/v1", "CustomResourceDefinition")
     assert {c["metadata"]["name"] for c in crds} == {
-        "tpuclusterpolicies.tpu.graft.dev", "tpudrivers.tpu.graft.dev"}
+        "tpuclusterpolicies.tpu.graft.dev", "tpudrivers.tpu.graft.dev",
+        "slicerequests.tpu.graft.dev"}
     assert srv.schema_for_collection(
         "/apis/tpu.graft.dev/v1/tpuclusterpolicies") is not None
     assert ops.get_or_none("apps/v1", "Deployment", "tpu-operator",
@@ -119,7 +120,7 @@ def test_install_to_all_ready_then_uninstall(cluster, capsys):
         assert ops.get_or_none("apps/v1", "Deployment", "tpu-operator",
                                NS) is None
         assert len(ops.list("apiextensions.k8s.io/v1",
-                            "CustomResourceDefinition")) == 2
+                            "CustomResourceDefinition")) == 3
     finally:
         mgr.stop()
         mgr_client._stop.set()

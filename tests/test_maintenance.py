@@ -17,13 +17,14 @@ from tpu_operator.runtime.objects import thaw_obj
 
 
 class TestApplyCRDs:
-    def test_creates_both_crds_fresh(self):
+    def test_creates_all_crds_fresh(self):
         c = FakeClient()
-        assert apply_crds(c) == 2
+        assert apply_crds(c) == 3
         names = {o["metadata"]["name"]
                  for o in c.list(CRD_API, "CustomResourceDefinition")}
         assert names == {"tpuclusterpolicies.tpu.graft.dev",
-                         "tpudrivers.tpu.graft.dev"}
+                         "tpudrivers.tpu.graft.dev",
+                         "slicerequests.tpu.graft.dev"}
 
     def test_updates_existing_schema_in_place(self):
         """The pre-upgrade scenario: an older CRD revision is live; the
@@ -36,7 +37,7 @@ class TestApplyCRDs:
         crd["spec"]["versions"][0]["schema"] = {
             "openAPIV3Schema": {"type": "object"}}
         c.update(crd)
-        assert apply_crds(c) == 2
+        assert apply_crds(c) == 3
         crd = c.get(CRD_API, "CustomResourceDefinition",
                     "tpuclusterpolicies.tpu.graft.dev")
         schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
@@ -46,7 +47,7 @@ class TestApplyCRDs:
     def test_idempotent(self):
         c = FakeClient()
         apply_crds(c)
-        assert apply_crds(c) == 2  # re-run on hook retry: no error
+        assert apply_crds(c) == 3  # re-run on hook retry: no error
 
 
 class TestCleanup:
@@ -84,7 +85,7 @@ class TestCleanup:
 
         c.create(new_tpu_driver("pool-a"))
         assert cleanup(c, timeout_s=0.1, poll_s=0.02) is False
-        assert len(c.list(CRD_API, "CustomResourceDefinition")) == 2
+        assert len(c.list(CRD_API, "CustomResourceDefinition")) == 3
         assert len(c.list(V1ALPHA1, KIND_TPU_DRIVER)) == 1
 
     def test_cleanup_idempotent_on_empty_cluster(self):

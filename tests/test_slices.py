@@ -8,7 +8,7 @@ from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
 )
 from tpu_operator.runtime import FakeClient, ListOptions, Request
-from tpu_operator.runtime.objects import thaw_obj
+from tpu_operator.runtime.objects import get_nested, thaw_obj
 
 # 2x2x2 = 8 chips at 4 chips/host = a 2-host v5p slice
 SLICE_LABELS = {
@@ -249,6 +249,29 @@ def test_status_cap_does_not_blind_the_gauges(monkeypatch):
     assert len(rows) == 1  # CR copy capped
     assert OPERATOR_METRICS.slices_total._value.get() == 2
     assert OPERATOR_METRICS.slices_validated._value.get() == 0
+
+
+def test_status_cap_sets_truncated_flag(monkeypatch):
+    """A fleet whose slice list outgrows MAX_ROWS gets
+    status.slicesTruncated: true so consumers of the capped list can
+    tell it was cut; an uncapped fleet reports false."""
+    from tpu_operator.controllers import slices as slices_mod
+
+    c, rec = make_sliced_cluster()
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    assert get_nested(cr, "status", "slicesTruncated") is False
+
+    monkeypatch.setattr(slices_mod, "MAX_ROWS", 1)
+    for i in range(2):
+        c.add_node(f"slice-z-{i}",
+                   labels=dict(SLICE_LABELS, **{L.GKE_NODEPOOL: "pool-z"}),
+                   allocatable={"google.com/tpu": "4"})
+    rec.reconcile(req)
+    cr = c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy")
+    assert get_nested(cr, "status", "slicesTruncated") is True
+    assert len(get_nested(cr, "status", "slices")) == 1
 
 
 def test_slice_validation_transitions_emit_events():

@@ -60,6 +60,26 @@ class TestProfiles:
         with pytest.raises(ValueError):
             chip_groups(["a", "b", "c"], 2)
 
+    def test_non_integer_subslices_names_the_profile(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text("profiles:\n  good:\n    subslices: 2\n"
+                     "  broken:\n    subslices: two\n")
+        with pytest.raises(ValueError, match="profile 'broken'"):
+            load_profiles(str(p))
+
+    def test_non_mapping_body_names_the_profile(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text("profiles:\n  good:\n    subslices: 1\n"
+                     "  scalar: 3\n")
+        with pytest.raises(ValueError, match="profile 'scalar'"):
+            load_profiles(str(p))
+
+    def test_zero_subslices_names_the_profile(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text("profiles:\n  empty:\n    subslices: 0\n")
+        with pytest.raises(ValueError, match="profile 'empty'.*>= 1"):
+            load_profiles(str(p))
+
 
 class TestTopologyManager:
     def test_apply_profile_writes_file_and_label(self, config_file, tmp_path):

@@ -9,6 +9,14 @@ from .clusterpolicy import (  # noqa: F401
     TPUClusterPolicySpec,
     new_cluster_policy,
 )
+from .slicerequest import (  # noqa: F401
+    KIND_SLICE_REQUEST,
+    PHASE_PENDING,
+    PHASE_PLACED,
+    PHASE_UNSCHEDULABLE,
+    SliceRequestSpec,
+    new_slice_request,
+)
 from .tpudriver import (  # noqa: F401
     KIND_TPU_DRIVER,
     V1ALPHA1,

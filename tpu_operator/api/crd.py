@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from .clusterpolicy import GROUP, KIND_CLUSTER_POLICY, TPUClusterPolicySpec
 from .convert import schema_of
+from .slicerequest import KIND_SLICE_REQUEST, SliceRequestSpec
 from .tpudriver import KIND_TPU_DRIVER, TPUDriverSpec
 
 
@@ -37,13 +38,38 @@ def _status_schema() -> dict:
                                "validated": {"type": "boolean"},
                                "upgradeState": {"type": "string"},
                            }}},
+            # true when status.slices was capped at MAX_ROWS — large
+            # fleets can tell rows were dropped (the gauges stay full)
+            "slicesTruncated": {"type": "boolean"},
+        },
+    }
+
+
+def _slice_request_status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "phase": {"type": "string",
+                      "enum": ["Pending", "Placed", "Unschedulable"]},
+            "nodes": {"type": "array", "items": {"type": "string"}},
+            "pool": {"type": "string"},
+            "sliceId": {"type": "string"},
+            "reason": {"type": "string"},
+            "score": {"type": "string"},
+            "evictions": {"type": "integer"},
+            "lastEvictionReason": {"type": "string"},
+            "conditions": {"type": "array",
+                           "items": {"type": "object",
+                                     "x-kubernetes-preserve-unknown-fields": True}},
         },
     }
 
 
 def _crd(kind: str, plural: str, singular: str, version: str,
          spec_schema: dict, short_names: list,
-         extra_printer_cols: list | None = None) -> dict:
+         extra_printer_cols: list | None = None,
+         scope: str = "Cluster",
+         status_schema: dict | None = None) -> dict:
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
@@ -52,7 +78,7 @@ def _crd(kind: str, plural: str, singular: str, version: str,
             "group": GROUP,
             "names": {"kind": kind, "plural": plural, "singular": singular,
                       "shortNames": short_names},
-            "scope": "Cluster",
+            "scope": scope,
             "versions": [{
                 "name": version,
                 "served": True,
@@ -68,7 +94,7 @@ def _crd(kind: str, plural: str, singular: str, version: str,
                     "type": "object",
                     "properties": {
                         "spec": spec_schema,
-                        "status": _status_schema(),
+                        "status": status_schema or _status_schema(),
                     },
                 }},
             }],
@@ -141,5 +167,24 @@ def tpu_driver_crd() -> dict:
                   "jsonPath": ".spec.channel"}])
 
 
+def slice_request_crd() -> dict:
+    schema = schema_of(SliceRequestSpec)
+    schema["properties"]["chips"]["minimum"] = 0
+    schema["properties"]["topology"]["pattern"] = r"^\d+(x\d+)*$"
+    # a request must ask for something: chips > 0 or an explicit topology
+    schema["x-kubernetes-validations"] = [
+        {"rule": "(has(self.chips) && self.chips > 0) || "
+                 "(has(self.topology) && self.topology != '')",
+         "message": "request must name chips > 0 or a topology grid"}]
+    return _crd(KIND_SLICE_REQUEST, "slicerequests", "slicerequest",
+                "v1alpha1", schema, ["sreq"],
+                [{"name": "Phase", "type": "string",
+                  "jsonPath": ".status.phase"},
+                 {"name": "Chips", "type": "integer",
+                  "jsonPath": ".spec.chips"}],
+                scope="Namespaced",
+                status_schema=_slice_request_status_schema())
+
+
 def all_crds() -> list:
-    return [cluster_policy_crd(), tpu_driver_crd()]
+    return [cluster_policy_crd(), tpu_driver_crd(), slice_request_crd()]

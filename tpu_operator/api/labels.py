@@ -10,6 +10,7 @@ GKE_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"  # e.g. tpu-v5p-sli
 GKE_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"        # e.g. 2x2x1
 GKE_ACCELERATOR_COUNT = "cloud.google.com/gke-accelerator-count"
 GKE_NODEPOOL = "cloud.google.com/gke-nodepool"                # pool identity
+GKE_TPU_WORKER_ID = "cloud.google.com/gke-tpu-worker-id"      # host index in slice
 
 # --- labels stamped by this operator --------------------------------------
 DOMAIN = "tpu.graft.dev"
@@ -49,6 +50,13 @@ UPGRADE_FAILED_REASON = f"{DOMAIN}/upgrade.failed-reason"
 
 # --- annotations ----------------------------------------------------------
 LAST_APPLIED_HASH = f"{DOMAIN}/last-applied-hash"  # object_controls.go:125 analog
+# placement lease: stamped on every node a SliceRequest is bound to, value
+# "<namespace>/<name>" of the owning request. The placement engine treats
+# it as the source of truth for what is free: a node carrying any
+# placed-by value is never offered to another request (placement-sound
+# invariant), and a Placed request whose lease disappears is re-queued
+# through an explicit drain event (placement-stable invariant).
+PLACED_BY = f"{DOMAIN}/placed-by"
 # stable hash of the rendered desired object (spec-hash write avoidance,
 # state/skel.py): a live object carrying the desired hash AND matching
 # the desired spec is skipped without any apiserver verb, so a converged

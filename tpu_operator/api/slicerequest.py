@@ -1,0 +1,67 @@
+"""SliceRequest: namespaced ask for a contiguous TPU sub-slice.
+
+The placement analog of a PodSpec resource request: a workload asks for
+``chips`` (optionally a ``topology`` like ``4x4`` and an ``accelerator``
+pin), and the placement engine (topology/placement.py) bin-packs it onto
+the mixed v4/v5e/v5p/v6e fleet, reconciling the decision as state:
+``status.phase: Pending|Placed|Unschedulable`` plus a
+``tpu.graft.dev/placed-by`` lease annotation on the chosen nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .clusterpolicy import GROUP
+from .convert import field, from_dict, to_dict
+
+V1ALPHA1 = f"{GROUP}/v1alpha1"
+KIND_SLICE_REQUEST = "SliceRequest"
+
+PHASE_PENDING = "Pending"
+PHASE_PLACED = "Placed"
+PHASE_UNSCHEDULABLE = "Unschedulable"
+
+
+@dataclass
+class SliceRequestSpec:
+    chips: Optional[int] = field(
+        default=0, description="Number of TPU chips requested")
+    topology: Optional[str] = field(
+        description="Requested slice topology, e.g. 4x4 (chips derived "
+                    "from the grid when set)")
+    accelerator: Optional[str] = field(
+        description="Pin to one GKE accelerator label value, "
+                    "e.g. tpu-v5p-slice")
+    priority: Optional[int] = field(
+        default=0, description="Preemption priority; higher wins when "
+                               "preemption is enabled")
+    preferred_generations: Optional[List[str]] = field(
+        description="Ordered generation preferences, e.g. [v5p, v5e]")
+
+    @classmethod
+    def from_obj(cls, cr: dict) -> "SliceRequestSpec":
+        return from_dict(cls, cr.get("spec") or {})
+
+    def to_obj(self) -> dict:
+        return to_dict(self)
+
+    def chips_needed(self) -> int:
+        """Effective chip count: explicit topology grid wins over chips."""
+        if self.topology:
+            n = 1
+            for d in str(self.topology).lower().split("x"):
+                n *= int(d)
+            return n
+        return int(self.chips or 0)
+
+
+def new_slice_request(name: str, spec: Optional[dict] = None,
+                      namespace: str = "default") -> dict:
+    return {
+        "apiVersion": V1ALPHA1,
+        "kind": KIND_SLICE_REQUEST,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec or {},
+    }

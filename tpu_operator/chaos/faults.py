@@ -57,6 +57,7 @@ MUTATE_POLICY = "mutate-policy"    # spec edit the operator must apply
 TRIGGER_ROLLOUT = "trigger-rollout"  # libtpu change -> fleet upgrade FSM
 OPERAND_DRIFT = "operand-drift"    # out-of-band spec edit to a live operand
 ANNOTATION_CLEAR = "annotation-clear"  # strip the spec-hash annotations
+SLICE_REQUEST = "slice-request"    # a SliceRequest lands in the queue
 
 
 @dataclass(frozen=True)
@@ -124,6 +125,7 @@ class FaultPlan:
             "chip-loss": cls._chip_loss,
             "operand-drift": cls._operand_drift,
             "dag-race": cls._dag_race,
+            "placement-contention": cls._placement_contention,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -262,6 +264,48 @@ class FaultPlan:
                 out.append(Fault(step, API_UNAVAILABLE, count=1))
             if step % 4 == 3:
                 out.append(Fault(step, WATCH_DROP))
+        return out
+
+    @classmethod
+    def _placement_contention(cls, rng, nodes, steps) -> List[Fault]:
+        """More demand than chips: waves of SliceRequests (chip count in
+        ``count``, priority in ``seconds``) land against a fleet that
+        flaps NotReady and shrinks mid-bind, with 409 storms hitting the
+        lease/status writes. The placement-sound and placement-stable
+        invariants must hold through every storm, and once faults stop
+        every request must sit in a terminal phase with consistent
+        leases."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8, 8, 16, 32)
+        req = 0
+        for step in range(steps):
+            # a wave of requests every step: demand outruns the fleet
+            # within the first few steps, so the scorer is packing a
+            # contended pool for most of the run
+            for _ in range(rng.randrange(2, 5)):
+                req += 1
+                out.append(Fault(step, SLICE_REQUEST,
+                                 arg=f"sreq-{req:03d}",
+                                 count=rng.choice(sizes),
+                                 seconds=float(rng.randrange(0, 3))))
+            if step % 3 == 1:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 4 == 2 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(step + 2, steps - 1), NODE_HEAL,
+                                 arg=victim))
+            if step % 5 == 3 and len(nodes) > 1:
+                # a bound node vanishing is the explicit drain event the
+                # eviction path exists for; never remove a node scheduled
+                # to heal later
+                flapped = {f.arg for f in out if f.kind == NODE_FLAP}
+                candidates = [n for n in nodes if n not in flapped]
+                if candidates:
+                    victim = rng.choice(candidates)
+                    nodes.remove(victim)
+                    out.append(Fault(step, NODE_REMOVE, arg=victim))
         return out
 
     @classmethod

@@ -39,6 +39,17 @@ point*, not just at convergence:
   numbers rather than trusted. Journal entries accumulate per pass
   across drains, so a pass split over two observation points cannot
   false-positive.
+- ``placement-sound``: no node is ever claimed by two Placed
+  SliceRequests at once, and a bound node never violates the request's
+  accelerator pin. Once settled, every bound node must also exist and
+  carry the matching ``tpu.graft.dev/placed-by`` lease, and no node may
+  carry an orphan lease (mid-storm a NODE_REMOVE legally breaks a
+  binding until the eviction path catches up). Checked in every
+  scenario — a run with no SliceRequests is a clean no-op.
+- ``placement-stable``: a Placed request's node set never changes
+  without ``status.evictions`` incrementing — the controller's promise
+  that placements only move through an explicit drain event, never a
+  silent re-pack.
 - ``convergence``: recorded by the runner when the cluster fails to
   reach all-Ready within the soak budget after faults stop.
 
@@ -89,6 +100,9 @@ class InvariantChecker:
         self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
         # pass_id -> {state: done_seq}, accumulated across journal drains
         self._dag_done: Dict[int, Dict[str, int]] = {}
+        # request key -> (sorted bound-node tuple, evictions) at the last
+        # observation the request was Placed (placement-stable history)
+        self._placements: Dict[str, Tuple[Tuple[str, ...], int]] = {}
 
     def record(self, invariant: str, step: int, detail: str) -> None:
         self.violations.append(Violation(invariant, step, detail))
@@ -107,6 +121,93 @@ class InvariantChecker:
         self._check_budget(step, nodes)
         self._check_cache(step, settled=False)
         self._check_dag(step)
+        self._check_placement(step, nodes, settled=False)
+
+    # -- slice placement ----------------------------------------------------
+
+    def _check_placement(self, step: int, nodes: Dict[str, dict],
+                         settled: bool) -> None:
+        """placement-sound + placement-stable (see module docstring).
+        Listing an unknown kind returns [] on the fake apiserver, so in
+        every scenario that creates no SliceRequests this is a no-op."""
+        from ..api.slicerequest import (
+            KIND_SLICE_REQUEST,
+            PHASE_PLACED,
+            V1ALPHA1,
+            SliceRequestSpec,
+        )
+
+        requests = sorted(self.client.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                          key=lambda r: (namespace_key(r), name_of(r)))
+        if not requests and not self._placements:
+            return
+        owner_by_node: Dict[str, str] = {}
+        live_keys = set()
+        for req in requests:
+            key = f"{namespace_key(req) or 'default'}/{name_of(req)}"
+            live_keys.add(key)
+            if get_nested(req, "status", "phase") != PHASE_PLACED:
+                continue
+            spec = SliceRequestSpec.from_obj(req)
+            bound = tuple(sorted(
+                get_nested(req, "status", "nodes", default=[]) or []))
+            evictions = int(get_nested(req, "status", "evictions",
+                                       default=0) or 0)
+            for node_name in bound:
+                prior = owner_by_node.get(node_name)
+                if prior is not None:
+                    self.record(
+                        "placement-sound", step,
+                        f"node {node_name} double-booked by {prior} "
+                        f"and {key}")
+                owner_by_node[node_name] = key
+                node = nodes.get(node_name)
+                if node is None:
+                    # legal mid-storm (NODE_REMOVE outruns the eviction
+                    # path); a hole after settling is a lost drain
+                    if settled:
+                        self.record(
+                            "placement-sound", step,
+                            f"{key}: bound node {node_name} does not "
+                            f"exist after settling")
+                    continue
+                if spec.accelerator and labels_of(node).get(
+                        L.GKE_TPU_ACCELERATOR) != spec.accelerator:
+                    self.record(
+                        "placement-sound", step,
+                        f"{key}: node {node_name} violates accelerator "
+                        f"pin {spec.accelerator!r}")
+                if settled:
+                    lease = (get_nested(node, "metadata", "annotations",
+                                        default={}) or {}).get(L.PLACED_BY)
+                    if lease != key:
+                        self.record(
+                            "placement-sound", step,
+                            f"{key}: node {node_name} lease is {lease!r} "
+                            f"after settling, want {key!r}")
+            prev = self._placements.get(key)
+            if prev is not None and bound != prev[0] \
+                    and evictions <= prev[1]:
+                self.record(
+                    "placement-stable", step,
+                    f"{key}: bound nodes {list(prev[0])} -> {list(bound)} "
+                    f"without status.evictions incrementing "
+                    f"({prev[1]} -> {evictions})")
+            self._placements[key] = (bound, evictions)
+        if settled:
+            for node_name in sorted(nodes):
+                lease = (get_nested(nodes[node_name], "metadata",
+                                    "annotations", default={})
+                         or {}).get(L.PLACED_BY)
+                if lease and owner_by_node.get(node_name) != lease:
+                    self.record(
+                        "placement-sound", step,
+                        f"node {node_name}: orphan placement lease "
+                        f"{lease!r} after settling")
+        # deleted requests stop being tracked (their leases were audited
+        # above while they lived); a namesake re-create starts fresh
+        for key in [k for k in self._placements if k not in live_keys]:
+            del self._placements[key]
 
     # -- DAG dependency order ----------------------------------------------
 
@@ -325,6 +426,8 @@ class InvariantChecker:
                             f"slice_status ({len(rows)} rows)")
         self._check_cache(step, settled=True)
         self._check_dag(step)
+        nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
+        self._check_placement(step, nodes, settled=True)
 
 
 def namespace_key(obj: dict) -> str:

@@ -26,8 +26,17 @@ from typing import Callable, Dict, Iterable, List, Optional
 
 from ..api import labels as L
 from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, new_cluster_policy
+from ..api.slicerequest import (
+    KIND_SLICE_REQUEST,
+    PHASE_PLACED,
+    PHASE_UNSCHEDULABLE,
+    V1ALPHA1,
+    SliceRequestSpec,
+    new_slice_request,
+)
 from ..benchmarks.controlplane import build_cluster
 from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+from ..controllers.placement_controller import PlacementReconciler
 from ..controllers.upgrade_controller import (
     STATE_DONE,
     UpgradeReconciler,
@@ -43,6 +52,7 @@ from ..runtime.client import (
 from ..runtime.fake import simulate_kubelet
 from ..runtime.manager import any_event, enqueue_object
 from ..runtime.objects import (
+    annotations_of,
     get_nested,
     labels_of,
     name_of,
@@ -65,6 +75,7 @@ from .faults import (
     NODE_REMOVE,
     OPERAND_DRIFT,
     POD_CRASH,
+    SLICE_REQUEST,
     TRIGGER_ROLLOUT,
     WATCH_DROP,
     ChaosClient,
@@ -76,7 +87,7 @@ from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
-             "dag-race")
+             "dag-race", "placement-contention")
 
 NAMESPACE = "tpu-operator"
 POLICY = "tpu-cluster-policy"
@@ -296,6 +307,19 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                     applied = True
                 except ConflictError:
                     pass
+    elif kind == SLICE_REQUEST:
+        # demand arrives: a user submits a SliceRequest. Chip count rides
+        # in ``count`` and priority in ``seconds`` (the plan's only free
+        # numeric slots); the placement controller picks it up from the
+        # ADDED watch event like any other client would.
+        if fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, fault.arg,
+                            NAMESPACE) is None:
+            fake.create(new_slice_request(
+                fault.arg,
+                spec=SliceRequestSpec(chips=fault.count,
+                                      priority=int(fault.seconds)).to_obj(),
+                namespace=NAMESPACE))
+            applied = True
     elif kind == ANNOTATION_CLEAR:
         # strip the hash annotations entirely (a `kubectl annotate ...-`
         # adversary): the skip must fail closed and restore them
@@ -391,9 +415,52 @@ def _converged(fake: FakeClient, state: dict) -> bool:
                                   "containers", default=[]) or []:
                 if str(ctr.get("image", "")).startswith("chaos-drift/"):
                     return False
+    # every SliceRequest must sit in a terminal phase with a consistent
+    # lease trail — a request still Pending (or Placed onto a vanished or
+    # re-leased node) means the placement loop hasn't finished healing
+    for req in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+        phase = get_nested(req, "status", "phase")
+        if phase not in (PHASE_PLACED, PHASE_UNSCHEDULABLE):
+            return False
+        if phase != PHASE_PLACED:
+            continue
+        key = f"{namespace_of(req) or 'default'}/{name_of(req)}"
+        for node_name in get_nested(req, "status", "nodes",
+                                    default=[]) or []:
+            node = fake.get_or_none("v1", "Node", node_name)
+            if node is None or annotations_of(node).get(L.PLACED_BY) != key:
+                return False
     from ..controllers.slices import slice_status
 
     return all(r["validated"] for r in slice_status(fake, NAMESPACE))
+
+
+def _placement_summary(fake: FakeClient) -> dict:
+    """Deterministic placement outcome block for the verdict: phase
+    counts, total evictions survived, and the chip inventory the gauges
+    export — all read from the settled store, no clocks involved."""
+    from ..topology.placement import FleetState
+
+    reqs = fake.list(V1ALPHA1, KIND_SLICE_REQUEST)
+    phases: Dict[str, int] = {}
+    evictions = 0
+    for req in reqs:
+        phase = get_nested(req, "status", "phase") or "Pending"
+        phases[phase] = phases.get(phase, 0) + 1
+        evictions += int(get_nested(req, "status", "evictions",
+                                    default=0) or 0)
+    totals = FleetState(fake.list("v1", "Node")).chip_totals()
+    free = sum(b["free"] for b in totals.values())
+    placed = sum(b["placed"] for b in totals.values())
+    return {
+        "requests": len(reqs),
+        "phases": {k: phases[k] for k in sorted(phases)},
+        "evictions": evictions,
+        "chips_placed": placed,
+        "chips_free": free,
+        "utilization": (round(placed / (placed + free), 4)
+                        if placed + free else 0.0),
+    }
 
 
 # -- scenario driver --------------------------------------------------------
@@ -480,6 +547,18 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
              _SyncController(urec, traced, clock)]
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
+    # the placement controller only joins the scenario built around it:
+    # the other scenarios create no SliceRequests, and keeping their
+    # controller set unchanged keeps their verdicts unchanged. Preemption
+    # is ON here (off by default in production) so the storm also
+    # exercises the priority-eviction path under fire.
+    place_ctrl = None
+    if scenario == "placement-contention":
+        lrec = PlacementReconciler(client=traced, namespace=NAMESPACE,
+                                   preemption=True)
+        place_ctrl = _SyncController(lrec, traced, clock)
+        lrec.setup_controller(place_ctrl, None)
+        ctrls.append(place_ctrl)
 
     state = {"marker": None, "rollout": False, "chips": {}, "drift": False}
     resync = Request(name=POLICY)
@@ -491,9 +570,15 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         # the resync add is the informer-resync analog: the liveness
         # backstop that keeps a scenario about SAFETY invariants — one
         # event lost to an armed fault inside a watch handler must not
-        # deadlock the whole run
+        # deadlock the whole run. The placement controller's resync is
+        # per-request: its primary kind is the SliceRequest, not the CR.
         for c in ctrls:
-            c.add(resync)
+            if c is place_ctrl:
+                for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                    c.add(Request(name=name_of(cr),
+                                  namespace=namespace_of(cr)))
+            else:
+                c.add(resync)
             c.drain()
         simulate_kubelet(fake, ready=True)
         for c in ctrls:
@@ -505,7 +590,7 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     def verdict(plan: FaultPlan, converged: bool, soak: int,
                 conv_s: Optional[float]) -> dict:
         violations = checker.to_list()
-        return {
+        out = {
             "scenario": scenario,
             "seed": seed,
             "nodes": nodes,
@@ -528,6 +613,9 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             },
             "ok": bool(converged and not violations),
         }
+        if place_ctrl is not None:
+            out["placement"] = _placement_summary(fake)
+        return out
 
     # baseline convergence — faults only start from a known-good state,
     # so a later non-convergence indicts the storm, not the install

@@ -91,6 +91,7 @@ def main(argv=None) -> int:
     from ..api import KIND_CLUSTER_POLICY, V1, new_cluster_policy
     from ..api import labels as L
     from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.placement_controller import PlacementReconciler
     from ..controllers.tpudriver_controller import TPUDriverReconciler
     from ..controllers.upgrade_controller import UpgradeReconciler
     from ..runtime import Manager
@@ -163,6 +164,9 @@ def main(argv=None) -> int:
         workers=args.workers)
     mgr.add_reconciler(
         UpgradeReconciler(client=api, namespace=args.namespace),
+        workers=args.workers)
+    mgr.add_reconciler(
+        PlacementReconciler(client=api, namespace=args.namespace),
         workers=args.workers)
     mgr.start()
     log.info("tpu-operator started (namespace=%s, fake=%s, cache=%s, "

@@ -10,6 +10,7 @@ plus the Helm-verb slot of deployments/gpu-operator/templates/*).
     tpuop-cfg trace [--url http://mgr:8080 | -f traces.json]
                     [--controller C] [--min-ms N] [--outcome error]
     tpuop-cfg dag [-o json]
+    tpuop-cfg place --fleet fleet.yaml --chips 8 [--explain] [-o json]
 
 ``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
 schema conformance against the generated CRD (unknown fields, wrong
@@ -428,6 +429,121 @@ def _dag(args) -> int:
     return 0
 
 
+def _fixture_nodes(doc) -> list:
+    """Expand a fleet fixture into Node objects. Two shapes: a YAML list
+    of Node dicts (used verbatim), or the compact ``pools:`` form —
+    ``{pools: [{accelerator, topology, chips, count}]}`` — expanded with
+    the same labels a GKE TPU VM carries (worker-id stamped only on
+    multi-host topologies, as GKE does)."""
+    from ..api import labels as L
+    from ..topology.placement import _grid_dims, _hosts_per_slice
+
+    if isinstance(doc, list):
+        return doc
+    if not isinstance(doc, dict) or not isinstance(doc.get("pools"), list):
+        raise ValueError("fleet fixture must be a node list or {pools: [...]}")
+    nodes = []
+    for pool in doc["pools"]:
+        accel = str(pool.get("accelerator", ""))
+        topo = str(pool.get("topology", ""))
+        chips = int(pool.get("chips", 4))
+        count = int(pool.get("count", 0))
+        hps = _hosts_per_slice(_grid_dims(topo), chips)
+        for i in range(count):
+            labels = {
+                L.GKE_TPU_ACCELERATOR: accel,
+                L.GKE_TPU_TOPOLOGY: topo,
+                L.GKE_ACCELERATOR_COUNT: str(chips),
+            }
+            if hps > 1:
+                labels[L.GKE_TPU_WORKER_ID] = str(i % hps)
+            short = accel.split("-")[1] if "-" in accel else accel
+            nodes.append({
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"{short}-{topo}-{i}",
+                             "labels": labels},
+                "spec": {},
+                "status": {
+                    "allocatable": {L.TPU_RESOURCE: str(chips)},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            })
+    return nodes
+
+
+def _place(args) -> int:
+    """Dry-run the slice placement engine against a fleet fixture: rank
+    every candidate window exactly as the placement controller would and
+    print the winner — or, with --explain, the full ranked table with
+    the per-term score breakdown (throughput / adjacency / fragmentation
+    / preference). Entirely offline: the scorer is a pure function of
+    the fleet and the request, so what this prints IS what the
+    controller would bind."""
+    from ..api.slicerequest import SliceRequestSpec
+    from ..topology.placement import (
+        FleetState,
+        rank_candidates,
+        unschedulable_reason,
+    )
+
+    try:
+        with open(args.fleet) as f:
+            nodes = _fixture_nodes(yaml.safe_load(f))
+    except (OSError, ValueError, yaml.YAMLError) as e:
+        print(f"INVALID fleet fixture {args.fleet}: {e}", file=sys.stderr)
+        return 2
+    spec = SliceRequestSpec(
+        chips=args.chips, topology=args.topology or None,
+        accelerator=args.accelerator or None, priority=args.priority,
+        preferred_generations=[g for g in args.prefer.split(",") if g]
+        or None)
+    fleet = FleetState(nodes)
+    ranked = rank_candidates(spec, fleet)
+    shown = ranked[:args.top] if args.top > 0 else ranked
+    if args.output == "json":
+        print(json.dumps({
+            "request": spec.to_obj(),
+            "candidates": [{
+                "pool": c.pool, "slice": c.slice_id,
+                "generation": c.generation, "nodes": list(c.nodes),
+                "chips": c.chips, "score": f"{c.score:.6f}",
+                "breakdown": {k: f"{v:.6f}"
+                              for k, v in sorted(c.breakdown.items())},
+            } for c in shown],
+            "reason": None if ranked else unschedulable_reason(spec, fleet),
+        }, indent=2, sort_keys=True))
+        return 0 if ranked else 1
+    totals = fleet.chip_totals()
+    fleet_line = " ".join(
+        f"{gen}:{t['free']}/{t['free'] + t['placed']}"
+        for gen, t in sorted(totals.items()))
+    print(f"fleet: {len(fleet.slices)} slices, free chips {fleet_line}")
+    print(f"request: chips={spec.chips_needed()}"
+          + (f" topology={spec.topology}" if spec.topology else "")
+          + (f" accelerator={spec.accelerator}" if spec.accelerator else "")
+          + (f" prefer={','.join(spec.preferred_generations)}"
+             if spec.preferred_generations else ""))
+    if not ranked:
+        print(f"UNSCHEDULABLE: {unschedulable_reason(spec, fleet)}")
+        return 1
+    if args.explain:
+        print(f"{len(ranked)} candidates (top {len(shown)}):")
+        for rank, c in enumerate(shown, 1):
+            b = c.breakdown
+            print(f"{rank:3d}. {c.score:.6f}  {c.pool}/{c.slice_id}  "
+                  f"{c.chips} chips on {len(c.nodes)} host(s)")
+            print(f"     throughput={b['throughput']:.6f} "
+                  f"adjacency={b['adjacency']:.6f} "
+                  f"fragmentation={b['fragmentation']:.6f} "
+                  f"preference={b['preference']:.6f}")
+            print(f"     nodes: {', '.join(c.nodes)}")
+    else:
+        best = ranked[0]
+        print(f"PLACED: {best.pool}/{best.slice_id} score={best.score:.6f}")
+        print(f"  nodes: {', '.join(best.nodes)}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     from .. import __version__
@@ -542,6 +658,34 @@ def main(argv=None) -> int:
     dg.add_argument("-o", "--output", choices=("text", "json"),
                     default="text")
 
+    pl = sub.add_parser(
+        "place", help="dry-run the slice placement engine against a "
+                      "fleet fixture: rank candidate windows with the "
+                      "per-term score breakdown the controller would "
+                      "use; exit 1 when unschedulable")
+    pl.add_argument("--fleet", required=True,
+                    help="fleet fixture YAML: a Node list, or the "
+                         "compact {pools: [{accelerator, topology, "
+                         "chips, count}]} form")
+    pl.add_argument("--chips", type=int, default=0)
+    pl.add_argument("--topology", default="",
+                    help="requested slice topology, e.g. 4x4; overrides "
+                         "--chips when set")
+    pl.add_argument("--accelerator", default="",
+                    help="hard accelerator pin, e.g. tpu-v5e-slice")
+    pl.add_argument("--priority", type=int, default=0)
+    pl.add_argument("--prefer", default="",
+                    help="comma-separated soft generation preference "
+                         "order, e.g. v5p,v5e")
+    pl.add_argument("--explain", action="store_true",
+                    help="print every ranked candidate with the "
+                         "per-term score breakdown, not just the winner")
+    pl.add_argument("--top", type=int, default=10,
+                    help="candidates shown with --explain/-o json "
+                         "(0 = all)")
+    pl.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+
     args = p.parse_args(argv)
 
     if args.cmd in ("install", "upgrade", "uninstall"):
@@ -552,6 +696,8 @@ def main(argv=None) -> int:
         return _trace(args)
     if args.cmd == "dag":
         return _dag(args)
+    if args.cmd == "place":
+        return _place(args)
 
     if args.cmd == "diff":
         docs = _generate_docs(args)

@@ -259,6 +259,9 @@ class ClusterPolicyReconciler(Reconciler):
         # and transition Events consume every slice so truncation cannot
         # blind the not-validated alert or its history
         set_nested(cr, slices[:MAX_ROWS], "status", "slices")
+        # surfaced alongside the capped rows so a large fleet can tell the
+        # list was cut (the gauges above still count every slice)
+        set_nested(cr, len(slices) > MAX_ROWS, "status", "slicesTruncated")
         OPERATOR_METRICS.slices_total.set(len(slices))
         OPERATOR_METRICS.slices_validated.set(
             sum(1 for s in slices if s["validated"]))

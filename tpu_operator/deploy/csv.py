@@ -10,7 +10,7 @@ the same code that renders the Deployment/RBAC/CRDs, so it cannot drift:
 
     tpuop-cfg generate bundle [--values my-values.yaml]
 
-emits the bundle manifest stream: the CSV, both CRDs, and the OLM bundle
+emits the bundle manifest stream: the CSV, every CRD, and the OLM bundle
 annotations document (metadata/annotations.yaml content).
 """
 
@@ -55,8 +55,18 @@ def _sample_tpudriver() -> dict:
     }
 
 
+def _sample_slicerequest() -> dict:
+    from ..api.slicerequest import new_slice_request
+
+    return new_slice_request(
+        "train-8x", spec={"chips": 8, "topology": "2x4",
+                          "preferredGenerations": ["v5p", "v5e"]})
+
+
 def _owned_crds() -> List[dict]:
     from ..api import V1, V1ALPHA1
+    from ..api.slicerequest import KIND_SLICE_REQUEST
+    from ..api.slicerequest import V1ALPHA1 as SR_V1ALPHA1
 
     return [
         {
@@ -104,6 +114,19 @@ def _owned_crds() -> List[dict]:
                 {"path": "state", "displayName": "State"},
             ],
         },
+        {
+            "name": "slicerequests.tpu.graft.dev",
+            "kind": KIND_SLICE_REQUEST,
+            "version": SR_V1ALPHA1.split("/")[-1],
+            "displayName": "SliceRequest",
+            "description": "A request for a TPU slice; the placement "
+                           "engine binds it to concrete nodes over the "
+                           "ICI topology.",
+            "statusDescriptors": [
+                {"path": "phase", "displayName": "Phase",
+                 "description": "Pending|Placed|Unschedulable"},
+            ],
+        },
     ]
 
 
@@ -118,7 +141,8 @@ def render_csv(values: Dict[str, Any]) -> dict:
         values.get("operator") or {})
     # OLM owns name/namespace placement; the install strategy embeds only
     # the Deployment's spec
-    alm_examples = [sample_cluster_policy(), _sample_tpudriver()]
+    alm_examples = [sample_cluster_policy(), _sample_tpudriver(),
+                    _sample_slicerequest()]
     return {
         "apiVersion": "operators.coreos.com/v1alpha1",
         "kind": "ClusterServiceVersion",

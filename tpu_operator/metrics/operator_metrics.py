@@ -168,6 +168,22 @@ class OperatorMetrics:
             "Informer cache relists (watch-gap heals and forced "
             "resyncs), per cached kind",
             labelnames=("kind",))
+        # slice placement engine (topology/placement.py + the
+        # SliceRequest controller): decision outcomes, per-decision
+        # scoring latency, and the free/placed chip inventory per
+        # generation — the fleet-utilization face of the bin-packer
+        self.placement_decisions = c(
+            "tpu_operator_placement_decisions_total",
+            "SliceRequest placement decisions, by outcome "
+            "(placed|unschedulable|released|evicted)",
+            labelnames=("outcome",))
+        self.placement_latency = h(
+            "tpu_operator_placement_latency_seconds",
+            "Wall time of one placement scoring pass (rank + bind)")
+        self.fleet_chips = g(
+            "tpu_operator_fleet_chips",
+            "TPU chips by generation and placement state",
+            labelnames=("accelerator", "state"))
 
 
 OPERATOR_METRICS = OperatorMetrics()

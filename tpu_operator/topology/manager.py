@@ -53,8 +53,23 @@ def load_profiles(config_file: str) -> Dict[str, Profile]:
         raw = yaml.safe_load(f) or {}
     out = {}
     for name, body in (raw.get("profiles") or {}).items():
-        out[name] = Profile(name=name,
-                            subslices=int(body.get("subslices", 1)),
+        # validate per profile and name the offender: one bad entry in a
+        # shared config map must fail with WHICH profile is broken, not
+        # a bare int() traceback pointing at nothing
+        if not isinstance(body, dict):
+            raise ValueError(
+                f"profile {name!r} in {config_file}: body must be a "
+                f"mapping, got {type(body).__name__}")
+        subslices = body.get("subslices", 1)
+        if isinstance(subslices, bool) or not isinstance(subslices, int):
+            raise ValueError(
+                f"profile {name!r} in {config_file}: subslices must be "
+                f"an integer, got {subslices!r}")
+        if subslices < 1:
+            raise ValueError(
+                f"profile {name!r} in {config_file}: subslices must be "
+                f">= 1, got {subslices}")
+        out[name] = Profile(name=name, subslices=subslices,
                             description=body.get("description", ""))
     if not out:
         raise ValueError(f"no profiles in {config_file}")
