@@ -503,6 +503,33 @@ def _controlplane_doc() -> dict | None:
                 pl["fleet_utilization_first_fit"], 4)
         except Exception as e:
             doc["placement"] = {"error": f"{type(e).__name__}: {e}"}
+        # elastic-slice migration vs kill-and-reschedule across a full
+        # driver rollout on a virtual clock (its own try for the same
+        # reason as rollout's). slice_migration_p95_s at top level is
+        # the headline figure tests/test_bench_guard.py tracks.
+        try:
+            from tpu_operator.benchmarks.controlplane import (
+                run_migration_bench,
+            )
+
+            mg = run_migration_bench(min(100, n))
+            doc["migration"] = {
+                "n_tpu_nodes": mg["n_tpu_nodes"],
+                "n_requests": mg["n_requests"],
+                "migrations": mg["migrations"],
+                "migrations_aborted": mg["migrations_aborted"],
+                "kills": mg["kills"],
+                "p50_s": round(mg["slice_migration_p50_s"], 2),
+                "kill_p50_s": round(mg["kill_reschedule_p50_s"], 2),
+                "kill_p95_s": round(mg["kill_reschedule_p95_s"], 2),
+                "elastic_lost_steps": mg["elastic_lost_steps"],
+                "kill_lost_steps": mg["kill_lost_steps"],
+                "speedup_p95": round(mg["speedup_p95"], 2),
+            }
+            doc["slice_migration_p95_s"] = round(
+                mg["slice_migration_p95_s"], 2)
+        except Exception as e:
+            doc["migration"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

@@ -127,6 +127,27 @@ def test_placement_p99_not_regressed():
         f"regressed >25% vs best on record ({best:.3f}ms)")
 
 
+def test_slice_migration_p95_not_regressed():
+    """Same contract again, for the elastic-slice migration stall p95
+    (benchmarks.controlplane.run_migration_bench): the latest round's
+    slice_migration_p95_s may be at most 25% above the best on record.
+    Skips until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "slice_migration_p95_s")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records slice_migration_p95_s yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} slice_migration_p95_s="
+        f"{latest:.2f}s regressed >25% vs best on record ({best:.2f}s)")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

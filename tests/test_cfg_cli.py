@@ -626,3 +626,89 @@ class TestStatusJsonFailure:
         assert rc == 1
         assert out.out == ""  # diagnostics belong to stderr in text mode
         assert "cannot reach the cluster" in out.err
+
+
+class TestSlicesView:
+    """`tpuop-cfg slices`: the SliceRequest fleet view, including the
+    elastic-migration handshake surfaced by --migrations."""
+
+    def _seed(self):
+        from tpu_operator.api import labels as L
+        from tpu_operator.api.slicerequest import new_slice_request
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        mid = new_slice_request("ereq-001", {"chips": 4})
+        mid["metadata"]["namespace"] = "tpu-operator"
+        mid["metadata"].setdefault("annotations", {}).update({
+            L.SLICE_INTENT: "migrate",
+            L.SLICE_INTENT_DEADLINE: "120.000",
+            L.SLICE_INTENT_ACK: "42"})
+        mid["status"] = {
+            "phase": "Placed", "chips": 4, "nodes": ["n1", "n2"],
+            "migrations": 1,
+            "migration": {"phase": "Checkpointed", "intent": "migrate",
+                          "deadline": "120.000", "ackedStep": 42,
+                          "from": ["n0", "n1"]}}
+        c.create(mid)
+        quiet = new_slice_request("ereq-002", {"chips": 8})
+        quiet["metadata"]["namespace"] = "other"
+        quiet["status"] = {"phase": "Pending"}
+        c.create(quiet)
+        return c
+
+    def test_report_rows_carry_handshake(self):
+        from tpu_operator.cli.tpuop_cfg import _slices_report
+
+        rep = _slices_report(self._seed(), "")
+        assert [r["name"] for r in rep["requests"]] == [
+            "ereq-002", "ereq-001"]  # sorted by (namespace, name)
+        rep = _slices_report(self._seed(), "tpu-operator")
+        (row,) = rep["requests"]
+        assert row["phase"] == "Placed"
+        assert row["migrations"] == 1
+        assert row["migration"]["phase"] == "Checkpointed"
+        assert row["migration"]["intent"] == "migrate"
+        assert row["migration"]["ackedStep"] == 42
+        assert row["migration"]["restoredStep"] is None
+        assert rep["migrationsTotal"] == 1
+
+    def test_namespace_filter_and_empty(self):
+        from tpu_operator.cli.tpuop_cfg import _slices_report
+        from tpu_operator.runtime import FakeClient
+
+        rep = _slices_report(self._seed(), "other")
+        assert [r["name"] for r in rep["requests"]] == ["ereq-002"]
+        assert rep["migrationsTotal"] == 0
+        assert _slices_report(FakeClient(), "") == {
+            "requests": [], "migrationsTotal": 0}
+
+    def test_text_renderer_shows_migration_detail(self, capsys):
+        from tpu_operator.cli.tpuop_cfg import (_print_slices_text,
+                                                _slices_report)
+
+        rep = _slices_report(self._seed(), "tpu-operator")
+        _print_slices_text(rep, migrations=True)
+        out = capsys.readouterr().out
+        assert "tpu-operator/ereq-001: Placed" in out
+        assert "migration Checkpointed" in out
+        assert "intent: migrate (deadline 120.000)" in out
+        assert "acked step: 42" in out
+        assert "completed migrations: 1" in out
+
+    def test_unreachable_cluster_emits_json_error(self, monkeypatch,
+                                                  capsys):
+        import json
+
+        from tpu_operator.runtime import kubeclient as kc
+
+        def boom():
+            raise RuntimeError("no kubeconfig anywhere")
+
+        monkeypatch.setattr(kc.KubeConfig, "load", staticmethod(boom))
+        rc = main(["slices", "-o", "json"])
+        out = capsys.readouterr()
+        assert rc == 1
+        doc = json.loads(out.out)
+        assert doc["requests"] == []
+        assert "no kubeconfig anywhere" in doc["error"]

@@ -82,6 +82,64 @@ class TestTrainCheckpointer:
             ckpt.restore(state)
         ckpt.close()
 
+    def test_restore_skips_corrupt_latest_and_counts_fallback(
+            self, tmp_path):
+        """A crash can leave a torn latest step directory that still
+        enumerates; a default restore must fall back to the previous
+        retained step (logged + counted) instead of failing the job,
+        while an explicit step= request still raises — the caller asked
+        for that step, not "the newest restorable one"."""
+        import os
+        import shutil
+
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        mesh = build_mesh(model_parallel=2)
+        step, state = small_state(mesh)
+        ckpt = TrainCheckpointer(str(tmp_path), max_to_keep=3)
+        state, _ = step(state, make_batch(CFG, mesh, jax.random.PRNGKey(1)))
+        ckpt.save(state, 1)
+        # the train step donates its input buffers — snapshot what step 1
+        # held before stepping again
+        good_embed = np.asarray(state["params"]["embed"])
+        state, _ = step(state, make_batch(CFG, mesh, jax.random.PRNGKey(2)))
+        ckpt.save(state, 2)
+        assert ckpt.all_steps() == [1, 2]
+        # gut the latest step directory (keep it enumerable — the torn
+        # shape a mid-write crash leaves behind)
+        torn = tmp_path / "2"
+        for entry in os.listdir(torn):
+            p = torn / entry
+            shutil.rmtree(p) if p.is_dir() else os.remove(p)
+        assert ckpt.all_steps() == [1, 2]
+        before = OPERATOR_METRICS.checkpoint_restore_fallbacks._value.get()
+        _, fresh = small_state(mesh)
+        restored = ckpt.restore(fresh)
+        after = OPERATOR_METRICS.checkpoint_restore_fallbacks._value.get()
+        assert after == before + 1
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["embed"]),
+            good_embed, atol=0, rtol=0)
+        with pytest.raises(Exception):
+            ckpt.restore(fresh, step=2)
+        ckpt.close()
+
+    def test_restore_raises_when_every_step_is_corrupt(self, tmp_path):
+        import os
+        import shutil
+
+        mesh = build_mesh(model_parallel=2)
+        _, state = small_state(mesh)
+        ckpt = TrainCheckpointer(str(tmp_path))
+        ckpt.save(state, 1)
+        torn = tmp_path / "1"
+        for entry in os.listdir(torn):
+            p = torn / entry
+            shutil.rmtree(p) if p.is_dir() else os.remove(p)
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(state)
+        ckpt.close()
+
     def test_interrupted_run_resumes_to_same_result(self, tmp_path):
         # uninterrupted 4 steps vs 2 steps + resume: identical final loss,
         # and `first` spans the WHOLE run (sidecar), not the resumed tail

@@ -1,0 +1,243 @@
+"""Elastic-slice workload shim + migrate protocol (workloads/elastic.py
+and controllers/slices.py — the Tenplex-style checkpoint/rebind/resume
+handshake the upgrade FSM and the placement resize path both drive).
+
+Three layers:
+
+1. ``MemoryCheckpointStore``: finalize-rename atomicity — a torn
+   (partial) save can never shadow a finalized step, restore skips
+   partials with fallback accounting.
+2. The full handshake: SliceMigrator posts the intent, the workload
+   checkpoints + acks, the migrator rebinds off the draining unit, the
+   workload resumes — with the no-acked-work-lost invariant at each
+   hop, plus the timeout -> hard-drain and opt-out degradations.
+3. Crash/restore: a crash mid-save loses only un-acked steps.
+"""
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.slicerequest import (
+    INTENT_MIGRATE,
+    KIND_SLICE_REQUEST,
+    MIG_ABORTED,
+    MIG_CHECKPOINTED,
+    MIG_MIGRATING,
+    MIG_REBOUND,
+    MIG_RESUMED,
+    PHASE_PLACED,
+    V1ALPHA1,
+    SliceRequestSpec,
+    new_slice_request,
+)
+from tpu_operator.controllers.placement_controller import PlacementReconciler
+from tpu_operator.controllers.slices import SliceMigrator
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.runtime.objects import annotations_of, get_nested
+from tpu_operator.workloads.elastic import ElasticWorkload, MemoryCheckpointStore
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def two_pool_fleet():
+    """Two independent 2-host v5e slices: a migration off pool-a has
+    exactly one place to go."""
+    c = FakeClient()
+    for pool, names in (("pool-a", ("a0", "a1")),
+                        ("pool-b", ("b0", "b1"))):
+        for i, name in enumerate(names):
+            c.add_node(name, labels={
+                L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+                L.GKE_TPU_TOPOLOGY: "2x4",
+                L.GKE_NODEPOOL: pool,
+                L.GKE_TPU_WORKER_ID: str(i),
+                L.GKE_ACCELERATOR_COUNT: "4"},
+                allocatable={"google.com/tpu": "4"})
+    return c
+
+
+def place(c, clock, name="job", chips=8):
+    rec = PlacementReconciler(client=c, namespace="default", now=clock)
+    c.create(new_slice_request(
+        name, spec=SliceRequestSpec(chips=chips).to_obj(),
+        namespace="default"))
+    rec.reconcile(Request(name=name, namespace="default"))
+    cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, name, "default")
+    assert get_nested(cr, "status", "phase") == PHASE_PLACED
+    return rec, list(get_nested(cr, "status", "nodes"))
+
+
+class TestMemoryCheckpointStore:
+    def test_partial_save_enumerates_but_never_restores(self):
+        store = MemoryCheckpointStore()
+        store.save(6, payload={"step": 6})
+        store.save(9, payload={"step": 9}, partial=True)
+        assert store.all_steps() == [6, 9]      # the torn dir is visible
+        assert store.latest_step() == 6          # but not durable
+        step, payload = store.restore()          # fallback past the tear
+        assert (step, payload["step"]) == (6, 6)
+
+    def test_partial_never_overwrites_finalized_same_step(self):
+        """Finalize-rename atomicity: a crash during a re-save of step N
+        cannot corrupt the finalized step-N directory."""
+        store = MemoryCheckpointStore()
+        store.save(6, payload={"step": 6})
+        store.save(6, payload=None, partial=True)
+        assert store.latest_step() == 6
+        assert store.restore()[0] == 6
+
+    def test_retention_keeps_newest_finalized(self):
+        store = MemoryCheckpointStore(max_to_keep=2)
+        for s in (3, 6, 9, 12):
+            store.save(s)
+        assert store.all_steps() == [9, 12]
+
+    def test_empty_store_raises(self):
+        store = MemoryCheckpointStore()
+        with pytest.raises(FileNotFoundError):
+            store.restore()
+        store.save(3, partial=True)
+        with pytest.raises(FileNotFoundError):
+            store.restore()
+
+
+class TestMigrateHandshake:
+    def test_full_walk_resumes_on_replacement_nodes(self):
+        c = two_pool_fleet()
+        clock = Clock()
+        _, bound = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        for _ in range(3):
+            wl.tick()
+            clock.t += 1
+        migrator = SliceMigrator(c, now=clock)
+        # pass 1: intent posted, not ready to drain yet
+        assert migrator.ready_to_drain(bound, clock.t + 60) is False
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert annotations_of(cr).get(L.SLICE_INTENT) == INTENT_MIGRATE
+        assert get_nested(cr, "status", "migration",
+                          "phase") == MIG_MIGRATING
+        # workload checkpoints at the step boundary and acks
+        wl.tick()
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_CHECKPOINTED
+        acked = mig["ackedStep"]
+        assert acked == wl.step
+        # pass 2: acked -> rebind off the draining unit, drain unblocked
+        assert migrator.ready_to_drain(bound, clock.t + 60) is True
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_REBOUND
+        new_nodes = list(get_nested(cr, "status", "nodes"))
+        assert not set(new_nodes) & set(bound)
+        assert get_nested(cr, "status", "migrations") == 1
+        # workload sees the rebind, restores the acked step, resumes
+        wl.tick()
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_RESUMED
+        assert mig["restoredStep"] == acked   # no acked work lost
+        assert wl.step == acked
+        # training continues on the new binding
+        wl.tick()
+        assert wl.step > acked
+
+    def test_timeout_degrades_to_hard_drain(self):
+        c = two_pool_fleet()
+        clock = Clock()
+        _, bound = place(c, clock)
+        migrator = SliceMigrator(c, now=clock)
+        deadline = clock.t + 60
+        assert migrator.ready_to_drain(bound, deadline) is False
+        # nobody acks (the workload never ticks); the window closes
+        clock.t = deadline + 1
+        assert migrator.ready_to_drain(bound, deadline) is True
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_ABORTED
+        assert "hard drain" in mig["reason"]
+        # the binding was NOT moved: the FSM's drain will evict it
+        assert list(get_nested(cr, "status", "nodes")) == bound
+
+    def test_opt_out_annotation_skips_the_handshake(self):
+        c = two_pool_fleet()
+        clock = Clock()
+        _, bound = place(c, clock)
+        c.patch(V1ALPHA1, KIND_SLICE_REQUEST, "job",
+                {"metadata": {"annotations": {L.SLICE_ELASTIC: "false"}}},
+                namespace="default")
+        migrator = SliceMigrator(c, now=clock)
+        assert migrator.ready_to_drain(bound, clock.t + 60) is True
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert L.SLICE_INTENT not in annotations_of(cr)
+
+    def test_migrator_restart_resumes_mid_handshake(self):
+        """The migrator is stateless: a fresh instance (operator
+        restart) picks the handshake up from status/annotations."""
+        c = two_pool_fleet()
+        clock = Clock()
+        _, bound = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        wl.tick()
+        assert SliceMigrator(c, now=clock).ready_to_drain(
+            bound, clock.t + 60) is False
+        wl.tick()  # acks
+        # a brand-new migrator instance completes the rebind
+        assert SliceMigrator(c, now=clock).ready_to_drain(
+            bound, clock.t + 60) is True
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert get_nested(cr, "status", "migration",
+                          "phase") == MIG_REBOUND
+
+
+class TestCrashRecovery:
+    def test_crash_loses_only_unacked_steps(self):
+        c = two_pool_fleet()
+        clock = Clock()
+        place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock,
+                             checkpoint_every=6, steps_per_tick=3)
+        for _ in range(4):
+            wl.tick()
+            clock.t += 1
+        durable = wl.store.latest_step()
+        assert durable is not None
+        before = wl.step
+        wl.crash(partial=True)   # leaves a torn step at wl.step
+        wl.tick()                # restart: restore consumes the quantum
+        assert wl.step == durable <= before
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert get_nested(cr, "status", "migration",
+                          "restoredStep") == durable
+        wl.tick()
+        assert wl.step == durable + wl.steps_per_tick
+
+    def test_crash_after_ack_still_restores_acked_step(self):
+        """The ack is a durability promise: even if the job crashes
+        right after acking (torn save at a later step), the restore may
+        not land below the acked step."""
+        c = two_pool_fleet()
+        clock = Clock()
+        _, bound = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        wl.tick()
+        migrator = SliceMigrator(c, now=clock)
+        migrator.ready_to_drain(bound, clock.t + 60)
+        wl.tick()                # checkpoints + acks this step
+        acked = get_nested(c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job",
+                                 "default"),
+                           "status", "migration", "ackedStep")
+        wl.step += wl.steps_per_tick   # un-acked progress…
+        wl.crash(partial=True)         # …torn at the crash step
+        wl.tick()
+        assert wl.step >= acked
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert get_nested(cr, "status", "migration",
+                          "restoredStep") >= acked

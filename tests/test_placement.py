@@ -459,3 +459,178 @@ class TestPlaceCli:
         bad.write_text("just a string")
         rc = main(["place", "--fleet", str(bad), "--chips", "8"])
         assert rc == 2
+
+
+class TestUnschedulableBackoff:
+    """Satellite: the fixed 30s Unschedulable requeue became a capped
+    exponential backoff with deterministic per-(key, attempt) jitter."""
+
+    def test_schedule_doubles_to_cap_deterministically(self):
+        from tpu_operator.controllers.placement_controller import (
+            REQUEUE_UNSCHEDULABLE_BASE_S,
+            REQUEUE_UNSCHEDULABLE_CAP_S,
+            unschedulable_backoff,
+        )
+
+        for attempt in range(12):
+            d1 = unschedulable_backoff("default/a", attempt)
+            d2 = unschedulable_backoff("default/a", attempt)
+            assert d1 == d2  # seeded jitter: byte-identical chaos verdicts
+            base = min(REQUEUE_UNSCHEDULABLE_CAP_S,
+                       REQUEUE_UNSCHEDULABLE_BASE_S * 2 ** attempt)
+            assert base <= d1 <= base * 1.25
+        # different keys de-synchronize (the thundering-herd fix)
+        assert unschedulable_backoff("default/a", 3) != \
+            unschedulable_backoff("default/b", 3)
+
+    def test_attempts_escalate_and_reset_on_placement(self):
+        from tpu_operator.controllers.placement_controller import (
+            REQUEUE_UNSCHEDULABLE_BASE_S,
+        )
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        c = mixed_fleet()
+        rec = PlacementReconciler(client=c, namespace="default")
+        c.create(new_slice_request(
+            "big", spec=SliceRequestSpec(chips=32).to_obj(),
+            namespace="default"))
+        req = Request(name="big", namespace="default")
+        before = OPERATOR_METRICS.placement_requeues._value.get()
+        delays = [rec.reconcile(req).requeue_after for _ in range(4)]
+        after = OPERATOR_METRICS.placement_requeues._value.get()
+        assert after == before + 4
+        assert delays[0] < delays[1] < delays[2] < delays[3]
+        assert delays[0] < REQUEUE_UNSCHEDULABLE_BASE_S * 1.25
+        # grow the fleet so the request fits: attempt counter resets
+        for i in range(8):
+            add_tpu(c, f"grow-{i}", accel="tpu-v5p-slice", topo="4x8",
+                    chips=4, worker_id=i, pool="grown")
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "big", "default")
+        assert get_nested(cr, "status", "phase") == PHASE_PLACED
+        assert rec._unsched_attempts.get("default/big", 0) == 0
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestResizeProtocol:
+    """Elastic resize on a Placed request: a spec.chips edit drives the
+    same intent/ack/rebind handshake as a migration, with the old
+    binding kept on every degradation path."""
+
+    def make(self, resize_timeout=120.0):
+        c = mixed_fleet()
+        clock = _Clock()
+        rec = PlacementReconciler(client=c, namespace="default",
+                                  now=clock, resize_timeout=resize_timeout)
+        c.create(new_slice_request(
+            "a", spec=SliceRequestSpec(chips=8).to_obj(),
+            namespace="default"))
+        req = Request(name="a", namespace="default")
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        assert get_nested(cr, "status", "phase") == PHASE_PLACED
+        assert get_nested(cr, "status", "chips") == 8
+        return c, rec, clock, req
+
+    def _shrink(self, c, chips=4):
+        from tpu_operator.runtime.objects import set_nested, thaw_obj
+
+        cr = thaw_obj(c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default"))
+        set_nested(cr, chips, "spec", "chips")
+        c.update(cr)
+
+    def test_spec_edit_posts_intent_then_ack_rebinds(self):
+        from tpu_operator.api.slicerequest import (
+            INTENT_SHRINK,
+            MIG_CHECKPOINTED,
+            MIG_MIGRATING,
+            MIG_REBOUND,
+        )
+        from tpu_operator.runtime.objects import set_nested, thaw_obj
+
+        c, rec, clock, req = self.make()
+        old_nodes = set(get_nested(
+            c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default"),
+            "status", "nodes"))
+        self._shrink(c, 4)
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        assert annotations_of(cr).get(L.SLICE_INTENT) == INTENT_SHRINK
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_MIGRATING
+        assert float(mig["deadline"]) == clock.t + 120.0
+        # binding untouched until the workload acks
+        assert set(get_nested(cr, "status", "nodes")) == old_nodes
+        # the workload checkpoints and acks
+        cr = thaw_obj(cr)
+        mig = dict(get_nested(cr, "status", "migration"))
+        mig.update({"phase": MIG_CHECKPOINTED, "ackedStep": 7})
+        set_nested(cr, mig, "status", "migration")
+        c.update_status(cr)
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_REBOUND
+        assert get_nested(cr, "status", "chips") == 4
+        assert len(get_nested(cr, "status", "nodes")) == 1
+        assert get_nested(cr, "status", "migrations") == 1
+        # intent annotations cleared; released nodes lost their lease
+        assert L.SLICE_INTENT not in annotations_of(cr)
+        for n in old_nodes - set(get_nested(cr, "status", "nodes")):
+            node = c.get("v1", "Node", n)
+            assert L.PLACED_BY not in annotations_of(node)
+
+    def test_timeout_aborts_once_per_generation_and_keeps_binding(self):
+        from tpu_operator.api.slicerequest import MIG_ABORTED
+
+        c, rec, clock, req = self.make()
+        old_nodes = set(get_nested(
+            c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default"),
+            "status", "nodes"))
+        self._shrink(c, 4)
+        rec.reconcile(req)
+        clock.t += 121.0          # never acked: deadline passes
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_ABORTED
+        assert "deadline" in mig["reason"]
+        assert set(get_nested(cr, "status", "nodes")) == old_nodes
+        # same generation never retries: the next pass posts nothing
+        rec.reconcile(req)
+        cr2 = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        assert get_nested(cr2, "status", "migration")["phase"] == \
+            MIG_ABORTED
+        # a fresh spec edit (new generation) opens a fresh attempt
+        self._shrink(c, 2)
+        rec.reconcile(req)
+        cr3 = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        assert get_nested(cr3, "status", "migration")["phase"] != \
+            MIG_ABORTED
+
+    def test_non_elastic_workload_aborts_immediately(self):
+        from tpu_operator.api.slicerequest import MIG_ABORTED
+        from tpu_operator.runtime.objects import thaw_obj
+
+        c, rec, clock, req = self.make()
+        cr = thaw_obj(c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default"))
+        cr.setdefault("metadata", {}).setdefault(
+            "annotations", {})[L.SLICE_ELASTIC] = "false"
+        c.update(cr)
+        old_nodes = set(get_nested(
+            c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default"),
+            "status", "nodes"))
+        self._shrink(c, 4)
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_ABORTED
+        assert "not elastic" in mig["reason"]
+        assert set(get_nested(cr, "status", "nodes")) == old_nodes

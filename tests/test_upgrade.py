@@ -281,6 +281,66 @@ class TestSliceGroupedUpgrades:
         # the unit converges: both hosts end in the same state
         assert node_state(c, "slice-h0") == node_state(c, "slice-h1")
 
+    def test_wiped_state_and_stamp_heal_without_losing_the_unit(self):
+        """Both stage label AND stage-started stamp wiped on one member
+        mid-upgrade (the partial-write/restart shape): the next pass
+        re-syncs the member to the unit's surviving stage WITHOUT
+        waiting for a transition, and the stage deadline (anchored on
+        the surviving member's stamp) still fires for the whole unit."""
+        clock = [5000.0]
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator",
+                                now=lambda: clock[0])
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h1") == STATE_VALIDATION
+        c.patch("v1", "Node", "slice-h1",
+                {"metadata": {"labels": {L.UPGRADE_STATE: None},
+                              "annotations": {
+                                  L.UPGRADE_STAGE_STARTED: None}}})
+        # block validation so the unit is parked, not transitioning
+        for pod in rec._validator_pods_by_node().get("slice-h0", []):
+            pod = thaw_obj(pod)
+            for cond in get_nested(pod, "status", "conditions",
+                                   default=[]) or []:
+                if cond.get("type") == "Ready":
+                    cond["status"] = "False"
+            c.update(pod)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_VALIDATION
+        assert node_state(c, "slice-h1") == STATE_VALIDATION
+        # the validation deadline survived the wipe: the unit fails
+        # together instead of h1 wedging label-less forever
+        clock[0] += 301
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_FAILED
+        assert node_state(c, "slice-h1") == STATE_FAILED
+
+    def test_diverged_members_resync_to_earliest_stage(self):
+        """When members report different stages (a crash between the
+        per-node label writes), the unit's aggregate is the EARLIEST
+        stage — the host that got ahead is dragged back and the pair
+        re-walks together, never leaving one host upgraded alone."""
+        c, prec = build_mixed_cluster()
+        rec = UpgradeReconciler(client=c, namespace="tpu-operator")
+        change_driver_spec(c, prec)
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        assert node_state(c, "slice-h0") == STATE_VALIDATION
+        # h1 crashed back to drain-required; h0 still says validation
+        c.patch("v1", "Node", "slice-h1",
+                {"metadata": {"labels": {
+                    L.UPGRADE_STATE: STATE_DRAIN}}})
+        rec.reconcile(Request(name="tpu-cluster-policy"))
+        # the unit re-walked from drain as one: both members agree and
+        # neither was uncordoned while the other was mid-stage
+        assert node_state(c, "slice-h0") == node_state(c, "slice-h1")
+        c.simulate_kubelet(ready=True)
+        for _ in range(4):
+            rec.reconcile(Request(name="tpu-cluster-policy"))
+            c.simulate_kubelet(ready=True)
+        assert node_state(c, "slice-h0") == STATE_DONE
+        assert node_state(c, "slice-h1") == STATE_DONE
+
 
 def add_tpu_pod(c, name, node, labels=None, ready=True):
     conditions = [{"type": "Ready", "status": "True" if ready else "False"}]

@@ -304,6 +304,10 @@ class DriverUpgradePolicySpec:
     failed_retry_backoff_seconds: Optional[int] = field(
         default=60, description="Backoff before a failed node re-enters "
         "the upgrade FSM")
+    migration_timeout_seconds: Optional[int] = field(
+        default=120, description="Seconds the migrate stage waits for a "
+        "placed slice to checkpoint-and-rebind before degrading to the "
+        "hard drain; 0 disables the elastic migrate stage entirely")
 
 
 @dataclass

@@ -58,6 +58,33 @@ def _slice_request_status_schema() -> dict:
             "score": {"type": "string"},
             "evictions": {"type": "integer"},
             "lastEvictionReason": {"type": "string"},
+            # chips actually bound (spec.chips_needed() at bind time);
+            # a later spec edit that diverges from this is what triggers
+            # the shrink/grow intent
+            "chips": {"type": "integer"},
+            # completed migrations/resizes (monotone; the placement-stable
+            # chaos invariant accepts a bound-node change only when this
+            # or evictions advanced)
+            "migrations": {"type": "integer"},
+            # current/last elastic-slice attempt (slice-intent contract)
+            "migration": {
+                "type": "object",
+                "properties": {
+                    "phase": {"type": "string",
+                              "enum": ["Migrating", "Checkpointed",
+                                       "Rebound", "Resumed", "Aborted"]},
+                    "intent": {"type": "string",
+                               "enum": ["migrate", "shrink", "grow"]},
+                    "deadline": {"type": "string"},
+                    "startedAt": {"type": "string"},
+                    "ackedStep": {"type": "integer"},
+                    "restoredStep": {"type": "integer"},
+                    "from": {"type": "array", "items": {"type": "string"}},
+                    "to": {"type": "array", "items": {"type": "string"}},
+                    "forGeneration": {"type": "integer"},
+                    "reason": {"type": "string"},
+                },
+            },
             "conditions": {"type": "array",
                            "items": {"type": "object",
                                      "x-kubernetes-preserve-unknown-fields": True}},

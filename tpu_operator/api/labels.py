@@ -71,6 +71,24 @@ STATE_LABEL = f"{DOMAIN}/state"                    # which state owns an object
 # returns). The same annotation on the policy CR pauses the whole rollout.
 # (driverAutoUpgradeAnnotationKey analog, state_manager.go:423-477)
 DRIVER_UPGRADE_ENABLED = f"{DOMAIN}/driver-upgrade-enabled"
+# --- elastic-slice protocol (slice-intent contract) ------------------------
+# Posted on a SliceRequest by the operator (upgrade FSM migrate stage, or
+# the placement controller on a spec resize) to ask the workload to
+# checkpoint-and-reshard. Value is the intent kind: migrate | shrink | grow.
+SLICE_INTENT = f"{DOMAIN}/slice-intent"
+# epoch-seconds deadline for the intent above; past it the operator falls
+# back to a hard drain (migrate) or abandons the resize attempt (shrink/
+# grow), recording outcome="timeout".
+SLICE_INTENT_DEADLINE = f"{DOMAIN}/slice-intent-deadline"
+# workload acknowledgement: the checkpoint step durably saved for this
+# intent. Written by the workload shim (workloads/elastic.py); the
+# operator only rebinds capacity after seeing the ack, which is what
+# makes the no-acked-work-lost invariant hold across any interleaving.
+SLICE_INTENT_ACK = f"{DOMAIN}/slice-intent-ack"
+# stamp "false" on a SliceRequest to declare its workload does not speak
+# the intent protocol; the operator skips straight to the hard-drain path
+# without burning the migration timeout waiting for an ack.
+SLICE_ELASTIC = f"{DOMAIN}/elastic"
 
 # --- Pod Security Admission (namespace labels) ----------------------------
 # stamped on the operand namespace so privileged operand pods admit under

@@ -23,6 +23,26 @@ PHASE_PENDING = "Pending"
 PHASE_PLACED = "Placed"
 PHASE_UNSCHEDULABLE = "Unschedulable"
 
+# --- elastic-slice protocol (status.migration.phase) -----------------------
+# Lifecycle of one migration/resize attempt, surfaced on the request so
+# operators (and the chaos invariants) can follow the handshake:
+#   Migrating    intent posted, waiting for the workload to checkpoint
+#   Checkpointed workload acked a durable checkpoint step
+#   Rebound      operator leased replacement capacity and moved the binding
+#   Resumed      workload restored the acked step on the new topology
+#   Aborted      deadline passed (or the attempt was superseded); the
+#                operator degraded to the pre-elastic hard-drain behavior
+MIG_MIGRATING = "Migrating"
+MIG_CHECKPOINTED = "Checkpointed"
+MIG_REBOUND = "Rebound"
+MIG_RESUMED = "Resumed"
+MIG_ABORTED = "Aborted"
+MIG_TERMINAL = ("", MIG_RESUMED, MIG_ABORTED)
+
+INTENT_MIGRATE = "migrate"
+INTENT_SHRINK = "shrink"
+INTENT_GROW = "grow"
+
 
 @dataclass
 class SliceRequestSpec:

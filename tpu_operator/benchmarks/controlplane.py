@@ -594,3 +594,178 @@ def run_placement_bench(n_tpu: int = 500, n_requests: int = 2000,
         "fleet_utilization_first_fit": naive["utilization"],
         "first_fit_placed": naive["placed"],
     }
+
+
+def run_migration_bench(n_tpu: int = 100, n_requests: int = 6,
+                        pass_budget: int = 300, seed: int = 0) -> Dict:
+    """Workload recovery latency across a full driver rollout: the
+    elastic migrate stage (checkpoint-ack-rebind ahead of the drain)
+    vs the kill-and-reschedule baseline (migrate stage disabled, the
+    job dies with the drain and waits out the unit's whole
+    drain/restart/validate/uncordon cycle on its old nodes).
+
+    Both modes run the SAME seeded request mix through the REAL
+    controllers (placement + upgrade FSM + the ElasticWorkload shim) on
+    a virtual clock, so a recovery span is deterministic virtual
+    seconds, not wall noise. A span is a STALLED-TRAINING window,
+    measured identically in both modes: it opens the first pass a
+    workload makes no step progress and closes when it is past its
+    pre-stall step again. Elastic's only stall is the reshard/restore
+    pause after the rebind; the killed job is dark for its unit's whole
+    cordon-to-uncordon cycle plus the re-warm back to its old step. The
+    headline pair is ``slice_migration_p95_s`` vs
+    ``kill_reschedule_p95_s``, plus the checkpointed steps each mode
+    lost."""
+    import random
+
+    from ..api.slicerequest import (
+        KIND_SLICE_REQUEST,
+        MIG_ABORTED,
+        V1ALPHA1,
+        SliceRequestSpec,
+        new_slice_request,
+    )
+    from ..chaos.faults import VirtualClock
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.placement_controller import PlacementReconciler
+    from ..controllers.upgrade_controller import (
+        STATE_DONE,
+        UpgradeReconciler,
+    )
+    from ..runtime.objects import get_nested, labels_of, name_of, thaw_obj
+    from ..workloads.elastic import ElasticWorkload
+
+    ns = "tpu-operator"
+    step_dt = 20.0
+
+    def _mode(elastic: bool) -> Dict:
+        clock = VirtualClock()
+        c = build_cluster(n_tpu)
+        c.create(new_cluster_policy(spec={"upgradePolicy": {
+            "autoUpgrade": True, "maxParallelUpgrades": 8,
+            "migrationTimeoutSeconds": 120 if elastic else 0}}))
+        prec = ClusterPolicyReconciler(client=c, namespace=ns)
+        urec = UpgradeReconciler(client=c, namespace=ns, now=clock)
+        lrec = PlacementReconciler(client=c, namespace=ns, now=clock)
+        req = Request(name="tpu-cluster-policy")
+        rng = random.Random(seed)
+        names = [f"mig-{i:03d}" for i in range(n_requests)]
+        for nm in names:
+            c.create(new_slice_request(
+                nm, spec=SliceRequestSpec(
+                    chips=rng.choice((4, 4, 8, 8))).to_obj(),
+                namespace=ns))
+
+        def place_all() -> None:
+            for nm in names:
+                lrec.reconcile(Request(name=nm, namespace=ns))
+
+        prec.reconcile(req)
+        c.simulate_kubelet(ready=True)
+        prec.reconcile(req)
+        place_all()
+        shims = {nm: ElasticWorkload(c, nm, ns, clock=clock)
+                 for nm in names}
+        for _ in range(3):  # baseline training before the rollout
+            for nm in names:
+                shims[nm].tick()
+            clock.advance(step_dt)
+
+        cr = thaw_obj(c.get(V1, KIND_CLUSTER_POLICY, "tpu-cluster-policy"))
+        cr["spec"]["libtpu"] = {"installDir": "/opt/elastic-bench"}
+        c.update(cr)
+        prec.reconcile(req)
+
+        spans: list = []
+        stall: Dict[str, tuple] = {}
+        high_step = {nm: shims[nm].step for nm in names}
+        down: set = set()
+        lost_steps = 0
+
+        for _ in range(pass_budget):
+            urec.reconcile(req)
+            place_all()
+            c.simulate_kubelet(ready=True)
+            unsched = {name_of(n) for n in c.list("v1", "Node")
+                       if get_nested(n, "spec", "unschedulable",
+                                     default=False)}
+            for nm in sorted(shims):
+                live = c.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, nm, ns)
+                if live is None:
+                    continue
+                bound = get_nested(live, "status", "nodes",
+                                   default=[]) or []
+                blocked = False
+                if not elastic:
+                    # with the migrate stage disabled, the drain follows
+                    # the cordon inside one FSM pass: the first cordoned
+                    # bound node means the job is dead, and it stays
+                    # dark until every bound node is schedulable again
+                    if any(b in unsched for b in bound):
+                        if nm not in down:
+                            wl = shims[nm]
+                            lost_steps += wl.step - (
+                                wl.store.latest_step() or 0)
+                            wl.crash(partial=False)
+                            down.add(nm)
+                        blocked = True
+                    else:
+                        down.discard(nm)
+                if not blocked:
+                    shims[nm].tick()
+                step_now = shims[nm].step
+                if nm in stall:
+                    if step_now > stall[nm][1]:
+                        spans.append(clock.t - stall[nm][0])
+                        del stall[nm]
+                elif step_now <= high_step[nm]:
+                    stall[nm] = (clock.t, high_step[nm])
+                high_step[nm] = max(high_step[nm], step_now)
+            urec.reconcile(req)
+            place_all()
+            clock.advance(step_dt)
+            tpu_nodes = [n for n in c.list("v1", "Node")
+                         if labels_of(n).get(L.GKE_TPU_ACCELERATOR)]
+            if all(labels_of(n).get(L.UPGRADE_STATE) == STATE_DONE
+                   for n in tpu_nodes) and not stall and not down:
+                break
+
+        moves = aborted = 0
+        for nm in names:
+            live = c.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, nm, ns)
+            if live is None:
+                continue
+            moves += int(get_nested(live, "status", "migrations",
+                                    default=0) or 0)
+            if (get_nested(live, "status", "migration", "phase")
+                    or "") == MIG_ABORTED:
+                aborted += 1
+        spans.sort()
+
+        def pct(p: float) -> float:
+            if not spans:
+                return 0.0
+            return spans[min(len(spans) - 1, int(p * len(spans)))]
+
+        return {"spans": len(spans), "p50_s": pct(0.50),
+                "p95_s": pct(0.95), "lost_steps": lost_steps,
+                "moves": moves, "aborted": aborted, "virtual_s": clock.t}
+
+    el = _mode(elastic=True)
+    kl = _mode(elastic=False)
+    return {
+        "n_tpu_nodes": n_tpu,
+        "n_requests": n_requests,
+        "migrations": el["moves"],
+        "migrations_aborted": el["aborted"],
+        "migration_stalls": el["spans"],
+        "kills": kl["spans"],
+        "slice_migration_p50_s": el["p50_s"],
+        "slice_migration_p95_s": el["p95_s"],
+        "kill_reschedule_p50_s": kl["p50_s"],
+        "kill_reschedule_p95_s": kl["p95_s"],
+        "elastic_lost_steps": el["lost_steps"],
+        "kill_lost_steps": kl["lost_steps"],
+        "speedup_p95": (kl["p95_s"] / el["p95_s"]
+                        if el["p95_s"] else 0.0),
+    }

@@ -58,6 +58,8 @@ TRIGGER_ROLLOUT = "trigger-rollout"  # libtpu change -> fleet upgrade FSM
 OPERAND_DRIFT = "operand-drift"    # out-of-band spec edit to a live operand
 ANNOTATION_CLEAR = "annotation-clear"  # strip the spec-hash annotations
 SLICE_REQUEST = "slice-request"    # a SliceRequest lands in the queue
+SLICE_RESIZE = "slice-resize"      # spec.chips edit on a live SliceRequest
+WORKLOAD_CRASH = "workload-crash"  # elastic shim dies mid-save (torn ckpt)
 
 
 @dataclass(frozen=True)
@@ -126,6 +128,7 @@ class FaultPlan:
             "operand-drift": cls._operand_drift,
             "dag-race": cls._dag_race,
             "placement-contention": cls._placement_contention,
+            "slice-migrate": cls._slice_migrate,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -306,6 +309,64 @@ class FaultPlan:
                     victim = rng.choice(candidates)
                     nodes.remove(victim)
                     out.append(Fault(step, NODE_REMOVE, arg=victim))
+        return out
+
+    @classmethod
+    def _slice_migrate(cls, rng, nodes, steps) -> List[Fault]:
+        """Drain-safe migrate/resize under fire: elastic (``ereq-*``) and
+        rigid (``rreq-*``) requests land in the opening steps, then a
+        fleet rollout forces every placed slice through the migrate
+        stage while 409 storms, watch drops, torn-checkpoint workload
+        crashes, spec resizes and a node removal interleave. The
+        no-acked-work-lost invariant must hold on every path — including
+        the rigid requests' timeout → hard-drain degradation."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8, 8, 16)
+        n_elastic = n_rigid = 0
+        for step in range(min(3, steps)):
+            for _ in range(rng.randrange(2, 4)):
+                if rng.random() < 0.7:
+                    n_elastic += 1
+                    name = f"ereq-{n_elastic:03d}"
+                else:
+                    n_rigid += 1
+                    name = f"rreq-{n_rigid:03d}"
+                out.append(Fault(step, SLICE_REQUEST, arg=name,
+                                 count=rng.choice(sizes),
+                                 seconds=float(rng.randrange(0, 3))))
+        if n_rigid == 0:
+            # the timeout degradation path is part of the contract; a
+            # seed must not be able to roll it off the schedule
+            n_rigid = 1
+            out.append(Fault(0, SLICE_REQUEST, arg="rreq-001",
+                             count=rng.choice(sizes)))
+        rollout_step = min(3, steps - 1)
+        out.append(Fault(rollout_step, TRIGGER_ROLLOUT,
+                         arg=cls._marker(rng, "/opt/elastic-libtpu")))
+        removed = False
+        for step in range(rollout_step + 1, steps):
+            if step % 3 == 1:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 4 == 2 and n_elastic:
+                out.append(Fault(
+                    step, WORKLOAD_CRASH,
+                    arg=f"ereq-{rng.randrange(1, n_elastic + 1):03d}"))
+            if step % 5 == 3:
+                idx = rng.randrange(1, n_elastic + n_rigid + 1)
+                name = (f"ereq-{idx:03d}" if idx <= n_elastic
+                        else f"rreq-{idx - n_elastic:03d}")
+                out.append(Fault(step, SLICE_RESIZE, arg=name,
+                                 count=rng.choice(sizes)))
+            if step % 5 == 4:
+                out.append(Fault(step, WATCH_DROP))
+            if not removed and step % 6 == 5 and len(nodes) > 4:
+                # a bound node vanishing mid-handshake: the eviction
+                # path must retire the in-flight attempt cleanly
+                victim = rng.choice(nodes)
+                nodes.remove(victim)
+                out.append(Fault(step, NODE_REMOVE, arg=victim))
+                removed = True
         return out
 
     @classmethod

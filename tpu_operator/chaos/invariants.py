@@ -47,9 +47,17 @@ point*, not just at convergence:
   binding until the eviction path catches up). Checked in every
   scenario — a run with no SliceRequests is a clean no-op.
 - ``placement-stable``: a Placed request's node set never changes
-  without ``status.evictions`` incrementing — the controller's promise
-  that placements only move through an explicit drain event, never a
+  without ``status.evictions`` OR ``status.migrations`` incrementing —
+  the controller's promise that placements only move through an
+  explicit drain event or an acknowledged elastic migration, never a
   silent re-pack.
+- ``no-lost-work``: the elastic-slice durability promise. A workload's
+  acked step (``status.migration.ackedStep`` / the
+  ``tpu.graft.dev/slice-intent-ack`` annotation) is a receipt for a
+  finalized checkpoint, so per request the acked high-water mark never
+  regresses, and every restore (``status.migration.restoredStep``
+  changing) lands at or above it — acknowledged training work must
+  survive any migrate/resize/crash interleaving the storm produces.
 - ``convergence``: recorded by the runner when the cluster fails to
   reach all-Ready within the soak budget after faults stop.
 
@@ -100,9 +108,13 @@ class InvariantChecker:
         self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
         # pass_id -> {state: done_seq}, accumulated across journal drains
         self._dag_done: Dict[int, Dict[str, int]] = {}
-        # request key -> (sorted bound-node tuple, evictions) at the last
-        # observation the request was Placed (placement-stable history)
-        self._placements: Dict[str, Tuple[Tuple[str, ...], int]] = {}
+        # request key -> (sorted bound-node tuple, evictions, migrations)
+        # at the last observation the request was Placed
+        # (placement-stable history)
+        self._placements: Dict[str, Tuple[Tuple[str, ...], int, int]] = {}
+        # request key -> (acked high-water step, last restoredStep seen)
+        # for the no-lost-work audit
+        self._work: Dict[str, Tuple[Optional[int], Optional[int]]] = {}
 
     def record(self, invariant: str, step: int, detail: str) -> None:
         self.violations.append(Violation(invariant, step, detail))
@@ -122,6 +134,7 @@ class InvariantChecker:
         self._check_cache(step, settled=False)
         self._check_dag(step)
         self._check_placement(step, nodes, settled=False)
+        self._check_work(step)
 
     # -- slice placement ----------------------------------------------------
 
@@ -185,15 +198,18 @@ class InvariantChecker:
                             "placement-sound", step,
                             f"{key}: node {node_name} lease is {lease!r} "
                             f"after settling, want {key!r}")
+            migrations = int(get_nested(req, "status", "migrations",
+                                        default=0) or 0)
             prev = self._placements.get(key)
             if prev is not None and bound != prev[0] \
-                    and evictions <= prev[1]:
+                    and evictions <= prev[1] and migrations <= prev[2]:
                 self.record(
                     "placement-stable", step,
                     f"{key}: bound nodes {list(prev[0])} -> {list(bound)} "
-                    f"without status.evictions incrementing "
-                    f"({prev[1]} -> {evictions})")
-            self._placements[key] = (bound, evictions)
+                    f"without status.evictions "
+                    f"({prev[1]} -> {evictions}) or status.migrations "
+                    f"({prev[2]} -> {migrations}) incrementing")
+            self._placements[key] = (bound, evictions, migrations)
         if settled:
             for node_name in sorted(nodes):
                 lease = (get_nested(nodes[node_name], "metadata",
@@ -208,6 +224,58 @@ class InvariantChecker:
         # above while they lived); a namesake re-create starts fresh
         for key in [k for k in self._placements if k not in live_keys]:
             del self._placements[key]
+
+    # -- elastic no-lost-work ----------------------------------------------
+
+    def _check_work(self, step: int) -> None:
+        """no-lost-work (see module docstring). An ack is written only
+        after the checkpoint it names is finalized, and retention never
+        prunes past the newest finalized step, so a regression here means
+        acknowledged training work genuinely evaporated."""
+        from ..api.slicerequest import KIND_SLICE_REQUEST, V1ALPHA1
+
+        requests = self.client.list(V1ALPHA1, KIND_SLICE_REQUEST)
+        if not requests and not self._work:
+            return
+        live = set()
+        for req in sorted(requests, key=name_of):
+            key = f"{namespace_key(req) or 'default'}/{name_of(req)}"
+            live.add(key)
+            mig = get_nested(req, "status", "migration", default={}) or {}
+            anns = get_nested(req, "metadata", "annotations",
+                              default={}) or {}
+            acks = []
+            for raw in (mig.get("ackedStep"),
+                        anns.get(L.SLICE_INTENT_ACK)):
+                try:
+                    if raw is not None:
+                        acks.append(int(raw))
+                except (TypeError, ValueError):
+                    pass
+            high, prev_restored = self._work.get(key, (None, None))
+            if acks and high is not None and max(acks) < high:
+                self.record(
+                    "no-lost-work", step,
+                    f"{key}: acked step regressed {high} -> {max(acks)}")
+            raw_restored = mig.get("restoredStep")
+            try:
+                restored = (int(raw_restored)
+                            if raw_restored is not None else None)
+            except (TypeError, ValueError):
+                restored = None
+            if restored is not None and restored != prev_restored \
+                    and high is not None and restored < high:
+                self.record(
+                    "no-lost-work", step,
+                    f"{key}: restored step {restored} below the acked "
+                    f"high-water mark {high}")
+            candidates = acks if high is None else acks + [high]
+            self._work[key] = (max(candidates) if candidates else None,
+                               restored)
+        # deleted requests stop being tracked; their durability promise
+        # died with them (a namesake re-create starts at step 0 legally)
+        for key in [k for k in self._work if k not in live]:
+            del self._work[key]
 
     # -- DAG dependency order ----------------------------------------------
 
@@ -428,6 +496,7 @@ class InvariantChecker:
         self._check_dag(step)
         nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
         self._check_placement(step, nodes, settled=True)
+        self._check_work(step)
 
 
 def namespace_key(obj: dict) -> str:

@@ -28,6 +28,7 @@ from ..api import labels as L
 from ..api.clusterpolicy import KIND_CLUSTER_POLICY, V1, new_cluster_policy
 from ..api.slicerequest import (
     KIND_SLICE_REQUEST,
+    MIG_TERMINAL,
     PHASE_PLACED,
     PHASE_UNSCHEDULABLE,
     V1ALPHA1,
@@ -60,6 +61,7 @@ from ..runtime.objects import (
     set_nested,
     thaw_obj,
 )
+from ..workloads.elastic import ElasticWorkload
 from .faults import (
     ANNOTATION_CLEAR,
     API_CONFLICT,
@@ -76,8 +78,10 @@ from .faults import (
     OPERAND_DRIFT,
     POD_CRASH,
     SLICE_REQUEST,
+    SLICE_RESIZE,
     TRIGGER_ROLLOUT,
     WATCH_DROP,
+    WORKLOAD_CRASH,
     ChaosClient,
     Fault,
     FaultPlan,
@@ -87,7 +91,14 @@ from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
-             "dag-race", "placement-contention")
+             "dag-race", "placement-contention", "slice-migrate")
+
+# virtual deadlines for the slice-migrate scenario, sized in runner steps
+# (STEP_DT each): long enough for the elastic handshake (~3 passes),
+# short enough that a rigid request demonstrably times out into the
+# hard-drain degradation inside the soak budget
+MIGRATION_TIMEOUT_S = 60.0
+RESIZE_TIMEOUT_VIRTUAL_S = 60.0
 
 NAMESPACE = "tpu-operator"
 POLICY = "tpu-cluster-policy"
@@ -320,6 +331,30 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                                       priority=int(fault.seconds)).to_obj(),
                 namespace=NAMESPACE))
             applied = True
+    elif kind == SLICE_RESIZE:
+        # the user edits spec.chips on a live request (kubectl apply of a
+        # bigger/smaller topology). The fake bumps metadata.generation on
+        # the spec change, so the placement controller's watch fires and
+        # the elastic shrink/grow handshake starts from the intent post.
+        live = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, fault.arg,
+                                NAMESPACE)
+        if live is not None:
+            victim = thaw_obj(live)
+            if get_nested(victim, "spec", "chips") != fault.count:
+                set_nested(victim, fault.count, "spec", "chips")
+                try:
+                    fake.update(victim)
+                    applied = True
+                except ConflictError:
+                    pass
+    elif kind == WORKLOAD_CRASH:
+        # the training job dies mid-step, leaving a torn (never-acked)
+        # checkpoint behind — the restart must restore the newest durable
+        # step, and the no-lost-work invariant holds it to the acked one
+        wl = (state.get("shims") or {}).get(fault.arg)
+        if wl is not None:
+            wl.crash(partial=True)
+            applied = True
     elif kind == ANNOTATION_CLEAR:
         # strip the hash annotations entirely (a `kubectl annotate ...-`
         # adversary): the skip must fail closed and restore them
@@ -422,6 +457,11 @@ def _converged(fake: FakeClient, state: dict) -> bool:
         phase = get_nested(req, "status", "phase")
         if phase not in (PHASE_PLACED, PHASE_UNSCHEDULABLE):
             return False
+        # an elastic handshake still in flight (Migrating/Checkpointed/
+        # Rebound) means a controller or workload still owes a move
+        if (get_nested(req, "status", "migration", "phase") or "") \
+                not in MIG_TERMINAL:
+            return False
         if phase != PHASE_PLACED:
             continue
         key = f"{namespace_of(req) or 'default'}/{name_of(req)}"
@@ -460,6 +500,39 @@ def _placement_summary(fake: FakeClient) -> dict:
         "chips_free": free,
         "utilization": (round(placed / (placed + free), 4)
                         if placed + free else 0.0),
+    }
+
+
+def _migration_summary(fake: FakeClient) -> dict:
+    """Deterministic elastic-protocol outcome block for the verdict: the
+    settled migration phase per request, completed-move counts, and the
+    acked/restored step pair the no-lost-work invariant audits — all read
+    from the store, byte-identical per seed."""
+    reqs = sorted(fake.list(V1ALPHA1, KIND_SLICE_REQUEST), key=name_of)
+    phases: Dict[str, int] = {}
+    completed = 0
+    rows = []
+    for req in reqs:
+        mig = dict(get_nested(req, "status", "migration",
+                              default={}) or {})
+        phase = mig.get("phase") or "none"
+        phases[phase] = phases.get(phase, 0) + 1
+        moves = int(get_nested(req, "status", "migrations",
+                               default=0) or 0)
+        completed += moves
+        rows.append({
+            "name": name_of(req),
+            "phase": phase,
+            "migrations": moves,
+            "ackedStep": mig.get("ackedStep"),
+            "restoredStep": mig.get("restoredStep"),
+            "reason": mig.get("reason"),
+        })
+    return {
+        "requests": len(reqs),
+        "phases": {k: phases[k] for k in sorted(phases)},
+        "completed_moves": completed,
+        "rows": rows,
     }
 
 
@@ -538,29 +611,44 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     # the reconcilers' client verbs get trace spans; the checker and the
     # verdict's relist counter keep the bare client
     traced = TracingClient(client)
-    fake.create(new_cluster_policy(spec={
-        "upgradePolicy": {"autoUpgrade": True,
-                          "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}}))
+    upgrade_spec = {"autoUpgrade": True,
+                    "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}
+    if scenario == "slice-migrate":
+        # a short virtual migrate window (3 ticks): the elastic requests
+        # complete the handshake inside it, the rigid ones demonstrably
+        # time out into the hard-drain degradation path
+        upgrade_spec["migrationTimeoutSeconds"] = int(MIGRATION_TIMEOUT_S)
+    fake.create(new_cluster_policy(spec={"upgradePolicy": upgrade_spec}))
     prec = ClusterPolicyReconciler(client=traced, namespace=NAMESPACE)
     urec = UpgradeReconciler(client=traced, namespace=NAMESPACE, now=clock)
     ctrls = [_SyncController(prec, traced, clock),
              _SyncController(urec, traced, clock)]
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
-    # the placement controller only joins the scenario built around it:
+    # the placement controller only joins the scenarios built around it:
     # the other scenarios create no SliceRequests, and keeping their
     # controller set unchanged keeps their verdicts unchanged. Preemption
-    # is ON here (off by default in production) so the storm also
-    # exercises the priority-eviction path under fire.
+    # is ON for the contention storm (off by default in production) so it
+    # also exercises the priority-eviction path under fire; the migrate
+    # scenario keeps it off so every rebind is a migration, not an
+    # eviction, and runs on the virtual clock for the intent deadlines.
     place_ctrl = None
-    if scenario == "placement-contention":
-        lrec = PlacementReconciler(client=traced, namespace=NAMESPACE,
-                                   preemption=True)
+    if scenario in ("placement-contention", "slice-migrate"):
+        lrec = PlacementReconciler(
+            client=traced, namespace=NAMESPACE,
+            preemption=(scenario == "placement-contention"),
+            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S)
         place_ctrl = _SyncController(lrec, traced, clock)
         lrec.setup_controller(place_ctrl, None)
         ctrls.append(place_ctrl)
+    # elastic workload shims (the training jobs' half of the slice-intent
+    # protocol) join only the migrate scenario; requests named ``rreq-*``
+    # deliberately get none — they model rigid jobs that never ack, so the
+    # migrate stage's timeout -> hard-drain fallback is always exercised
+    shims: Dict[str, ElasticWorkload] = {}
 
-    state = {"marker": None, "rollout": False, "chips": {}, "drift": False}
+    state = {"marker": None, "rollout": False, "chips": {}, "drift": False,
+             "shims": shims}
     resync = Request(name=POLICY)
     checker = InvariantChecker(fake, NAMESPACE,
                                cache=client if cached else None,
@@ -581,6 +669,19 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                 c.add(resync)
             c.drain()
         simulate_kubelet(fake, ready=True)
+        if scenario == "slice-migrate":
+            # the training jobs run their quantum: elastic requests get a
+            # shim the first time they appear, rigid (rreq-*) never do.
+            # Shims talk to the unwrapped fake like any out-of-cluster
+            # client — their writes still raise watch events for the
+            # controllers, but armed faults stay aimed at the operator.
+            for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                nm = name_of(cr)
+                if nm.startswith("ereq-") and nm not in shims:
+                    shims[nm] = ElasticWorkload(fake, nm, NAMESPACE,
+                                                clock=clock)
+            for nm in sorted(shims):
+                shims[nm].tick()
         for c in ctrls:
             c.drain()
         clock.advance(STEP_DT)
@@ -615,6 +716,8 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         }
         if place_ctrl is not None:
             out["placement"] = _placement_summary(fake)
+        if scenario == "slice-migrate":
+            out["migrations"] = _migration_summary(fake)
         return out
 
     # baseline convergence — faults only start from a known-good state,

@@ -11,6 +11,7 @@ plus the Helm-verb slot of deployments/gpu-operator/templates/*).
                     [--controller C] [--min-ms N] [--outcome error]
     tpuop-cfg dag [-o json]
     tpuop-cfg place --fleet fleet.yaml --chips 8 [--explain] [-o json]
+    tpuop-cfg slices [-n NS] [--migrations] [-o json]
 
 ``validate`` checks a CR offline: YAML wellformedness, kind/apiVersion,
 schema conformance against the generated CRD (unknown fields, wrong
@@ -220,6 +221,135 @@ def _status(args) -> int:
         return 0 if report["ready"] else 1
     except Exception as e:
         print(f"status failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return fail_json(e) if as_json else 1
+
+
+def _slices_report(client, namespace: str) -> dict:
+    """Gather every SliceRequest (one namespace or all) into one plain
+    dict — the single source both renderers (text and -o json) read.
+    Each row carries the placement picture (phase, chips, nodes) plus
+    the elastic-migration handshake state (status.migration + the
+    intent/ack annotations), so `tpuop-cfg slices --migrations` is the
+    operator-side view of a drain-safe resize in flight."""
+    from ..api import labels as L
+    from ..api.slicerequest import KIND_SLICE_REQUEST, V1ALPHA1
+    from ..runtime.client import ListOptions, NotFoundError
+    from ..runtime.objects import (annotations_of, get_nested, name_of,
+                                   namespace_of)
+
+    report: dict = {"requests": [], "migrationsTotal": 0}
+    try:
+        opts = ListOptions(namespace=namespace) if namespace else None
+        crs = client.list(V1ALPHA1, KIND_SLICE_REQUEST, opts) \
+            if opts else client.list(V1ALPHA1, KIND_SLICE_REQUEST)
+    except NotFoundError:
+        return report
+
+    def _num(raw):
+        try:
+            return int(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    for cr in sorted(crs, key=lambda c: (namespace_of(c), name_of(c))):
+        anns = annotations_of(cr)
+        mig = get_nested(cr, "status", "migration", default={}) or {}
+        moves = int(get_nested(cr, "status", "migrations",
+                               default=0) or 0)
+        report["migrationsTotal"] += moves
+        report["requests"].append({
+            "namespace": namespace_of(cr) or "default",
+            "name": name_of(cr),
+            "phase": get_nested(cr, "status", "phase",
+                                default="Pending") or "Pending",
+            "chips": int(get_nested(cr, "status", "chips",
+                                    default=0) or 0),
+            "nodes": list(get_nested(cr, "status", "nodes",
+                                     default=[]) or []),
+            "elastic": anns.get(L.SLICE_ELASTIC) != "false",
+            "migrations": moves,
+            "migration": {
+                "phase": mig.get("phase", ""),
+                "intent": mig.get("intent")
+                or anns.get(L.SLICE_INTENT) or "",
+                "deadline": mig.get("deadline")
+                or anns.get(L.SLICE_INTENT_DEADLINE) or "",
+                "ackedStep": _num(mig.get("ackedStep",
+                                          anns.get(L.SLICE_INTENT_ACK))),
+                "restoredStep": _num(mig.get("restoredStep")),
+                "from": list(mig.get("from") or []),
+                "to": list(mig.get("to") or []),
+                "reason": mig.get("reason", ""),
+            },
+        })
+    return report
+
+
+def _print_slices_text(report: dict, migrations: bool) -> None:
+    for row in report["requests"]:
+        mig = row["migration"]
+        line = (f"{row['namespace']}/{row['name']}: {row['phase']}"
+                f", chips {row['chips']}"
+                f", nodes {len(row['nodes'])}")
+        if not row["elastic"]:
+            line += ", elastic off"
+        if row["migrations"]:
+            line += f", migrations {row['migrations']}"
+        if mig["phase"]:
+            line += f", migration {mig['phase']}"
+        print(line)
+        if migrations and (mig["phase"] or mig["intent"]):
+            if mig["intent"]:
+                print(f"  intent: {mig['intent']}"
+                      + (f" (deadline {mig['deadline']})"
+                         if mig["deadline"] else ""))
+            if mig["ackedStep"] is not None:
+                print(f"  acked step: {mig['ackedStep']}")
+            if mig["restoredStep"] is not None:
+                print(f"  restored step: {mig['restoredStep']}")
+            if mig["from"] or mig["to"]:
+                print(f"  move: {', '.join(mig['from']) or '-'}"
+                      f" -> {', '.join(mig['to']) or '-'}")
+            if mig["reason"]:
+                print(f"  reason: {mig['reason']}")
+    print(f"requests: {len(report['requests'])}, completed migrations: "
+          f"{report['migrationsTotal']}")
+
+
+def _slices(args) -> int:
+    """SliceRequest fleet view: placement phase + binding per request,
+    and with ``--migrations`` the live elastic handshake (intent,
+    deadline, acked/restored steps, old->new binding, abort reason).
+    Exit 0 whenever the listing succeeds — an in-flight migration is a
+    normal state, not a failure."""
+    from ..runtime.kubeclient import HTTPClient, KubeConfig
+
+    as_json = getattr(args, "output", "text") == "json"
+
+    def fail_json(e: Exception) -> int:
+        print(json.dumps({"requests": [],
+                          "error": f"{type(e).__name__}: {e}"},
+                         indent=2, sort_keys=True))
+        return 1
+
+    try:
+        client = HTTPClient(KubeConfig.load())
+    except Exception as e:
+        print(f"cannot reach the cluster: {e}", file=sys.stderr)
+        return fail_json(e) if as_json else 1
+
+    try:
+        report = _slices_report(client, args.namespace)
+        if as_json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+            return 0
+        if not report["requests"]:
+            print("no SliceRequests found")
+            return 0
+        _print_slices_text(report, migrations=args.migrations)
+        return 0
+    except Exception as e:
+        print(f"slices failed: {type(e).__name__}: {e}", file=sys.stderr)
         return fail_json(e) if as_json else 1
 
 
@@ -620,6 +750,21 @@ def main(argv=None) -> int:
                     help="json: the same health picture as one "
                          "machine-readable object (same exit code)")
 
+    sl = sub.add_parser(
+        "slices", help="SliceRequest fleet view: placement phase, chips, "
+                       "binding size per request; --migrations adds the "
+                       "elastic handshake (intent, deadline, acked/"
+                       "restored steps, old->new binding)")
+    sl.add_argument("-n", "--namespace", default="",
+                    help="restrict to one namespace (default: all)")
+    sl.add_argument("--migrations", action="store_true",
+                    help="show the per-request migration handshake "
+                         "detail, not just the one-line summary")
+    sl.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text",
+                    help="json: the same listing as one machine-"
+                         "readable object")
+
     u = sub.add_parser("uninstall",
                        help="delete CRs (waiting for operand teardown), "
                             "then the operator stream (pre-delete hook "
@@ -692,6 +837,8 @@ def main(argv=None) -> int:
         return _lifecycle(args)
     if args.cmd == "status":
         return _status(args)
+    if args.cmd == "slices":
+        return _slices(args)
     if args.cmd == "trace":
         return _trace(args)
     if args.cmd == "dag":

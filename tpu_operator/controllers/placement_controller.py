@@ -29,13 +29,22 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
+import time
 from typing import Iterable, Optional
 
 from ..api import labels as L
 from ..api.conditions import update_status_with_retry
 from ..api.slicerequest import (
+    INTENT_GROW,
+    INTENT_MIGRATE,
+    INTENT_SHRINK,
     KIND_SLICE_REQUEST,
+    MIG_ABORTED,
+    MIG_CHECKPOINTED,
+    MIG_MIGRATING,
+    MIG_REBOUND,
     PHASE_PENDING,
     PHASE_PLACED,
     PHASE_UNSCHEDULABLE,
@@ -70,7 +79,40 @@ from ..topology.placement import (
 
 log = logging.getLogger("tpu_operator.placement")
 
-REQUEUE_UNSCHEDULABLE_S = 30.0
+# Unschedulable requeues back off exponentially (base doubling per
+# attempt, capped) instead of the old fixed 30s: a request that will not
+# fit for hours must not re-score the fleet every 30s, while a request
+# blocked on one draining node retries quickly at first. The jitter that
+# de-synchronizes a thundering herd of Unschedulable requests is seeded
+# from (request key, attempt) — fully deterministic, so chaos verdicts
+# stay byte-identical per seed.
+REQUEUE_UNSCHEDULABLE_BASE_S = 5.0
+REQUEUE_UNSCHEDULABLE_CAP_S = 240.0
+
+# deadline for a shrink/grow resize handshake (spec edit on a Placed
+# request); past it the attempt aborts and the old binding stands
+RESIZE_TIMEOUT_S = 120.0
+REQUEUE_RESIZE_S = 5.0
+
+
+def unschedulable_backoff(key: str, attempt: int) -> float:
+    delay = min(REQUEUE_UNSCHEDULABLE_CAP_S,
+                REQUEUE_UNSCHEDULABLE_BASE_S * (2 ** min(attempt, 16)))
+    jitter = random.Random(f"requeue:{key}:{attempt}").uniform(
+        0.0, delay / 4.0)
+    return delay + jitter
+
+
+def find_replacement(client, spec: SliceRequestSpec, key: str,
+                     exclude: Iterable[str] = ()):
+    """Best candidate window for ``spec`` with the draining domain
+    carved out of the fleet entirely (its leases, capacity and adjacency
+    must not score). Returns None when nothing fits — the caller decides
+    between waiting and degrading."""
+    shut = set(exclude)
+    nodes = [n for n in client.list("v1", "Node") if name_of(n) not in shut]
+    ranked = rank_candidates(spec, FleetState(nodes), reclaim=key)
+    return ranked[0] if ranked else None
 
 
 def _env_preemption() -> bool:
@@ -105,15 +147,23 @@ class PlacementReconciler(Reconciler):
     name = "sliceplacement"
 
     def __init__(self, client, namespace: Optional[str] = None,
-                 preemption: Optional[bool] = None):
+                 preemption: Optional[bool] = None,
+                 now=time.time, resize_timeout: float = RESIZE_TIMEOUT_S):
         self.client = client
         self.namespace = namespace or os.environ.get(
             "OPERATOR_NAMESPACE", "tpu-operator")
         self.preemption = (_env_preemption() if preemption is None
                            else preemption)
+        self.now = now
+        self.resize_timeout = resize_timeout
         # place-and-bind is read-rank-annotate: serialized so N workers
         # placing different requests can't both observe a node as free
         self._bind_lock = threading.Lock()
+        # Unschedulable backoff attempt per request key; reset on any
+        # successful placement or deletion. In-memory by design: a
+        # controller restart restarting the schedule from the fast end
+        # is the safe direction.
+        self._unsched_attempts = {}
 
     # -- wiring ------------------------------------------------------------
 
@@ -156,6 +206,7 @@ class PlacementReconciler(Reconciler):
             request.namespace or None)
         if live is None:
             # request deleted: return its nodes to the pool
+            self._unsched_attempts.pop(key, None)
             if self._release_leases(key):
                 OPERATOR_METRICS.placement_decisions.labels(
                     outcome="released").inc()
@@ -167,10 +218,41 @@ class PlacementReconciler(Reconciler):
         if phase == PHASE_PLACED:
             broken = self._binding_broken(cr, spec, key)
             if broken is None:
-                self._export_gauges(self.client.list("v1", "Node"))
-                return Result()
+                self._unsched_attempts.pop(key, None)
+                nodes = self.client.list("v1", "Node")
+                # heal orphan self-leases: a crash between a migration's
+                # status write and its old-lease release strands leases
+                # on nodes outside the (new) binding
+                bound = set(get_nested(cr, "status", "nodes",
+                                       default=[]) or [])
+                for node in nodes:
+                    n = name_of(node)
+                    if (annotations_of(node).get(L.PLACED_BY) == key
+                            and n not in bound):
+                        self.client.patch(
+                            "v1", "Node", n,
+                            {"metadata": {"annotations": {
+                                L.PLACED_BY: None}}})
+                res = self._reap_expired_migration(cr, live)
+                if res is None:
+                    res = self._maybe_resize(cr, live, spec, key)
+                self._export_gauges(nodes)
+                return res if res is not None else Result()
             # explicit drain event: the ONLY path off a placement
             self._release_leases(key)
+            from .slices import clear_intent, migration_of
+            mig = migration_of(cr)
+            if mig.get("phase") in (MIG_MIGRATING, MIG_CHECKPOINTED,
+                                    MIG_REBOUND):
+                # an eviction supersedes any in-flight handshake; the
+                # workload restores from its last durable checkpoint on
+                # the replacement binding, so no ACKED work is lost
+                mig["phase"] = MIG_ABORTED
+                mig["reason"] = f"evicted: {broken}"
+                set_nested(cr, mig, "status", "migration")
+                clear_intent(self.client, cr)
+                OPERATOR_METRICS.slice_migrations.labels(
+                    outcome="aborted").inc()
             set_nested(cr, PHASE_PENDING, "status", "phase")
             set_nested(cr, [], "status", "nodes")
             set_nested(cr, int(get_nested(cr, "status", "evictions",
@@ -214,7 +296,11 @@ class PlacementReconciler(Reconciler):
                 OPERATOR_METRICS.placement_latency.observe(
                     _time.perf_counter() - t0)
                 self._export_gauges(nodes)
-                return Result(requeue_after=REQUEUE_UNSCHEDULABLE_S)
+                attempt = self._unsched_attempts.get(key, 0)
+                self._unsched_attempts[key] = attempt + 1
+                OPERATOR_METRICS.placement_requeues.inc()
+                return Result(
+                    requeue_after=unschedulable_backoff(key, attempt))
 
             best = ranked[0]
             # drop any stale self-leases outside the chosen window, then
@@ -239,8 +325,10 @@ class PlacementReconciler(Reconciler):
             set_nested(cr, best.pool, "status", "pool")
             set_nested(cr, best.slice_id, "status", "sliceId")
             set_nested(cr, f"{best.score:.6f}", "status", "score")
+            set_nested(cr, spec.chips_needed(), "status", "chips")
             pop_nested(cr, "status", "reason")
             update_status_with_retry(self.client, cr, live=live)
+            self._unsched_attempts.pop(key, None)
         OPERATOR_METRICS.placement_decisions.labels(outcome="placed").inc()
         OPERATOR_METRICS.placement_latency.observe(
             _time.perf_counter() - t0)
@@ -250,6 +338,101 @@ class PlacementReconciler(Reconciler):
         return Result()
 
     # -- helpers -----------------------------------------------------------
+
+    def _reap_expired_migration(self, cr: dict,
+                                live: dict) -> Optional[Result]:
+        """Janitor for a migrate handshake nobody will finish: the
+        migrator aborts expired attempts itself while its unit sits in
+        the migrate stage, but an operator crash (or a unit forced past
+        the stage) can leave the intent open forever. An expired,
+        still-mid-phase migrate intent on a sound binding degrades to
+        Aborted here, exactly as the migrator would have."""
+        from .slices import abort_migration, migration_of
+
+        mig = migration_of(cr)
+        if mig.get("intent") != INTENT_MIGRATE \
+                or mig.get("phase") not in (MIG_MIGRATING, MIG_CHECKPOINTED):
+            return None
+        try:
+            raw = annotations_of(cr).get(L.SLICE_INTENT_DEADLINE) \
+                or mig.get("deadline")
+            deadline = float(raw) if raw is not None else 0.0
+        except (TypeError, ValueError):
+            deadline = 0.0
+        if self.now() <= deadline:
+            return None
+        abort_migration(self.client, cr, live,
+                        "migration deadline exceeded; hard drain",
+                        outcome="timeout")
+        return Result()
+
+    def _maybe_resize(self, cr: dict, live: dict, spec: SliceRequestSpec,
+                      key: str) -> Optional[Result]:
+        """Shrink/grow handshake for a sound Placed binding whose spec
+        size diverged from the bound size. One attempt per spec
+        generation: a timed-out resize parks as Aborted until the spec
+        changes again, so a non-elastic workload quiesces instead of
+        re-posting intents forever."""
+        from .slices import (
+            abort_migration,
+            migration_of,
+            post_intent,
+            rebind_request,
+        )
+
+        bound_chips = get_nested(cr, "status", "chips", default=None)
+        if bound_chips is None:
+            # binding predates elastic slices: adopt its current size
+            set_nested(cr, spec.chips_needed(), "status", "chips")
+            update_status_with_retry(self.client, cr, live=live)
+            return None
+        need = spec.chips_needed()
+        mig = migration_of(cr)
+        phase = mig.get("phase", "")
+        gen = int(get_nested(cr, "metadata", "generation",
+                             default=0) or 0)
+        resizing = (mig.get("intent") in (INTENT_SHRINK, INTENT_GROW)
+                    and phase in (MIG_MIGRATING, MIG_CHECKPOINTED))
+        if need == int(bound_chips):
+            if resizing:
+                # spec reverted mid-handshake: retire the attempt
+                abort_migration(self.client, cr, live,
+                                "superseded: spec reverted to bound size",
+                                outcome="aborted",
+                                extra={"forGeneration": gen})
+            return None
+        if phase == MIG_ABORTED and int(
+                mig.get("forGeneration", -1) or -1) == gen:
+            return None  # this generation already had its attempt
+        if resizing:
+            if phase == MIG_CHECKPOINTED:
+                # acked: move the binding; its own nodes may be reused
+                # (a shrink usually lands inside the old window)
+                nodes = [n for n in self.client.list("v1", "Node")]
+                ranked = rank_candidates(spec, FleetState(nodes),
+                                         reclaim=key)
+                if ranked:
+                    rebind_request(self.client, cr, live, spec, ranked[0],
+                                   self.now(), outcome="resized")
+                    return Result()
+            if self.now() > float(mig.get("deadline") or 0):
+                abort_migration(self.client, cr, live,
+                                "resize deadline exceeded; binding kept",
+                                outcome="timeout",
+                                extra={"forGeneration": gen})
+                return Result()
+            return Result(requeue_after=REQUEUE_RESIZE_S)
+        if annotations_of(cr).get(L.SLICE_ELASTIC) == "false":
+            abort_migration(self.client, cr, live,
+                            "workload is not elastic; binding kept",
+                            outcome="aborted",
+                            extra={"forGeneration": gen})
+            return None
+        intent = INTENT_SHRINK if need < int(bound_chips) else INTENT_GROW
+        post_intent(self.client, cr, live, intent,
+                    self.now() + self.resize_timeout, self.now(),
+                    extra={"forGeneration": gen})
+        return Result(requeue_after=REQUEUE_RESIZE_S)
 
     def _binding_broken(self, cr: dict, spec: SliceRequestSpec,
                         key: str) -> Optional[str]:

@@ -28,21 +28,50 @@ already the per-state status, and one row per node would bloat
 
 from __future__ import annotations
 
-from typing import List, Optional
+import logging
+import time
+from typing import Callable, Iterable, List, Optional
 
 from ..api import labels as L
+from ..api.conditions import update_status_with_retry
+from ..api.slicerequest import (
+    INTENT_MIGRATE,
+    KIND_SLICE_REQUEST,
+    MIG_ABORTED,
+    MIG_CHECKPOINTED,
+    MIG_MIGRATING,
+    MIG_REBOUND,
+    MIG_RESUMED,
+    MIG_TERMINAL,
+    PHASE_PLACED,
+    V1ALPHA1,
+    SliceRequestSpec,
+)
+from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime.client import Client, ListOptions
-from ..runtime.objects import get_nested, labels_of, name_of, pod_ready
+from ..runtime.objects import (
+    annotations_of,
+    get_nested,
+    labels_of,
+    name_of,
+    namespace_of,
+    pod_ready,
+    set_nested,
+    thaw_obj,
+)
 from ..state.nodepool import get_node_pools, slices_of
+
+log = logging.getLogger("tpu_operator.slices")
 
 MAX_ROWS = 100  # status-size bound; rows are sorted, so truncation is stable
 
 # upgrade-state severity for the per-slice aggregate: the row shows the
 # most in-need-of-attention member state (failed dominates; done only
 # when every labeled member is done)
-_SEVERITY = ("failed", "drain-required", "cordon-required",
-             "pod-restart-required", "validation-required",
-             "uncordon-required", "upgrade-required", "done")
+_SEVERITY = ("failed", "drain-required", "migrate-required",
+             "cordon-required", "pod-restart-required",
+             "validation-required", "uncordon-required",
+             "upgrade-required", "done")
 
 
 def _aggregate_upgrade_state(states: List[str]) -> str:
@@ -105,3 +134,220 @@ def slice_status(client: Client, namespace: str,
             })
     rows.sort(key=lambda r: r["id"])
     return rows
+
+
+# --- elastic-slice protocol (slice-intent contract) ------------------------
+#
+# The handshake, driven from both ends:
+#
+#   operator                        workload shim (workloads/elastic.py)
+#   --------                        ------------------------------------
+#   post intent annotation +        sees intent, checkpoints at the next
+#   status.migration Migrating ->   step boundary, acks the durable step
+#                                   (annotation + status Checkpointed) ->
+#   leases replacement window,
+#   rewrites the binding
+#   (status Rebound) ->             restores the acked step on the new
+#                                   topology (status Resumed)
+#
+# Past the deadline the operator aborts the attempt (status Aborted,
+# outcome=timeout) and degrades to the pre-elastic hard drain — the
+# workload loses only un-ACKED steps, never acknowledged ones. All
+# timestamps flow through an injectable clock so the chaos plane drives
+# the whole protocol off its virtual clock and verdicts stay
+# byte-identical per seed.
+
+
+def request_key(cr: dict) -> str:
+    return f"{namespace_of(cr) or 'default'}/{name_of(cr)}"
+
+
+def migration_of(cr: dict) -> dict:
+    return dict(get_nested(cr, "status", "migration", default={}) or {})
+
+
+def _fmt_ts(ts: float) -> str:
+    return f"{float(ts):.3f}"
+
+
+def placed_requests_on(client: Client, node_names: Iterable[str]) -> List[dict]:
+    """Placed SliceRequests whose binding intersects ``node_names``,
+    sorted by key for deterministic processing order."""
+    wanted = set(node_names)
+    out = []
+    for cr in client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+        if get_nested(cr, "status", "phase") != PHASE_PLACED:
+            continue
+        bound = get_nested(cr, "status", "nodes", default=[]) or []
+        if wanted.intersection(bound):
+            out.append(cr)
+    out.sort(key=request_key)
+    return out
+
+
+def clear_intent(client: Client, cr: dict) -> None:
+    client.patch(
+        V1ALPHA1, KIND_SLICE_REQUEST, name_of(cr),
+        {"metadata": {"annotations": {L.SLICE_INTENT: None,
+                                      L.SLICE_INTENT_DEADLINE: None,
+                                      L.SLICE_INTENT_ACK: None}}},
+        namespace=namespace_of(cr))
+
+
+def post_intent(client: Client, cr: dict, live: dict, intent: str,
+                deadline: float, now: float,
+                extra: Optional[dict] = None) -> None:
+    """Open a migration attempt: intent annotations first (the workload's
+    trigger), then status.migration (the observable phase)."""
+    key = request_key(cr)
+    client.patch(
+        V1ALPHA1, KIND_SLICE_REQUEST, name_of(cr),
+        {"metadata": {"annotations": {
+            L.SLICE_INTENT: intent,
+            L.SLICE_INTENT_DEADLINE: _fmt_ts(deadline),
+            L.SLICE_INTENT_ACK: None}}},
+        namespace=namespace_of(cr))
+    mig = {
+        "phase": MIG_MIGRATING,
+        "intent": intent,
+        "deadline": _fmt_ts(deadline),
+        "startedAt": _fmt_ts(now),
+        "from": sorted(get_nested(cr, "status", "nodes", default=[]) or []),
+    }
+    mig.update(extra or {})
+    set_nested(cr, mig, "status", "migration")
+    update_status_with_retry(client, cr, live=live)
+    log.info("posted %s intent on %s (deadline %s)", intent, key,
+             _fmt_ts(deadline))
+
+
+def abort_migration(client: Client, cr: dict, live: dict, reason: str,
+                    outcome: str, extra: Optional[dict] = None) -> None:
+    """Retire the current attempt; the hard-drain (or the unchanged
+    binding, for a resize) is the degradation the caller falls back to.
+    Intent annotations are kept so the attempt stays idempotent within
+    its deadline window — a fresh attempt posts a fresh deadline."""
+    mig = migration_of(cr)
+    mig["phase"] = MIG_ABORTED
+    mig["reason"] = reason
+    mig.update(extra or {})
+    mig.pop("to", None)
+    set_nested(cr, mig, "status", "migration")
+    update_status_with_retry(client, cr, live=live)
+    OPERATOR_METRICS.slice_migrations.labels(outcome=outcome).inc()
+    log.warning("migration of %s aborted (%s): %s",
+                request_key(cr), outcome, reason)
+
+
+def rebind_request(client: Client, cr: dict, live: dict,
+                   spec: SliceRequestSpec, candidate, now: float,
+                   outcome: str) -> None:
+    """Move a Placed binding onto ``candidate``'s window: lease the new
+    nodes BEFORE publishing status (placement-sound, same order as the
+    initial bind), then release the leases left behind. A crash between
+    status and release leaves orphan self-leases, which the placement
+    controller's Placed-sound sweep reclaims."""
+    key = request_key(cr)
+    old = set(get_nested(cr, "status", "nodes", default=[]) or [])
+    new = set(candidate.nodes)
+    for n in sorted(new):
+        client.patch("v1", "Node", n,
+                     {"metadata": {"annotations": {L.PLACED_BY: key}}})
+    mig = migration_of(cr)
+    mig["phase"] = MIG_REBOUND
+    mig["to"] = sorted(new)
+    mig.pop("reason", None)
+    set_nested(cr, mig, "status", "migration")
+    set_nested(cr, sorted(new), "status", "nodes")
+    set_nested(cr, candidate.pool, "status", "pool")
+    set_nested(cr, candidate.slice_id, "status", "sliceId")
+    set_nested(cr, f"{candidate.score:.6f}", "status", "score")
+    set_nested(cr, spec.chips_needed(), "status", "chips")
+    set_nested(cr, int(get_nested(cr, "status", "migrations",
+                                  default=0) or 0) + 1,
+               "status", "migrations")
+    update_status_with_retry(client, cr, live=live)
+    for n in sorted(old - new):
+        node = client.get_or_none("v1", "Node", n)
+        if node is not None and annotations_of(node).get(L.PLACED_BY) == key:
+            client.patch("v1", "Node", n,
+                         {"metadata": {"annotations": {L.PLACED_BY: None}}})
+    clear_intent(client, cr)
+    OPERATOR_METRICS.slice_migrations.labels(outcome=outcome).inc()
+    started = mig.get("startedAt")
+    if started:
+        OPERATOR_METRICS.slice_migration_duration.observe(
+            max(0.0, now - float(started)))
+    log.info("request %s rebound %s -> %s (%s)", key,
+             sorted(old), sorted(new), outcome)
+
+
+class SliceMigrator:
+    """Drives the migrate half of the protocol for the upgrade FSM.
+
+    Stateless across passes — every decision is recomputed from the
+    cluster, so a controller restart mid-handshake resumes where the
+    annotations/status say it left off. ``ready_to_drain`` returns True
+    only when every placed request on the unit has either rebound onto
+    replacement capacity or exhausted its deadline (hard-drain
+    degradation)."""
+
+    def __init__(self, client: Client, now: Callable[[], float] = time.time):
+        self.client = client
+        self.now = now
+
+    def ready_to_drain(self, unit_nodes: List[str], deadline: float) -> bool:
+        ready = True
+        for live in placed_requests_on(self.client, unit_nodes):
+            if not self._advance_one(live, unit_nodes, deadline):
+                ready = False
+        return ready
+
+    def _advance_one(self, live: dict, unit_nodes: List[str],
+                     deadline: float) -> bool:
+        cr = thaw_obj(live)
+        key = request_key(cr)
+        anns = annotations_of(cr)
+        phase = migration_of(cr).get("phase", "")
+        intent = anns.get(L.SLICE_INTENT)
+        try:
+            raw = anns.get(L.SLICE_INTENT_DEADLINE)
+            ann_deadline = float(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            ann_deadline = None
+        live_attempt = (intent is not None and ann_deadline is not None
+                        and self.now() <= ann_deadline)
+        if not live_attempt:
+            # an expired attempt still mid-phase degrades to the hard
+            # drain right now; otherwise open a fresh attempt for THIS
+            # drain (unless the workload opted out of the handshake, or
+            # our own window is already gone)
+            if intent is not None and phase not in MIG_TERMINAL:
+                abort_migration(self.client, cr, live,
+                                "migration deadline exceeded; hard drain",
+                                outcome="timeout")
+                return True
+            if anns.get(L.SLICE_ELASTIC) == "false":
+                return True
+            if self.now() > deadline:
+                return True
+            post_intent(self.client, cr, live, INTENT_MIGRATE,
+                        deadline, self.now())
+            return False
+        # an attempt is live — ours, a sibling upgrade unit's (a request
+        # spanning two draining units), or a concurrent resize. The SAME
+        # phase machine drives all of them off the ANNOTATION's deadline,
+        # so two units sharing a request never ping-pong reposts
+        if phase in (MIG_REBOUND, MIG_RESUMED, MIG_ABORTED):
+            return True
+        if phase == MIG_CHECKPOINTED:
+            from .placement_controller import find_replacement
+
+            spec = SliceRequestSpec.from_obj(cr)
+            cand = find_replacement(self.client, spec, key,
+                                    exclude=unit_nodes)
+            if cand is not None:
+                rebind_request(self.client, cr, live, spec, cand,
+                               self.now(), outcome="migrated")
+                return True
+        return False

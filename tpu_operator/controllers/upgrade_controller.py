@@ -65,6 +65,11 @@ REQUEUE_ACTIVE_S = 5.0
 STATE_DONE = "done"
 STATE_UPGRADE_REQUIRED = "upgrade-required"
 STATE_CORDON = "cordon-required"
+# elastic-slice stage between cordon and drain: placed slices on the
+# unit get the slice-intent handshake (checkpoint → rebind onto
+# replacement capacity) before their pods are evicted; past
+# migrationTimeoutSeconds the unit degrades to the plain hard drain
+STATE_MIGRATE = "migrate-required"
 STATE_DRAIN = "drain-required"
 STATE_POD_RESTART = "pod-restart-required"
 STATE_VALIDATION = "validation-required"
@@ -72,15 +77,15 @@ STATE_UNCORDON = "uncordon-required"
 STATE_FAILED = "failed"
 
 # states that count against the parallel-upgrade budget
-IN_PROGRESS_STATES = {STATE_CORDON, STATE_DRAIN, STATE_POD_RESTART,
-                      STATE_VALIDATION, STATE_UNCORDON}
+IN_PROGRESS_STATES = {STATE_CORDON, STATE_MIGRATE, STATE_DRAIN,
+                      STATE_POD_RESTART, STATE_VALIDATION, STATE_UNCORDON}
 
 # stage ordering used to heal a unit whose members diverged (a wiped
 # label, an operator restart mid-transition): the unit resumes from the
 # EARLIEST stage any member is in
-_STAGE_ORDER = [STATE_UPGRADE_REQUIRED, STATE_CORDON, STATE_DRAIN,
-                STATE_POD_RESTART, STATE_VALIDATION, STATE_UNCORDON,
-                STATE_DONE]
+_STAGE_ORDER = [STATE_UPGRADE_REQUIRED, STATE_CORDON, STATE_MIGRATE,
+                STATE_DRAIN, STATE_POD_RESTART, STATE_VALIDATION,
+                STATE_UNCORDON, STATE_DONE]
 
 
 def desired_revision(client, ds: dict) -> str:
@@ -375,6 +380,15 @@ class UpgradeReconciler(Reconciler):
             for m in members:
                 if m.state != state:
                     self._set_node_state(m.node, state)
+                    # keep the in-pass snapshot truthful: the divergence
+                    # heal can move a member BACKWARD, and the later
+                    # same-pass forward transitions compare against
+                    # m.state — a stale label would make them skip the
+                    # write and leave the unit split again
+                    refreshed = self.client.get_or_none(
+                        "v1", "Node", m.name)
+                    if refreshed is not None:
+                        m.node = refreshed
 
     def _stage_started(self, members: List[_Member]) -> Optional[float]:
         stamps = []
@@ -464,6 +478,9 @@ class UpgradeReconciler(Reconciler):
         retry_backoff = (policy.failed_retry_backoff_seconds
                          if policy.failed_retry_backoff_seconds is not None
                          else 60)
+        migration_timeout = (policy.migration_timeout_seconds
+                             if policy.migration_timeout_seconds is not None
+                             else 120)
 
         # eligible = opted-in nodes (per-node pause: the policy reconciler
         # stamps this annotation "true" on TPU nodes while autoUpgrade is
@@ -556,6 +573,15 @@ class UpgradeReconciler(Reconciler):
             state = self._unit_state(members)
             needs = any(not m.at_new_revision for m in members)
 
+            if state in IN_PROGRESS_STATES or state == STATE_UPGRADE_REQUIRED:
+                # divergence heal on EVERY pass, not only on the next
+                # transition: a member whose stage label was wiped (or
+                # that crashed ahead of its siblings) re-syncs to the
+                # unit's aggregate earliest stage even while the unit is
+                # just waiting (e.g. parked in validation). No-op — and
+                # zero writes — when the members already agree.
+                self._set_unit_state(members, state)
+
             if state == STATE_FAILED:
                 # retry with backoff: failed -> upgrade-required
                 failed_ats = []
@@ -605,8 +631,30 @@ class UpgradeReconciler(Reconciler):
                         m.node, "Normal", "DriverUpgradeStarted",
                         "Node cordoned; scheduling drain of the node")
                 self._stamp_stage(members)
-                state = STATE_DRAIN
+                state = STATE_MIGRATE
                 self._set_unit_state(members, state)
+            if state == STATE_MIGRATE:
+                proceed = True
+                if migration_timeout > 0:
+                    started = self._stage_started(members)
+                    if started is None:
+                        self._stamp_stage(members)
+                        started = self.now()
+                    from .slices import SliceMigrator
+
+                    migrator = SliceMigrator(self.client, now=self.now)
+                    proceed = migrator.ready_to_drain(
+                        [m.name for m in members],
+                        started + migration_timeout)
+                if proceed:
+                    # fresh stamp: the drain deadline must not be
+                    # pre-consumed by however long the handshake took
+                    self._stamp_stage(members)
+                    state = STATE_DRAIN
+                    self._set_unit_state(members, state)
+                else:
+                    record(members, state)
+                    continue
             if state == STATE_DRAIN:
                 remaining = 0
                 blocked: List[str] = []

@@ -184,6 +184,33 @@ class OperatorMetrics:
             "tpu_operator_fleet_chips",
             "TPU chips by generation and placement state",
             labelnames=("accelerator", "state"))
+        # elastic slices (slice-intent protocol): migration/resize
+        # attempt outcomes, intent→rebound handshake latency, how stale
+        # each workload's last durable checkpoint is, and the two
+        # robustness counters the satellite work added (Unschedulable
+        # requeue backoff fires, corrupt-checkpoint restore fallbacks)
+        self.slice_migrations = c(
+            "tpu_operator_slice_migrations_total",
+            "Elastic slice migration/resize attempts, by outcome "
+            "(migrated|resized|timeout|aborted)",
+            labelnames=("outcome",))
+        self.slice_migration_duration = h(
+            "tpu_operator_slice_migration_duration_seconds",
+            "Intent-posted to capacity-rebound latency of one "
+            "successful migration/resize handshake")
+        self.slice_checkpoint_age = g(
+            "tpu_operator_slice_checkpoint_age_seconds",
+            "Seconds since the workload on a placed slice last wrote a "
+            "durable checkpoint",
+            labelnames=("request",))
+        self.placement_requeues = c(
+            "tpu_operator_placement_requeue_total",
+            "Unschedulable SliceRequest requeues (capped exponential "
+            "backoff schedule)")
+        self.checkpoint_restore_fallbacks = c(
+            "tpu_operator_checkpoint_restore_fallbacks_total",
+            "Restores that skipped a partial/corrupt latest checkpoint "
+            "and fell back to an older retained step")
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -49,22 +49,51 @@ class TrainCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the shardings/dtypes of ``state_like`` (the freshly
         initialized state): each leaf comes back placed exactly where the
         live mesh wants it, so resume works even when the host set (and
-        hence device ordering) changed across the preemption."""
+        hence device ordering) changed across the preemption.
+
+        With no explicit ``step``, an unreadable latest checkpoint (a
+        crash can leave a torn step directory that still enumerates) falls
+        back to the previous retained step instead of failing the job —
+        each skip is logged and counted
+        (tpu_operator_checkpoint_restore_fallbacks_total). An explicit
+        ``step`` still raises: the caller asked for that step, not "the
+        newest restorable one"."""
         import orbax.checkpoint as ocp
 
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self._dir}")
         target = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if isinstance(x, jax.Array) else x,
             state_like)
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(target))
+        if step is not None:
+            return self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(target))
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        last_err: Optional[Exception] = None
+        for i, s in enumerate(candidates):
+            try:
+                return self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(target))
+            except Exception as e:  # noqa: BLE001 — any unreadable step
+                last_err = e
+                if i + 1 < len(candidates):
+                    from ..metrics.operator_metrics import OPERATOR_METRICS
+
+                    OPERATOR_METRICS.checkpoint_restore_fallbacks.inc()
+                    log.warning(
+                        "checkpoint step %s under %s is partial/corrupt "
+                        "(%s); falling back to step %s",
+                        s, self._dir, e, candidates[i + 1])
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self._dir}") from last_err
 
     def close(self) -> None:
         self._mgr.close()

@@ -1,0 +1,255 @@
+"""Workload half of the elastic-slice protocol (Tenplex-style reshard).
+
+The operator (upgrade FSM migrate stage, placement resize path) posts a
+``tpu.graft.dev/slice-intent`` annotation on the SliceRequest; this
+module is the training job's side of the handshake:
+
+    intent seen -> checkpoint at the next step boundary -> ack the
+    durable step (annotation + ``status.migration`` Checkpointed) ->
+    ... operator rebinds (Rebound) ... -> restore the acked step on the
+    new topology and report Resumed + ``restoredStep``.
+
+The ONLY thing a step may be acked on is a *finalized* checkpoint —
+orbax's finalize-rename atomicity means a crash mid-save leaves a
+partial step that was never acked, so restoring an older retained step
+(TrainCheckpointer's corrupt-latest fallback) can never violate the
+no-acked-work-lost invariant.
+
+Two bindings of the same state machine live here:
+
+- ``MemoryCheckpointStore`` + ``ElasticWorkload``: deterministic
+  in-process store + shim used by the chaos runner and the migration
+  bench — no jax, all time through an injectable clock, so seeded runs
+  produce byte-identical verdicts.
+- ``OrbaxCheckpointStore``: the same store interface over
+  ``TrainCheckpointer`` for real multi-host jobs (jax imported lazily).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..api import labels as L
+from ..api.conditions import update_status_with_retry
+from ..api.slicerequest import (
+    KIND_SLICE_REQUEST,
+    MIG_CHECKPOINTED,
+    MIG_MIGRATING,
+    MIG_REBOUND,
+    MIG_RESUMED,
+    V1ALPHA1,
+)
+from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime.objects import (
+    annotations_of,
+    get_nested,
+    name_of,
+    namespace_of,
+    set_nested,
+    thaw_obj,
+)
+
+log = logging.getLogger("tpu_operator.elastic")
+
+
+class MemoryCheckpointStore:
+    """Deterministic stand-in for the orbax CheckpointManager: finalized
+    saves are durable, a ``partial=True`` save models a crash mid-write
+    (enumerates like a real torn step directory, fails restore), and
+    restore falls back past partial steps exactly like
+    ``TrainCheckpointer.restore`` does."""
+
+    def __init__(self, max_to_keep: int = 3):
+        self.max_to_keep = max_to_keep
+        self._steps: Dict[int, dict] = {}
+
+    def save(self, step: int, payload: Any = None,
+             partial: bool = False) -> None:
+        step = int(step)
+        if partial and step in self._steps \
+                and not self._steps[step]["partial"]:
+            # finalize-rename atomicity: a torn write can never replace
+            # an already-finalized step directory
+            return
+        self._steps[step] = {"partial": bool(partial),
+                             "payload": payload}
+        finalized = sorted(s for s, rec in self._steps.items()
+                           if not rec["partial"])
+        for stale in finalized[:-self.max_to_keep]:
+            del self._steps[stale]
+
+    def all_steps(self) -> list:
+        return sorted(self._steps)
+
+    def latest_step(self) -> Optional[int]:
+        finalized = [s for s, rec in self._steps.items()
+                     if not rec["partial"]]
+        return max(finalized) if finalized else None
+
+    def restore(self) -> Tuple[int, Any]:
+        """(step, payload) of the newest restorable checkpoint, skipping
+        partial steps with the same fallback accounting as the orbax
+        path. Raises FileNotFoundError when nothing restorable exists."""
+        for step in sorted(self._steps, reverse=True):
+            rec = self._steps[step]
+            if rec["partial"]:
+                OPERATOR_METRICS.checkpoint_restore_fallbacks.inc()
+                log.warning("skipping partial checkpoint step %s", step)
+                continue
+            return step, rec["payload"]
+        raise FileNotFoundError("no restorable checkpoint")
+
+
+class OrbaxCheckpointStore:
+    """The same store interface over a real ``TrainCheckpointer``:
+    ``state_fn`` yields the live train state to persist, ``state_like_fn``
+    the freshly-initialized template restore reshards into (which is what
+    makes resume-on-a-new-topology work)."""
+
+    def __init__(self, checkpointer, state_fn: Callable[[], Any],
+                 state_like_fn: Callable[[], Any]):
+        self._ckpt = checkpointer
+        self._state_fn = state_fn
+        self._state_like_fn = state_like_fn
+
+    def save(self, step: int, payload: Any = None,
+             partial: bool = False) -> None:
+        self._ckpt.save(self._state_fn(), int(step), wait=not partial)
+
+    def latest_step(self) -> Optional[int]:
+        return self._ckpt.latest_step()
+
+    def restore(self) -> Tuple[int, Any]:
+        state = self._ckpt.restore(self._state_like_fn())
+        step = None
+        if isinstance(state, dict):
+            step = state.get("step")
+        step = int(step) if step is not None else int(
+            self._ckpt.latest_step() or 0)
+        return step, state
+
+
+class ElasticWorkload:
+    """One training job speaking the slice-intent protocol for one
+    SliceRequest. ``tick()`` is one scheduling quantum: the chaos runner
+    (and the migration bench) call it once per virtual step, a real
+    deployment would call it from the training loop's step callback.
+
+    All cluster interaction goes through the request's status/annotations
+    — the shim holds no protocol state a restart could lose; its only
+    private state (the in-memory step counter) is exactly the work a
+    crash is ALLOWED to lose, back to the last durable checkpoint.
+    """
+
+    def __init__(self, client, name: str, namespace: str = "default",
+                 clock: Callable[[], float] = None,
+                 store: Optional[MemoryCheckpointStore] = None,
+                 checkpoint_every: int = 6, steps_per_tick: int = 3):
+        import time
+
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.clock = clock or time.time
+        self.store = store if store is not None else MemoryCheckpointStore()
+        self.checkpoint_every = checkpoint_every
+        self.steps_per_tick = steps_per_tick
+        self.step = 0
+        self.max_acked = -1
+        self._last_saved: Optional[int] = None
+        self._last_save_at: Optional[float] = None
+        self._nodes_seen: Optional[tuple] = None
+        self._crashed = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def crash(self, partial: bool = True) -> None:
+        """Chaos hook: the job dies mid-step. ``partial`` leaves a torn
+        checkpoint at the current (never-acked) step, the artifact a
+        crash during an async save produces."""
+        if partial:
+            self.store.save(self.step, payload={"step": self.step},
+                            partial=True)
+        self._crashed = True
+
+    def _restore(self) -> int:
+        try:
+            step, _ = self.store.restore()
+        except FileNotFoundError:
+            step = 0
+        return int(step)
+
+    def _save(self, step: int) -> None:
+        self.store.save(step, payload={"step": step})
+        self._last_saved = step
+        self._last_save_at = self.clock()
+
+    def tick(self) -> None:
+        live = self.client.get_or_none(
+            V1ALPHA1, KIND_SLICE_REQUEST, self.name, self.namespace)
+        if live is None:
+            return
+        cr = thaw_obj(live)
+        nodes = tuple(get_nested(cr, "status", "nodes", default=[]) or [])
+        mig = dict(get_nested(cr, "status", "migration",
+                              default={}) or {})
+        phase = mig.get("phase", "")
+        if not nodes:
+            return  # not placed (or mid-eviction): nothing is running
+        if (self._crashed or phase == MIG_REBOUND
+                or (self._nodes_seen is not None
+                    and nodes != self._nodes_seen)):
+            # restart/reshard: restore the newest durable checkpoint on
+            # the (possibly new) topology, losing only un-acked steps
+            restored = self._restore()
+            self.step = restored
+            mig["restoredStep"] = restored
+            if phase == MIG_REBOUND:
+                mig["phase"] = MIG_RESUMED
+            set_nested(cr, mig, "status", "migration")
+            update_status_with_retry(self.client, cr, live=live)
+            log.info("workload %s restored step %d on %d node(s)",
+                     self.key, restored, len(nodes))
+            self._nodes_seen = nodes
+            self._crashed = False
+            return  # the restore consumed this quantum
+        self._nodes_seen = nodes
+
+        # one quantum of training, then the periodic checkpoint cadence
+        self.step += self.steps_per_tick
+        if self.step - (self._last_saved or 0) >= self.checkpoint_every:
+            self._save(self.step)
+
+        anns = annotations_of(cr)
+        intent = anns.get(L.SLICE_INTENT)
+        deadline = anns.get(L.SLICE_INTENT_DEADLINE)
+        if intent and phase == MIG_MIGRATING:
+            try:
+                expired = (deadline is not None
+                           and self.clock() > float(deadline))
+            except (TypeError, ValueError):
+                expired = False
+            if not expired:
+                # checkpoint at this step boundary and ack it durably;
+                # save BEFORE ack — the ack is the operator's license to
+                # tear the old binding down
+                self._save(self.step)
+                self.max_acked = max(self.max_acked, self.step)
+                self.client.patch(
+                    V1ALPHA1, KIND_SLICE_REQUEST, self.name,
+                    {"metadata": {"annotations": {
+                        L.SLICE_INTENT_ACK: str(self.step)}}},
+                    namespace=self.namespace)
+                mig["phase"] = MIG_CHECKPOINTED
+                mig["ackedStep"] = max(
+                    int(mig.get("ackedStep", -1) or -1), self.step)
+                set_nested(cr, mig, "status", "migration")
+                update_status_with_retry(self.client, cr, live=live)
+                log.info("workload %s acked %s at step %d",
+                         self.key, intent, self.step)
+        if self._last_save_at is not None:
+            OPERATOR_METRICS.slice_checkpoint_age.labels(
+                request=self.key).set(self.clock() - self._last_save_at)
