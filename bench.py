@@ -530,6 +530,48 @@ def _controlplane_doc() -> dict | None:
                 mg["slice_migration_p95_s"], 2)
         except Exception as e:
             doc["migration"] = {"error": f"{type(e).__name__}: {e}"}
+        # 10k-node fleet survivability: cache bytes/node (projected, vs
+        # the 500-node baseline), paginated relist, and per-lane queue
+        # p99 under bulk churn (its own try for the same reason as
+        # rollout's). fleet_bytes_per_node / fleet_p99_queue_ms at top
+        # level are the headline figures tests/test_bench_guard.py
+        # tracks. TPUOP_BENCH_FLEET_NODES scales it down for smoke runs;
+        # TPUOP_BENCH_SKIP_FLEET skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_FLEET"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_fleet_bench,
+                )
+
+                fl_n = int(os.environ.get(
+                    "TPUOP_BENCH_FLEET_NODES", "10000"))
+                fl = run_fleet_bench(fl_n)
+                doc["fleet"] = {
+                    "n_tpu_nodes": fl["n_tpu_nodes"],
+                    "baseline_nodes": fl["baseline_nodes"],
+                    "ready": fl["ready"],
+                    # deliberately NOT named install_to_ready_s: that key
+                    # is the 500-node install guard's figure and a 10k
+                    # install must not masquerade as its latest round
+                    "install_s": round(fl["install_to_ready_s"], 2),
+                    "steady_pass_s": round(fl["fleet_steady_pass_s"], 4),
+                    "bytes_per_node_vs_baseline": round(
+                        fl["bytes_per_node_vs_baseline"], 3),
+                    "projection_savings_ratio": round(
+                        fl["projection_savings_ratio"], 3),
+                    "relist_pages": fl["relist_pages"],
+                    "lane_p99_ms": {k: round(v, 4)
+                                    for k, v in fl["lane_p99_ms"].items()},
+                    "lane_p99_ratio": round(fl["lane_p99_ratio"], 5),
+                    "max_rss_mb": (round(fl["max_rss_mb"], 1)
+                                   if fl["max_rss_mb"] else None),
+                }
+                doc["fleet_bytes_per_node"] = round(
+                    fl["fleet_bytes_per_node"], 1)
+                doc["fleet_p99_queue_ms"] = round(
+                    fl["fleet_p99_queue_ms"], 4)
+            except Exception as e:
+                doc["fleet"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

@@ -148,6 +148,49 @@ def test_slice_migration_p95_not_regressed():
         f"{latest:.2f}s regressed >25% vs best on record ({best:.2f}s)")
 
 
+def test_fleet_p99_queue_not_regressed():
+    """Same contract again, for the fleet bench's health-lane p99 queue
+    time under bulk churn (benchmarks.controlplane.run_fleet_bench): the
+    latest round's fleet_p99_queue_ms may be at most 25% above the best
+    on record. Skips until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "fleet_p99_queue_ms")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records fleet_p99_queue_ms yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} fleet_p99_queue_ms={latest:.4f}ms "
+        f"regressed >25% vs best on record ({best:.4f}ms)")
+
+
+def test_fleet_bytes_per_node_not_regressed():
+    """Same contract again, for the fleet bench's projected cache bytes
+    per node at 10k nodes (the O(fleet)-with-small-constant claim): the
+    latest round's fleet_bytes_per_node may be at most 25% above the
+    best on record. Skips until a round carrying the key is
+    committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "fleet_bytes_per_node")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records fleet_bytes_per_node yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} fleet_bytes_per_node="
+        f"{latest:.0f}B regressed >25% vs best on record ({best:.0f}B)")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

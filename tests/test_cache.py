@@ -272,3 +272,189 @@ class TestIndexes:
     def test_unknown_index_raises(self, fake, cached):
         with pytest.raises(KeyError, match="no index"):
             cached.index("v1", "Pod", "by-zone", "z1")
+
+
+def _node(name, labels=None, images=0):
+    status = {"conditions": [{"type": "Ready", "status": "True"}],
+              "capacity": {"cpu": "8"}, "allocatable": {"cpu": "8"}}
+    if images:
+        status["images"] = [
+            {"names": [f"img-{i}@sha256:{i:064x}"], "sizeBytes": i}
+            for i in range(images)]
+        status["volumesInUse"] = [f"vol-{i}" for i in range(4)]
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {}, "status": status}
+
+
+class TestPagination:
+    def test_fake_list_pages_cover_everything_once(self, fake):
+        from tpu_operator.runtime import ListOptions
+        from tpu_operator.runtime.objects import name_of
+
+        for i in range(23):
+            fake.create(_cm(f"cm-{i:02d}", {"v": str(i)}))
+        seen, token, pages = [], None, 0
+        while True:
+            page = fake.list("v1", "ConfigMap",
+                             ListOptions(limit=10, continue_=token))
+            pages += 1
+            seen.extend(name_of(o) for o in page)
+            token = getattr(page, "continue_", None)
+            if not token:
+                break
+        assert pages == 3
+        assert seen == sorted(seen)  # obj_key order, stable across pages
+        assert sorted(seen) == [f"cm-{i:02d}" for i in range(23)]
+
+    def test_limit_at_least_collection_returns_plain_list(self, fake):
+        from tpu_operator.runtime import ListOptions
+
+        for i in range(5):
+            fake.create(_cm(f"cm-{i}", {}))
+        page = fake.list("v1", "ConfigMap", ListOptions(limit=5))
+        assert getattr(page, "continue_", None) is None
+        assert len(page) == 5
+
+    def test_chunked_relist_matches_unchunked(self, fake):
+        for i in range(12):
+            fake.add_node(f"n-{i:02d}")
+        chunked = CachedClient(fake, relist_chunk=5)
+        plain = CachedClient(fake, relist_chunk=0)
+        try:
+            fake.reset_verb_counts()
+            a = {o["metadata"]["name"] for o in chunked.list("v1", "Node")}
+            chunked.resync()  # forced heal through the paged path
+            pages = fake.reset_verb_counts().get("list", 0)
+            assert pages >= 1 + 3  # warm list + ceil(12/5) relist pages
+            b = {o["metadata"]["name"] for o in plain.list("v1", "Node")}
+            assert a == b == {f"n-{i:02d}" for i in range(12)}
+        finally:
+            chunked.close()
+            plain.close()
+
+
+class TestRelistGuard:
+    def test_reader_losing_the_race_serves_stale_not_blocks(self, fake,
+                                                            cached):
+        import threading
+        import time as _time
+
+        fake.create(_cm("a", {"v": "1"}))
+        assert cached.list("v1", "ConfigMap")  # warm the informer
+        store = cached._stores[("v1", "ConfigMap")]
+        store.needs_relist = True
+        assert store.relist_lock.acquire(blocking=False)  # healer busy
+        try:
+            done = threading.Event()
+            result = {}
+
+            def read():
+                t0 = _time.perf_counter()
+                result["objs"] = cached.list("v1", "ConfigMap")
+                result["s"] = _time.perf_counter() - t0
+                done.set()
+
+            t = threading.Thread(target=read)
+            t.start()
+            assert done.wait(2.0), "reader convoyed behind the relist"
+            t.join()
+            # served the current view immediately, no heal performed
+            assert [o["metadata"]["name"] for o in result["objs"]] == ["a"]
+            assert store.needs_relist  # still dirty: loser didn't heal
+            assert result["s"] < 0.5
+        finally:
+            store.relist_lock.release()
+        cached.list("v1", "ConfigMap")  # next reader wins the lock
+        assert not store.needs_relist  # ... and heals
+
+
+class TestProjection:
+    def test_node_projection_drops_fat_status_but_keeps_reads(self, fake,
+                                                              cached):
+        fake.create(_node("fat", images=30))
+        got = cached.get("v1", "Node", "fat")
+        # the health-relevant fields survive ...
+        assert got["status"]["conditions"][0]["type"] == "Ready"
+        assert got["status"]["capacity"] == {"cpu": "8"}
+        # ... the kubelet image/volume payload does not
+        assert "images" not in got["status"]
+        assert "volumesInUse" not in got["status"]
+        stats = cached.cache_stats()["kinds"]["v1/Node"]
+        assert stats["projected"]
+        assert 0 < stats["bytes"] < stats["full_bytes"]
+
+    def test_projection_gate_off_stores_full_objects(self, fake):
+        from tpu_operator.runtime.cache import PROJECTION_GATE
+
+        prev = PROJECTION_GATE.enabled
+        PROJECTION_GATE.enabled = False
+        try:
+            cc = CachedClient(fake)
+            fake.create(_node("fat", images=30))
+            got = cc.get("v1", "Node", "fat")
+            assert len(got["status"]["images"]) == 30  # nothing dropped
+            stats = cc.cache_stats()
+            assert not stats["projection_enabled"]
+            assert not stats["kinds"]["v1/Node"]["projected"]
+            cc.close()
+        finally:
+            PROJECTION_GATE.enabled = prev
+
+    def test_bytes_accounting_returns_to_zero_on_delete(self, fake,
+                                                        cached):
+        fake.create(_node("n1", images=10))
+        fake.create(_node("n2", images=10))
+        cached.list("v1", "Node")
+        stats = cached.cache_stats()["kinds"]["v1/Node"]
+        assert stats["objects"] == 2 and stats["bytes"] > 0
+        fake.delete("v1", "Node", "n1")
+        fake.delete("v1", "Node", "n2")
+        cached.list("v1", "Node")
+        stats = cached.cache_stats()["kinds"]["v1/Node"]
+        assert stats["objects"] == 0
+        assert stats["bytes"] == 0 and stats["full_bytes"] == 0
+
+
+class TestCacheCLI:
+    """``tpuop-cfg cache`` renders a /debug/cache snapshot (or a saved
+    cache.json) — the same CLI surface test_tracing.py pins for
+    ``tpuop-cfg trace``."""
+
+    def _stats(self, fake):
+        cc = CachedClient(fake)
+        fake.create(_node("fat-0", images=20))
+        fake.create(_node("fat-1", images=20))
+        cc.list("v1", "Node")
+        stats = cc.cache_stats()
+        cc.close()
+        return stats
+
+    def test_render_shows_projected_vs_full_bytes(self, fake):
+        from tpu_operator.cli.tpuop_cfg import render_cache_stats
+
+        out = render_cache_stats(self._stats(fake))
+        lines = out.splitlines()
+        assert lines[0].startswith("projection: on")
+        node_line = next(l for l in lines if l.startswith("v1/Node:"))
+        assert "2 objects" in node_line
+        assert "projected (" in node_line and "full)" in node_line
+
+    def test_cli_reads_file_and_json_roundtrips(self, tmp_path, capsys,
+                                                fake):
+        import json
+
+        from tpu_operator.cli.tpuop_cfg import main
+
+        stats = self._stats(fake)
+        f = tmp_path / "cache.json"
+        f.write_text(json.dumps(stats))
+        rc = main(["cache", "-f", str(f)])
+        assert rc == 0
+        assert "v1/Node" in capsys.readouterr().out
+        rc = main(["cache", "-f", str(f), "-o", "json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == stats
+        rc = main(["cache", "-f", str(tmp_path / "missing.json")])
+        assert rc == 1
+        assert "cannot read" in capsys.readouterr().err

@@ -148,3 +148,104 @@ class TestFakeClientPatchBookkeeping:
             assert not rv_bumped, "no-op patch bumped resourceVersion"
             assert gen_after == gen_before
             assert events == [], "no-op patch published a watch event"
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale plane properties: rendezvous shard routing + lane discipline
+# ---------------------------------------------------------------------------
+
+_SHARD_KEYS = st.lists(
+    st.text(string.ascii_lowercase + string.digits, min_size=1, max_size=12),
+    min_size=1, max_size=60, unique=True)
+
+_LANE_NAMES = st.sampled_from(["health", "placement", "bulk"])
+
+_ADD_SEQS = st.lists(
+    st.tuples(st.integers(0, 11), _LANE_NAMES), min_size=1, max_size=80)
+
+
+class TestShardRoutingProperties:
+    @FUZZ
+    @given(_SHARD_KEYS, st.integers(2, 8))
+    def test_rehash_moves_only_the_dead_shards_keys(self, keys, shards):
+        """Rendezvous property: killing any one shard relocates exactly
+        that shard's keys; every survivor keeps its assignment. This is
+        the bound on failover churn — a modulo hash would reshuffle
+        nearly everything."""
+        from tpu_operator.runtime import shard_of
+
+        live = list(range(shards))
+        before = {k: shard_of(k, live) for k in keys}
+        for dead in range(shards):
+            survivors = [s for s in live if s != dead]
+            for k in keys:
+                after = shard_of(k, survivors)
+                if before[k] == dead:
+                    assert after in survivors
+                else:
+                    assert after == before[k]
+
+    @FUZZ
+    @given(_SHARD_KEYS, st.integers(2, 8))
+    def test_every_key_routes_to_exactly_one_live_shard(self, keys, shards):
+        from tpu_operator.runtime import shard_of
+
+        live = list(range(shards))
+        for k in keys:
+            s = shard_of(k, live)
+            assert s in live
+            assert shard_of(k, live) == s  # deterministic
+
+
+class TestLaneDisciplineProperties:
+    @FUZZ
+    @given(_ADD_SEQS)
+    def test_drain_serves_each_key_once_in_lane_priority_order(self, seq):
+        """For ANY add sequence (duplicate keys, mixed lanes — so
+        promotions happen), a full drain yields every distinct key
+        exactly once, and service order is monotone in lane rank: with
+        no adds racing the drain, a bulk item is never served while a
+        health item waits."""
+        from tpu_operator.runtime.workqueue import LANES, WorkQueue
+
+        rank = {lane: i for i, lane in enumerate(LANES)}
+        q = WorkQueue()
+        for key, lane in seq:
+            q.add(key, lane=lane)
+        served = []
+        while True:
+            item, _, lane = q.get_with_info(timeout=0)
+            if item is None:
+                break
+            served.append((item, lane))
+            q.done(item)
+        assert sorted(k for k, _ in served) == sorted({k for k, _ in seq})
+        ranks = [rank[lane] for _, lane in served]
+        assert ranks == sorted(ranks), (seq, served)
+
+    @FUZZ
+    @given(_ADD_SEQS)
+    def test_shard_failover_drain_loses_no_key(self, seq):
+        """Queued keys spread over K shard queues, one shard killed via
+        freeze + drain_pending (the Controller.kill_shard path, minus
+        threads): the union of queued keys afterwards equals the union
+        before — no key lost, none duplicated."""
+        from tpu_operator.runtime import shard_of
+        from tpu_operator.runtime.workqueue import WorkQueue
+
+        shards = 3
+        live = list(range(shards))
+        queues = {s: WorkQueue() for s in live}
+        for key, lane in seq:
+            queues[shard_of(key, live)].add(key, lane=lane)
+        before = {k for k, _ in seq}
+        dead = max(live, key=lambda s: len(queues[s]))  # busiest shard
+        queues[dead].freeze()
+        moved = queues[dead].drain_pending()
+        survivors = [s for s in live if s != dead]
+        for item, lane in moved:
+            queues[shard_of(item, survivors)].add(item, lane=lane)
+        after = set()
+        for s in survivors:
+            after |= set(queues[s].snapshot().queued)
+        assert after == before

@@ -477,3 +477,220 @@ class TestEventRecorder:
         rec.event(pod, "Normal", "R", "m")
         [evt] = c.list("v1", "Event")
         assert evt["metadata"]["namespace"] == "workloads"
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale plane: priority lanes, write budget, sharded controllers
+# ---------------------------------------------------------------------------
+
+from tpu_operator.runtime import (  # noqa: E402  (fleet-scale section)
+    LANE_BULK,
+    LANE_HEALTH,
+    LANE_PLACEMENT,
+    ThrottledWriteClient,
+    WriteBudget,
+    env_shards,
+    shard_of,
+)
+from tpu_operator.runtime.workqueue import LANE_GATE  # noqa: E402
+
+
+def drain_with_lanes(q):
+    """Pop everything, returning [(item, lane)] in service order."""
+    out = []
+    while True:
+        item, _, lane = q.get_with_info(timeout=0)
+        if item is None:
+            return out
+        out.append((item, lane))
+        q.done(item)
+
+
+class TestLanes:
+    def test_strict_priority_order(self):
+        q = WorkQueue()
+        for i in range(4):
+            q.add(("bulk", i))                      # default lane: bulk
+        q.add(("pl", 0), lane=LANE_PLACEMENT)
+        q.add(("h", 0), lane=LANE_HEALTH)
+        order = drain_with_lanes(q)
+        assert order == [
+            (("h", 0), LANE_HEALTH),
+            (("pl", 0), LANE_PLACEMENT),
+            (("bulk", 0), LANE_BULK), (("bulk", 1), LANE_BULK),
+            (("bulk", 2), LANE_BULK), (("bulk", 3), LANE_BULK),
+        ]
+
+    def test_pending_key_promoted_to_higher_lane(self):
+        q = WorkQueue()
+        for i in range(4):
+            q.add(("bulk", i))
+        # the queued key becomes urgent: it jumps the bulk backlog, and
+        # the dedup still holds (served once, not twice)
+        q.add(("bulk", 2), lane=LANE_HEALTH)
+        order = drain_with_lanes(q)
+        assert order[0] == (("bulk", 2), LANE_HEALTH)
+        assert [it for it, _ in order].count(("bulk", 2)) == 1
+        assert len(order) == 4
+
+    def test_lane_gate_off_restores_single_fifo(self):
+        prev = LANE_GATE.enabled
+        LANE_GATE.enabled = False
+        try:
+            q = WorkQueue()
+            q.add("a")
+            q.add("b", lane=LANE_HEALTH)
+            q.add("c", lane=LANE_PLACEMENT)
+            # pure arrival order: the pre-lane single-queue behavior
+            assert [it for it, _ in drain_with_lanes(q)] == ["a", "b", "c"]
+        finally:
+            LANE_GATE.enabled = prev
+
+    def test_lane_depths_counts_queued_and_delayed(self):
+        q = WorkQueue()
+        q.add("x", lane=LANE_HEALTH)
+        q.add_after("y", 30.0, lane=LANE_BULK)
+        d = q.lane_depths()
+        assert d[LANE_HEALTH] == 1 and d[LANE_BULK] == 1
+        assert len(q) == 2
+
+
+class TestRateLimiterEvictionCap:
+    def test_tracked_never_exceeds_cap(self):
+        rl = RateLimiter(max_tracked=16)
+        for i in range(200):
+            rl.when(f"key-{i}")
+        assert rl.tracked() <= 16
+        # a long-evicted key restarts at base backoff, as if forgotten
+        assert rl.when("key-0") == rl.base
+
+    def test_recency_protects_hot_keys(self):
+        rl = RateLimiter(max_tracked=4)
+        for i in range(50):
+            rl.when("hot")
+            rl.when(f"cold-{i}")
+        # the constantly-failing key never lost its backoff state to
+        # the churn of one-shot cold keys
+        assert rl.retries("hot") == 50
+
+
+class TestWriteBudget:
+    def test_unlimited_budget_is_free(self):
+        b = WriteBudget(0)
+        assert b.acquire() == 0.0
+        assert b.throttled_seconds == 0.0
+
+    def test_throttles_beyond_burst(self):
+        b = WriteBudget(qps=200.0, burst=1.0)
+        assert b.acquire() == 0.0        # the one burst token is free
+        waited = b.acquire()             # must wait for a refill
+        assert waited > 0.0
+        assert b.throttled_seconds >= waited * 0.99
+
+    def test_throttled_client_passes_writes_and_reads_through(self):
+        c = FakeClient()
+        tc = ThrottledWriteClient(c, WriteBudget(0), controller="t")
+        tc.create(make_cm("x"))
+        assert tc.get("v1", "ConfigMap", "x", "default")
+        assert len(tc.list("v1", "ConfigMap")) == 1
+        tc.delete("v1", "ConfigMap", "x", "default")
+        with pytest.raises(NotFoundError):
+            c.get("v1", "ConfigMap", "x", "default")
+
+
+class TestSharding:
+    def test_env_shards_default_and_parse(self):
+        assert env_shards(env={}) == 1
+        assert env_shards(env={"OPERATOR_SHARDS": "4"}) == 4
+        assert env_shards(env={"OPERATOR_SHARDS": "junk"}) == 1
+        assert env_shards(env={"OPERATOR_SHARDS": "-2"}) == 1
+
+    def test_rendezvous_only_moves_dead_shards_keys(self):
+        live = [0, 1, 2, 3]
+        keys = [f"req-{i}" for i in range(300)]
+        before = {k: shard_of(k, live) for k in keys}
+        assert set(before.values()) == {0, 1, 2, 3}  # all shards used
+        survivors = [0, 1, 3]
+        for k in keys:
+            after = shard_of(k, survivors)
+            if before[k] != 2:
+                # rendezvous stability: a surviving shard keeps its keys
+                assert after == before[k], k
+            else:
+                assert after in survivors, k
+
+    def test_kill_shard_loses_no_queued_keys(self):
+        ctrl = Controller("t", CountingReconciler(FakeClient()),
+                          FakeClient(), shards=4)
+        reqs = {Request(name=f"r{i}") for i in range(60)}
+        for r in reqs:
+            ctrl.enqueue(r)
+        # kill a shard that actually holds keys (workers never started,
+        # so everything is still queued)
+        victim = max((s for s in ctrl.live_shards()[1:]),
+                     key=lambda s: len(ctrl.queues[s]))
+        moved = ctrl.kill_shard(victim)
+        assert moved > 0
+        assert not ctrl.queues[victim].snapshot().queued  # fully drained
+        queued = set()
+        for s in ctrl.live_shards():
+            queued |= set(ctrl.queues[s].snapshot().queued)
+        assert queued == reqs  # every key survived the failover
+        assert victim not in ctrl.live_shards()
+
+    def test_same_key_never_reconciled_concurrently_across_shards(self):
+        # property-style, threaded: hammer a handful of keys through a
+        # 3-shard x 2-worker controller, kill a shard mid-storm, and
+        # assert no key ever had two reconciles in flight at once
+        class Track(Reconciler):
+            name = "track"
+
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.inflight = {}
+                self.max_concurrency = 0
+                self.total = 0
+
+            def reconcile(self, request):
+                k = str(request)
+                with self.lock:
+                    n = self.inflight.get(k, 0) + 1
+                    self.inflight[k] = n
+                    self.max_concurrency = max(self.max_concurrency, n)
+                    self.total += 1
+                time.sleep(0.001)
+                with self.lock:
+                    self.inflight[k] -= 1
+                return Result()
+
+        rec = Track()
+        ctrl = Controller("t", rec, FakeClient(), workers=2, shards=3)
+        ctrl.start()
+        try:
+            keys = [Request(name=f"k{i}") for i in range(5)]
+
+            def storm():
+                for n in range(80):
+                    ctrl.enqueue(keys[n % len(keys)])
+                    if n % 16 == 0:
+                        time.sleep(0.002)
+
+            producers = [threading.Thread(target=storm) for _ in range(2)]
+            for t in producers:
+                t.start()
+            time.sleep(0.01)
+            ctrl.kill_shard(ctrl.live_shards()[-1])  # failover mid-storm
+            for t in producers:
+                t.join()
+            assert ctrl.wait_idle(timeout=10.0)
+        finally:
+            ctrl.stop()
+        assert rec.total > 0
+        assert rec.max_concurrency == 1, (
+            f"key reconciled concurrently (max={rec.max_concurrency})")
+
+    def test_single_shard_cannot_be_killed(self):
+        ctrl = Controller("t", CountingReconciler(FakeClient()),
+                          FakeClient(), shards=1)
+        with pytest.raises(ValueError):
+            ctrl.kill_shard(0)

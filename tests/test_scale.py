@@ -317,3 +317,37 @@ class TestTracerOverhead:
             f"tracing overhead blew the 5% budget: median delta "
             f"{overhead * 1000:.3f}ms on a {floor * 1000:.3f}ms pass "
             f"(diffs ms: {[round(d * 1000, 2) for d in diffs]})")
+
+
+class TestFleetBench:
+    """run_fleet_bench: the 10k-node survivability figures. The full 10k
+    run is slow-tier; a scaled-down pass rides tier-1 so the bench code
+    itself can't rot between slow runs. The assertions are the
+    acceptance bars, not measured-minus-epsilon budgets: bytes/node flat
+    vs the small baseline, projection non-trivial on realistic node
+    payloads, relists paginated, health-lane p99 <= 1/10 bulk p99."""
+
+    @staticmethod
+    def _check(r, min_relist_pages):
+        assert r["ready"], r
+        assert r["bytes_per_node_vs_baseline"] <= 1.25, r
+        assert r["projection_savings_ratio"] > 0.10, r
+        assert r["relist_pages"] >= min_relist_pages, r
+        assert r["fleet_p99_queue_ms"] <= r["lane_p99_ms"]["bulk"] / 10.0, r
+        # steady fleet pass stayed zero-request on the apiserver
+        assert sum(r["fleet_steady_verbs"].values()) == 0, r
+
+    def test_fleet_bench_small(self):
+        from tpu_operator.benchmarks.controlplane import run_fleet_bench
+
+        r = run_fleet_bench(n_tpu=800, baseline_tpu=200, churn_items=4000)
+        self._check(r, min_relist_pages=2)  # 880 Node objects / chunk 500
+
+    @pytest.mark.slow
+    def test_fleet_bench_10k(self):
+        from tpu_operator.benchmarks.controlplane import run_fleet_bench
+
+        r = run_fleet_bench()  # the real thing: 10k TPU nodes
+        # 11k Node objects page in 500-object chunks
+        self._check(r, min_relist_pages=20)
+        assert r["n_tpu_nodes"] == 10000, r

@@ -60,6 +60,9 @@ ANNOTATION_CLEAR = "annotation-clear"  # strip the spec-hash annotations
 SLICE_REQUEST = "slice-request"    # a SliceRequest lands in the queue
 SLICE_RESIZE = "slice-resize"      # spec.chips edit on a live SliceRequest
 WORKLOAD_CRASH = "workload-crash"  # elastic shim dies mid-save (torn ckpt)
+SHARD_KILL = "shard-kill"          # a reconcile shard's workers die;
+#                                    queued keys must rehash losslessly
+#                                    onto the survivors (count = shard id)
 
 
 @dataclass(frozen=True)
@@ -129,6 +132,7 @@ class FaultPlan:
             "dag-race": cls._dag_race,
             "placement-contention": cls._placement_contention,
             "slice-migrate": cls._slice_migrate,
+            "shard-failover": cls._shard_failover,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -370,6 +374,52 @@ class FaultPlan:
         return out
 
     @classmethod
+    def _shard_failover(cls, rng, nodes, steps) -> List[Fault]:
+        """A fleet rollout keeps every reconcile shard churning bulk
+        work, node flaps keep the health lane hot, and two of the four
+        shards die mid-run — their queued keys must rehash losslessly
+        onto the survivors (rendezvous hashing: only the dead shard's
+        keys move). Convergence, all standing invariants and the
+        lane-priority bound must hold through both failovers, and the
+        verdict stays byte-identical per seed. Shard 0 is never a
+        victim, so at least one shard always survives."""
+        out: List[Fault] = [
+            Fault(0, TRIGGER_ROLLOUT,
+                  arg=cls._marker(rng, "/opt/shard-libtpu"))]
+        kill_steps = sorted(rng.sample(range(2, max(4, steps - 2)), 2))
+        for idx, kill_step in enumerate(kill_steps):
+            # a same-step CR mutation lands first (faults sort by kind
+            # within a step, "mutate-policy" < "shard-kill"), so its
+            # watch events are queued on every controller when the shard
+            # dies — the kill demonstrably rehashes in-flight keys, not
+            # an empty queue. ``count`` seeds the victim preference; the
+            # runner kills the busiest killable shard deterministically.
+            out.append(Fault(kill_step, MUTATE_POLICY,
+                             arg=cls._marker(rng, f"failover-{idx}")))
+            out.append(Fault(kill_step, SHARD_KILL,
+                             count=rng.randrange(1, 4)))
+        join = 0
+        for step in range(1, steps):
+            if step % 3 == 1 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(step + 2, steps - 1), NODE_HEAL,
+                                 arg=victim))
+            if step % 4 == 2:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(1, 4)))
+            if step % 4 == 3:
+                # labeled TPU nodes joining pass the policy controller's
+                # label predicate on ADDED — live health-lane traffic
+                # racing the rollout's bulk churn
+                join += 1
+                out.append(Fault(step, NODE_ADD,
+                                 arg=f"failover-join-{join}"))
+            if step % 5 == 4:
+                out.append(Fault(step, WATCH_DROP))
+        return out
+
+    @classmethod
     def _chip_loss(cls, rng, nodes, steps) -> List[Fault]:
         """Chips disappear from health samples (allocatable drops), come
         back, and operand pods crash-loop in between."""
@@ -408,6 +458,13 @@ class ChaosClient(Client):
         self.injected: dict = {}            # kind -> count, for the verdict
         self._armed: List[Fault] = []
         self._watches: List[dict] = []
+
+    @property
+    def supports_chunked_list(self) -> bool:
+        # pass-through: list() forwards opts verbatim, so chunking works
+        # iff the wrapped client chunks (the cache's relist then pages
+        # through the fault injector, eating armed faults per page)
+        return getattr(self.inner, "supports_chunked_list", False)
 
     # -- arming -------------------------------------------------------------
 

@@ -58,6 +58,11 @@ point*, not just at convergence:
   regresses, and every restore (``status.migration.restoredStep``
   changing) lands at or above it — acknowledged training work must
   survive any migrate/resize/crash interleaving the storm produces.
+- ``lane-priority`` (recorded by the runner): no health-lane event may
+  be dequeued having waited behind more than the runner's
+  ``LANE_PRIORITY_BUDGET`` bulk reconciles — the workload-aware
+  queueing promise the priority lanes exist for, audited from the
+  controllers' lane journals at verdict time.
 - ``convergence``: recorded by the runner when the cluster fails to
   reach all-Ready within the soak budget after faults stop.
 

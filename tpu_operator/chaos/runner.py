@@ -43,7 +43,14 @@ from ..controllers.upgrade_controller import (
     UpgradeReconciler,
     desired_revision,
 )
-from ..runtime import CachedClient, FakeClient, Request
+from ..runtime import (
+    LANE_BULK,
+    LANE_HEALTH,
+    LANES,
+    CachedClient,
+    FakeClient,
+    Request,
+)
 from ..runtime.client import (
     ApiError,
     ConflictError,
@@ -51,7 +58,7 @@ from ..runtime.client import (
     NotFoundError,
 )
 from ..runtime.fake import simulate_kubelet
-from ..runtime.manager import any_event, enqueue_object
+from ..runtime.manager import any_event, enqueue_object, shard_of
 from ..runtime.objects import (
     annotations_of,
     get_nested,
@@ -77,6 +84,7 @@ from .faults import (
     NODE_REMOVE,
     OPERAND_DRIFT,
     POD_CRASH,
+    SHARD_KILL,
     SLICE_REQUEST,
     SLICE_RESIZE,
     TRIGGER_ROLLOUT,
@@ -91,7 +99,8 @@ from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
-             "dag-race", "placement-contention", "slice-migrate")
+             "dag-race", "placement-contention", "slice-migrate",
+             "shard-failover")
 
 # virtual deadlines for the slice-migrate scenario, sized in runner steps
 # (STEP_DT each): long enough for the elastic handshake (~3 passes),
@@ -109,25 +118,51 @@ SOAK_PASS_BUDGET = 150   # post-fault passes before convergence fails
 DRAIN_BUDGET = 500       # reconciles per drain — a backstop, not a knob
 RETRY_DELAY_S = 1.0      # virtual requeue delay after an injected failure
 MAX_PARALLEL_UPGRADES = 8
+FAILOVER_SHARDS = 4      # shard count for the shard-failover scenario
+# the lane-priority invariant: no health-lane item may be dequeued having
+# waited behind more than this many bulk reconciles
+LANE_PRIORITY_BUDGET = 8
 
 
 class _SyncController:
     """Single-threaded Controller stand-in: same watch/predicate/mapper
     registration surface, but reconciles run inline from :meth:`drain`
-    and delayed requeues key off the virtual clock."""
+    and delayed requeues key off the virtual clock.
 
-    def __init__(self, reconciler, client, clock: VirtualClock):
+    Models the production Controller's fleet-scale queueing exactly:
+    requests route to ``shards`` rendezvous-hashed queues (the same
+    ``shard_of`` the Manager uses, so a kill moves only the dead shard's
+    keys) and each shard holds per-lane FIFOs popped health > placement >
+    bulk. ``shards=1`` is the default — scenarios that predate sharding
+    keep one queue. The lane journal (``max_health_behind_bulk``) feeds
+    the lane-priority invariant: how many bulk reconciles ran while the
+    worst-served health item waited."""
+
+    def __init__(self, reconciler, client, clock: VirtualClock,
+                 shards: int = 1, name: str = ""):
         self.reconciler = reconciler
         self.client = client
         self.clock = clock
-        self._queue: List[Request] = []
+        self.name = name
+        self.shards = max(1, shards)
+        self._live: List[int] = list(range(self.shards))
+        self._queues: List[Dict[str, List[Request]]] = [
+            {lane: [] for lane in LANES} for _ in range(self.shards)]
+        self._lane_of: Dict[Request, str] = {}
         self._delayed: Dict[Request, float] = {}
         self._last_seen: Dict[tuple, dict] = {}
         self.reconcile_errors = 0
+        # lane-priority accounting: bulk reconciles completed while each
+        # queued health item waited, and the worst case seen
+        self._bulk_pops = 0
+        self._health_marks: Dict[Request, int] = {}
+        self.max_health_behind_bulk = 0
+        self.keys_moved_on_failover = 0
 
     def watch(self, api_version: str, kind: str,
               predicate: Callable = any_event,
-              mapper: Callable = enqueue_object) -> None:
+              mapper: Callable = enqueue_object,
+              lane: Optional[str] = None) -> None:
         def handler(event):
             key = (api_version, kind, namespace_of(event.obj),
                    name_of(event.obj))
@@ -140,7 +175,7 @@ class _SyncController:
                 if not predicate(event, old):
                     return
                 for req in mapper(event):
-                    self.add(req)
+                    self.add(req, lane=lane)
             except ApiError:
                 # the mapper's LIST ate an armed fault; the per-tick
                 # resync (and any relist) re-enqueues what this loses
@@ -148,9 +183,82 @@ class _SyncController:
 
         self.client.watch(api_version, kind, handler)
 
-    def add(self, request: Request) -> None:
-        if request not in self._queue:
-            self._queue.append(request)
+    def _shard_for(self, request: Request) -> int:
+        return shard_of(str(request), self._live)
+
+    def add(self, request: Request, lane: Optional[str] = None) -> None:
+        lane = lane if lane in LANES else LANE_BULK
+        cur = self._lane_of.get(request)
+        if cur is not None:
+            # already queued: promote to the higher-priority lane only
+            if LANES.index(lane) < LANES.index(cur):
+                shard = self._shard_for(request)
+                self._queues[shard][cur].remove(request)
+                self._queues[shard][lane].append(request)
+                self._lane_of[request] = lane
+                if lane == LANE_HEALTH:
+                    self._health_marks.setdefault(request, self._bulk_pops)
+            return
+        self._queues[self._shard_for(request)][lane].append(request)
+        self._lane_of[request] = lane
+        if lane == LANE_HEALTH:
+            self._health_marks.setdefault(request, self._bulk_pops)
+
+    def kill_busiest(self, preferred: int) -> Optional[tuple]:
+        """Kill the killable shard currently holding the most queued
+        keys (ties: ``preferred`` if killable, else lowest id) — the
+        adversary aims where it hurts. Deterministic given the queue
+        state. Returns ``(shard, keys_moved)`` or None when no shard can
+        die (single-shard controller)."""
+        killable = self._live[1:]  # the first live shard always survives
+        if not killable:
+            return None
+        depth = {s: sum(len(self._queues[s][lane]) for lane in LANES)
+                 for s in killable}
+        top = max(depth.values())
+        candidates = sorted(s for s, d in depth.items() if d == top)
+        victim = (preferred if preferred in candidates and top == 0
+                  else candidates[0])
+        return victim, self.kill_shard(victim) or 0
+
+    def kill_shard(self, shard: int) -> Optional[int]:
+        """Kill one shard's (virtual) worker group: remove it from the
+        live set and rehash its queued keys onto the survivors, lanes
+        preserved. Returns keys moved, or None when the kill is a no-op
+        (unknown/dead shard, or it would take the last shard down)."""
+        if shard not in self._live or len(self._live) == 1:
+            return None
+        self._live.remove(shard)
+        dead = self._queues[shard]
+        self._queues[shard] = {lane: [] for lane in LANES}
+        moved = 0
+        for lane in LANES:
+            for req in dead[lane]:
+                del self._lane_of[req]
+                self.add(req, lane=lane)
+                moved += 1
+        self.keys_moved_on_failover += moved
+        return moved
+
+    def _pop(self) -> Optional[Request]:
+        # strict lane priority, shards visited in live order within a
+        # lane — deterministic, and with shards=1 exactly the old FIFO
+        # per lane
+        for lane in LANES:
+            for shard in self._live:
+                queue = self._queues[shard][lane]
+                if queue:
+                    req = queue.pop(0)
+                    del self._lane_of[req]
+                    if lane == LANE_BULK:
+                        self._bulk_pops += 1
+                    elif lane == LANE_HEALTH:
+                        behind = self._bulk_pops - self._health_marks.pop(
+                            req, self._bulk_pops)
+                        if behind > self.max_health_behind_bulk:
+                            self.max_health_behind_bulk = behind
+                    return req
+        return None
 
     def _schedule(self, request: Request, due: float) -> None:
         prev = self._delayed.get(request)
@@ -165,8 +273,10 @@ class _SyncController:
     def drain(self, budget: int = DRAIN_BUDGET) -> int:
         done = 0
         self._promote()
-        while self._queue and done < budget:
-            req = self._queue.pop(0)
+        while done < budget:
+            req = self._pop()
+            if req is None:
+                break
             done += 1
             try:
                 result = self.reconciler.reconcile(req)
@@ -347,6 +457,20 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                     applied = True
                 except ConflictError:
                     pass
+    elif kind == SHARD_KILL:
+        # kill the busiest killable shard's worker group on every
+        # controller: queued keys rehash onto the survivors (lanes
+        # preserved); the no-op guard (never the last shard) mirrors
+        # Controller.kill_shard
+        kills = []
+        for ctrl in state.get("ctrls") or []:
+            out = ctrl.kill_busiest(fault.count)
+            if out is not None:
+                kills.append({"controller": ctrl.name, "shard": out[0],
+                              "keys_moved": out[1]})
+        if kills:
+            state.setdefault("shard_kills", []).extend(kills)
+            applied = True
     elif kind == WORKLOAD_CRASH:
         # the training job dies mid-step, leaving a torn (never-acked)
         # checkpoint behind — the restart must restore the newest durable
@@ -621,8 +745,13 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     fake.create(new_cluster_policy(spec={"upgradePolicy": upgrade_spec}))
     prec = ClusterPolicyReconciler(client=traced, namespace=NAMESPACE)
     urec = UpgradeReconciler(client=traced, namespace=NAMESPACE, now=clock)
-    ctrls = [_SyncController(prec, traced, clock),
-             _SyncController(urec, traced, clock)]
+    # the failover scenario runs sharded queues (kills rehash keys); every
+    # other scenario keeps one shard — identical routing to before
+    shards = FAILOVER_SHARDS if scenario == "shard-failover" else 1
+    ctrls = [_SyncController(prec, traced, clock, shards=shards,
+                             name="policy"),
+             _SyncController(urec, traced, clock, shards=shards,
+                             name="upgrade")]
     prec.setup_controller(ctrls[0], None)
     urec.setup_controller(ctrls[1], None)
     # the placement controller only joins the scenarios built around it:
@@ -638,7 +767,8 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             client=traced, namespace=NAMESPACE,
             preemption=(scenario == "placement-contention"),
             now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S)
-        place_ctrl = _SyncController(lrec, traced, clock)
+        place_ctrl = _SyncController(lrec, traced, clock, shards=shards,
+                                     name="placement")
         lrec.setup_controller(place_ctrl, None)
         ctrls.append(place_ctrl)
     # elastic workload shims (the training jobs' half of the slice-intent
@@ -648,7 +778,7 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     shims: Dict[str, ElasticWorkload] = {}
 
     state = {"marker": None, "rollout": False, "chips": {}, "drift": False,
-             "shims": shims}
+             "shims": shims, "ctrls": ctrls}
     resync = Request(name=POLICY)
     checker = InvariantChecker(fake, NAMESPACE,
                                cache=client if cached else None,
@@ -690,6 +820,17 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
 
     def verdict(plan: FaultPlan, converged: bool, soak: int,
                 conv_s: Optional[float]) -> dict:
+        # lane-priority invariant: the worst-served health item across
+        # every controller waited behind at most LANE_PRIORITY_BUDGET
+        # bulk reconciles — checked at verdict time so every exit path
+        # (setup failure included) audits it
+        for ctrl in ctrls:
+            if ctrl.max_health_behind_bulk > LANE_PRIORITY_BUDGET:
+                checker.record(
+                    "lane-priority", plan.steps,
+                    f"[{ctrl.name}] a health-lane event waited behind "
+                    f"{ctrl.max_health_behind_bulk} bulk reconciles "
+                    f"(budget {LANE_PRIORITY_BUDGET})")
         violations = checker.to_list()
         out = {
             "scenario": scenario,
@@ -711,6 +852,23 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             "traces": {
                 "slowest": TRACER.slowest_trace(),
                 "failed": TRACER.failed_traces(),
+            },
+            # fleet-scale queueing evidence: worst health-behind-bulk
+            # wait per controller, and (sharded runs) the kill ledger —
+            # which shards died and how many queued keys each failover
+            # rehashed onto the survivors
+            "lanes": {
+                "budget": LANE_PRIORITY_BUDGET,
+                "max_health_behind_bulk": {
+                    ctrl.name: ctrl.max_health_behind_bulk
+                    for ctrl in ctrls},
+            },
+            "shards": {
+                "configured": shards,
+                "live": {ctrl.name: list(ctrl._live) for ctrl in ctrls},
+                "kills": state.get("shard_kills", []),
+                "keys_rehashed": sum(ctrl.keys_moved_on_failover
+                                     for ctrl in ctrls),
             },
             "ok": bool(converged and not violations),
         }

@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(os.environ.get("OPERATOR_WORKERS", "1")),
                    help="reconcile workers per controller "
                         "(MaxConcurrentReconciles analog)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="workqueue shards per controller, rendezvous-"
+                        "hashed by key (default OPERATOR_SHARDS or 1 = "
+                        "today's single queue)")
+    p.add_argument("--write-qps", type=float, default=None,
+                   help="shared apiserver write budget in writes/sec, "
+                        "0 = unlimited (default OPERATOR_WRITE_QPS)")
     from ..runtime.tracing import env_trace_enabled
 
     p.add_argument("--no-trace", action="store_true",
@@ -155,23 +162,25 @@ def main(argv=None) -> int:
 
     mgr = Manager(api, namespace=args.namespace,
                   health_port=args.health_port,
-                  leader_elect=args.leader_elect)
+                  leader_elect=args.leader_elect,
+                  write_qps=args.write_qps)
     mgr.add_reconciler(
         ClusterPolicyReconciler(client=api, namespace=args.namespace),
-        workers=args.workers)
+        workers=args.workers, shards=args.shards)
     mgr.add_reconciler(
         TPUDriverReconciler(client=api, namespace=args.namespace),
-        workers=args.workers)
+        workers=args.workers, shards=args.shards)
     mgr.add_reconciler(
         UpgradeReconciler(client=api, namespace=args.namespace),
-        workers=args.workers)
+        workers=args.workers, shards=args.shards)
     mgr.add_reconciler(
         PlacementReconciler(client=api, namespace=args.namespace),
-        workers=args.workers)
+        workers=args.workers, shards=args.shards)
     mgr.start()
     log.info("tpu-operator started (namespace=%s, fake=%s, cache=%s, "
-             "workers=%d)", args.namespace, args.fake_cluster,
-             not args.no_cache, args.workers)
+             "workers=%d, shards=%s)", args.namespace, args.fake_cluster,
+             not args.no_cache, args.workers,
+             args.shards if args.shards is not None else "env")
 
     try:
         start = time.monotonic()

@@ -9,6 +9,7 @@ plus the Helm-verb slot of deployments/gpu-operator/templates/*).
     tpuop-cfg uninstall [--purge-crds]
     tpuop-cfg trace [--url http://mgr:8080 | -f traces.json]
                     [--controller C] [--min-ms N] [--outcome error]
+    tpuop-cfg cache [--url http://mgr:8080 | -f cache.json] [-o json]
     tpuop-cfg dag [-o json]
     tpuop-cfg place --fleet fleet.yaml --chips 8 [--explain] [-o json]
     tpuop-cfg slices [-n NS] [--migrations] [-o json]
@@ -524,6 +525,73 @@ def _trace(args) -> int:
     return 0
 
 
+def render_cache_stats(stats: dict) -> str:
+    """The /debug/cache body as a human-readable table: one row per
+    cached kind with object count, measured store bytes (and what the
+    full objects would have cost when the kind is projected), index
+    bucket counts, and per-store relists."""
+
+    def human(n) -> str:
+        n = float(n or 0)
+        for unit in ("B", "KiB", "MiB", "GiB"):
+            if n < 1024.0 or unit == "GiB":
+                return (f"{n:.0f}{unit}" if unit == "B"
+                        else f"{n:.1f}{unit}")
+            n /= 1024.0
+        return f"{n:.1f}GiB"  # pragma: no cover - unreachable
+
+    lines = [
+        f"projection: {'on' if stats.get('projection_enabled') else 'off'}"
+        f", relist chunk: {stats.get('relist_chunk')}"
+        f", cache reads: {stats.get('cache_reads')}"
+        f", relists: {stats.get('relists')}"]
+    for gvk, st in sorted((stats.get("kinds") or {}).items()):
+        line = (f"{gvk}: {st.get('objects')} objects"
+                f", {human(st.get('bytes'))}")
+        if st.get("projected"):
+            line += (f" projected ({human(st.get('full_bytes'))} full)")
+        if st.get("relists"):
+            line += f", {st['relists']} relists"
+        lines.append(line)
+        idx = st.get("indexes") or {}
+        if idx:
+            lines.append("  indexes: " + ", ".join(
+                f"{name}={n}" for name, n in sorted(idx.items())))
+    return "\n".join(lines)
+
+
+def _cache(args) -> int:
+    """Fetch the manager's /debug/cache snapshot (or a must-gather
+    cache.json) and print the per-kind store picture: object counts,
+    measured projected-vs-full bytes, index buckets, relists."""
+    import pathlib
+    import urllib.request
+
+    if args.file:
+        try:
+            stats = json.loads(pathlib.Path(args.file).read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read cache stats from {args.file}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        url = args.url.rstrip("/") + "/debug/cache"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                stats = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if not isinstance(stats, dict):
+        print("cache stats payload is not an object", file=sys.stderr)
+        return 1
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(stats, indent=2, sort_keys=True))
+        return 0
+    print(render_cache_stats(stats))
+    return 0
+
+
 def _dag(args) -> int:
     """Render the operand dependency DAG the scheduler compiles at
     startup: every state with its requires(), the parallel sync waves
@@ -795,6 +863,19 @@ def main(argv=None) -> int:
                    help="render only the trace with this id")
     t.add_argument("--timeout", type=float, default=10.0)
 
+    ca = sub.add_parser(
+        "cache", help="show the manager's informer-cache picture from "
+                      "/debug/cache (or a must-gather cache.json): per-"
+                      "kind object counts, measured projected-vs-full "
+                      "store bytes, index buckets, relists")
+    ca.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    ca.add_argument("-f", "--file", default=None,
+                    help="read a cache.json dump instead of fetching")
+    ca.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    ca.add_argument("--timeout", type=float, default=10.0)
+
     dg = sub.add_parser(
         "dag", help="show the operand state dependency DAG the scheduler "
                     "compiles at startup: sync waves, per-state "
@@ -841,6 +922,8 @@ def main(argv=None) -> int:
         return _slices(args)
     if args.cmd == "trace":
         return _trace(args)
+    if args.cmd == "cache":
+        return _cache(args)
     if args.cmd == "dag":
         return _dag(args)
     if args.cmd == "place":

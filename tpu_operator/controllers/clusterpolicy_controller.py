@@ -24,6 +24,7 @@ from ..api.clusterpolicy import (
 )
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime import (
+    LANE_HEALTH,
     Controller,
     Manager,
     Reconciler,
@@ -78,13 +79,16 @@ class ClusterPolicyReconciler(Reconciler):
 
     def setup_controller(self, controller: Controller, manager: Manager):
         controller.watch(V1, KIND_CLUSTER_POLICY, predicate=generation_changed)
-        # node events: TPU labels appearing/changing re-trigger every policy
+        # node events: TPU labels appearing/changing re-trigger every
+        # policy — health lane, so a node flapping in mid-rollout is
+        # examined before the bulk operand churn queued behind it
         controller.watch(
             "v1", "Node",
             predicate=label_changed(L.GKE_TPU_ACCELERATOR, L.GKE_TPU_TOPOLOGY,
                                     L.WORKLOAD_CONFIG, L.SLICE_CONFIG,
                                     L.DEPLOY_PREFIX + "*"),
-            mapper=self._enqueue_all_policies)
+            mapper=self._enqueue_all_policies,
+            lane=LANE_HEALTH)
         # owned DaemonSets feed readiness back into the loop
         controller.watch("apps/v1", "DaemonSet",
                          mapper=enqueue_owner(V1, KIND_CLUSTER_POLICY))

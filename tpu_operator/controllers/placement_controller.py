@@ -53,6 +53,8 @@ from ..api.slicerequest import (
 )
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime import (
+    LANE_HEALTH,
+    LANE_PLACEMENT,
     Controller,
     Manager,
     Reconciler,
@@ -168,14 +170,19 @@ class PlacementReconciler(Reconciler):
     # -- wiring ------------------------------------------------------------
 
     def setup_controller(self, controller: Controller, manager: Manager):
-        # spec edges only: our own status writes must not re-trigger
+        # spec edges only: our own status writes must not re-trigger;
+        # placement lane — scoring requests outranks bulk churn but
+        # yields to node-health events
         controller.watch(V1ALPHA1, KIND_SLICE_REQUEST,
-                         predicate=generation_changed)
+                         predicate=generation_changed,
+                         lane=LANE_PLACEMENT)
         # node edges re-examine every request: a freed node can unblock
-        # an Unschedulable request, a removed node breaks a binding
+        # an Unschedulable request, a removed node breaks a binding —
+        # that's fleet health, so it preempts both other lanes
         controller.watch("v1", "Node",
                          predicate=_node_placement_changed,
-                         mapper=self._enqueue_all_requests)
+                         mapper=self._enqueue_all_requests,
+                         lane=LANE_HEALTH)
 
     def _enqueue_all_requests(self, event: WatchEvent) -> Iterable[Request]:
         for cr in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):

@@ -203,6 +203,26 @@ class OperatorMetrics:
             "Seconds since the workload on a placed slice last wrote a "
             "durable checkpoint",
             labelnames=("request",))
+        # fleet-scale control plane (sharded reconcile lanes + bounded
+        # cache): per-lane queue depth (health must never pool behind
+        # bulk), time spent blocked on the shared apiserver write
+        # budget, and the measured in-memory size of each informer
+        # store (the projected view when projection is on)
+        self.workqueue_lane_depth = g(
+            "tpu_operator_workqueue_lane_depth",
+            "Items waiting per workqueue priority lane "
+            "(health > placement > bulk)",
+            labelnames=("controller", "lane"))
+        self.client_write_throttle = c(
+            "tpu_operator_client_write_throttle_seconds_total",
+            "Seconds reconcile workers spent blocked on the shared "
+            "apiserver write budget (OPERATOR_WRITE_QPS token bucket)",
+            labelnames=("controller",))
+        self.cache_store_bytes = g(
+            "tpu_operator_cache_store_bytes",
+            "Measured bytes held by one informer store (the projected "
+            "view when field projection is on)",
+            labelnames=("kind",))
         self.placement_requeues = c(
             "tpu_operator_placement_requeue_total",
             "Unschedulable SliceRequest requeues (capped exponential "

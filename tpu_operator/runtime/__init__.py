@@ -7,6 +7,7 @@ from .client import (  # noqa: F401
     InvalidError,
     ListOptions,
     NotFoundError,
+    PagedList,
     ServerUnavailableError,
     TooManyRequestsError,
     WatchEvent,
@@ -26,5 +27,18 @@ from .manager import (  # noqa: F401
     generation_changed,
     label_changed,
 )
+from .manager import (  # noqa: F401
+    ThrottledWriteClient,
+    env_shards,
+    shard_of,
+)
 from .tracing import TRACER, Tracer, TracingClient  # noqa: F401
-from .workqueue import RateLimiter, WorkQueue  # noqa: F401
+from .workqueue import (  # noqa: F401
+    LANE_BULK,
+    LANE_HEALTH,
+    LANE_PLACEMENT,
+    LANES,
+    RateLimiter,
+    WorkQueue,
+    WriteBudget,
+)

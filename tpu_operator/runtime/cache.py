@@ -40,6 +40,20 @@ Design, mapped to client-go:
   cover pod-by-node, pod-by-owner-uid, node-by-accelerator-label, and an
   automatic by-label index that turns plain ``{k: v}`` label-selector
   lists into bucket intersections instead of full scans.
+* **Index-only projections (fleet-scale memory bound).** For kinds with a
+  registered projection (Node, Pod) the store keeps only the fields the
+  reconcilers actually read — a 10k-node fleet no longer pays for
+  ``status.images``/``volumesInUse``/full container specs it never looks
+  at. Per-key measured bytes (projected AND what the full object would
+  have cost) feed ``cache_store_bytes{kind}`` and ``/debug/cache``.
+  ``OPERATOR_CACHE_PROJECTION=0`` stores full objects, exactly as before.
+* **Chunked relists.** The 410-Gone heal pages through the inner client
+  with ``ListOptions(limit=..., continue_=...)`` when it advertises
+  ``supports_chunked_list``, so a fleet-wide relist never materializes
+  every object at once; a non-blocking per-store guard means a
+  watch-drop storm heals each store exactly once, with concurrent
+  readers serving the (RV-monotonic, safe) current view instead of
+  convoying behind the relist.
 
 Everything above is threading-safe; under the single-threaded chaos
 runner it is also fully deterministic.
@@ -47,7 +61,9 @@ runner it is also fully deterministic.
 
 from __future__ import annotations
 
+import sys
 import threading
+from collections.abc import Mapping as _Mapping
 from typing import Callable, Iterable, Optional
 
 from ..api import labels as L
@@ -127,6 +143,118 @@ DEFAULT_INDEXES: dict[tuple, tuple] = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Index-only projections: store what reconcilers read, drop the rest.
+#
+# The field sets below are the union of every cached read in the repo
+# (grep get_nested over controllers/, topology/, validator/, state/):
+#   Node — metadata (labels/annotations incl. upgrade FSM state), spec
+#     (unschedulable), status.{conditions, capacity, allocatable, nodeInfo}.
+#   Pod — metadata (labels/ownerReferences/deletionTimestamp), spec
+#     {nodeName, containers[].resources.requests} (the drainable test),
+#     status.{phase, conditions}.
+# Everything else (managedFields, status.images, volume lists, full
+# container specs, probes, env) is O(fleet) memory the control plane
+# never looks at. Widening a projection is safe; narrowing one requires
+# re-auditing the readers.
+# ---------------------------------------------------------------------------
+
+
+def env_projection_enabled(env=None) -> bool:
+    """Cache field projection defaults ON; OPERATOR_CACHE_PROJECTION=0
+    (or false/no/off) stores full objects — same spelling as the other
+    kill switches."""
+    import os
+
+    val = (env or os.environ).get("OPERATOR_CACHE_PROJECTION", "1")
+    return str(val).strip().lower() not in ("0", "false", "no", "off")
+
+
+class ProjectionGate:
+    """Process-wide switch for index-only cache projections. Disabled,
+    every store holds full objects exactly as before — the escape hatch
+    when a consumer reads a field the projection audit missed."""
+
+    def __init__(self):
+        self.enabled = env_projection_enabled()
+
+
+PROJECTION_GATE = ProjectionGate()
+
+
+def env_relist_chunk(env=None) -> int:
+    """Page size for chunked relists (OPERATOR_RELIST_CHUNK, default 500);
+    0 disables chunking and relists in one full list."""
+    import os
+
+    val = (env or os.environ).get("OPERATOR_RELIST_CHUNK", "500")
+    try:
+        return max(0, int(str(val).strip()))
+    except ValueError:
+        return 500
+
+
+def _project_node(obj: dict) -> dict:
+    status = obj.get("status") or {}
+    slim = {k: v for k, v in obj.items() if k != "status"}
+    slim["status"] = {k: status[k] for k in
+                      ("phase", "conditions", "capacity", "allocatable",
+                       "nodeInfo")
+                      if k in status}
+    return slim
+
+
+def _slim_container(ctr: _Mapping) -> dict:
+    out = {}
+    if ctr.get("name"):
+        out["name"] = ctr["name"]
+    requests = get_nested(ctr, "resources", "requests", default=None)
+    if requests:
+        out["resources"] = {"requests": requests}
+    return out
+
+
+def _project_pod(obj: dict) -> dict:
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    slim = {k: v for k, v in obj.items() if k not in ("spec", "status")}
+    slim_spec = {}
+    if spec.get("nodeName"):
+        slim_spec["nodeName"] = spec["nodeName"]
+    if spec.get("containers"):
+        slim_spec["containers"] = [_slim_container(c)
+                                   for c in spec["containers"]]
+    slim["spec"] = slim_spec
+    slim["status"] = {k: status[k] for k in ("phase", "conditions")
+                      if k in status}
+    return slim
+
+
+#: kind -> projection; applied at ingest when :data:`PROJECTION_GATE` is
+#: on. Kinds without an entry (CRs, DaemonSets, ...) are stored full.
+PROJECTIONS: dict[tuple, Callable[[dict], dict]] = {
+    ("v1", "Node"): _project_node,
+    ("v1", "Pod"): _project_pod,
+}
+
+
+def measure_bytes(obj) -> int:
+    """Approximate resident footprint of one stored object tree:
+    recursive ``sys.getsizeof`` over dicts/lists/scalars (frozen views
+    included). Shared/interned leaves count at every occurrence, so the
+    number is a stable upper bound — what the fleet bench's bytes/node
+    figure and ``/debug/cache``'s projected-vs-full comparison need,
+    cheap enough to run on every ingest."""
+    size = sys.getsizeof(obj)
+    if isinstance(obj, _Mapping):
+        for k, v in obj.items():
+            size += measure_bytes(k) + measure_bytes(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            size += measure_bytes(v)
+    return size
+
+
 def _rv_int(obj: Optional[dict]) -> Optional[int]:
     rv = get_nested(obj or {}, "metadata", "resourceVersion")
     try:
@@ -156,6 +284,16 @@ class _Store:
         self.relist_lock = threading.Lock()
         self.relist_total = 0
         self.started = threading.Event()
+        # projection applied at ingest (None = store full objects) and
+        # the measured-bytes ledger: per-key stored footprint plus what
+        # the full (unprojected) object would have cost — the
+        # projected-vs-full comparison /debug/cache reports. Running
+        # totals keep stats O(1).
+        self.projection: Optional[Callable[[dict], dict]] = None
+        self.obj_bytes: dict[tuple, int] = {}
+        self.full_obj_bytes: dict[tuple, int] = {}
+        self.bytes_total = 0
+        self.full_bytes_total = 0
 
     # -- keys ---------------------------------------------------------------
 
@@ -165,10 +303,11 @@ class _Store:
 
     # -- mutation (callers hold no lock) ------------------------------------
 
-    def upsert(self, obj: dict) -> str:
+    def upsert(self, obj: dict, full_bytes: Optional[int] = None) -> str:
         """RV-monotonic insert/replace. Returns ``"new"``, ``"replaced"``,
         ``"same"`` (identical RV already held) or ``"stale"`` (older than
-        held — dropped)."""
+        held — dropped). ``full_bytes`` is the measured footprint of the
+        unprojected object (defaults to the stored object's own)."""
         key = self.key_of(obj)
         new_rv = _rv_int(obj)
         with self.lock:
@@ -183,6 +322,12 @@ class _Store:
             self._unindex(key)
             self.objects[key] = obj
             self._index(key, obj)
+            stored_b = measure_bytes(obj)
+            full_b = stored_b if full_bytes is None else full_bytes
+            self.bytes_total += stored_b - self.obj_bytes.get(key, 0)
+            self.obj_bytes[key] = stored_b
+            self.full_bytes_total += full_b - self.full_obj_bytes.get(key, 0)
+            self.full_obj_bytes[key] = full_b
             return "replaced" if cur is not None else "new"
 
     def remove(self, obj_or_key) -> None:
@@ -192,6 +337,8 @@ class _Store:
             if self.objects.pop(key, None) is not None:
                 self._unindex(key)
             self.written_rvs.pop(key, None)
+            self.bytes_total -= self.obj_bytes.pop(key, 0)
+            self.full_bytes_total -= self.full_obj_bytes.pop(key, 0)
 
     def _index(self, key: tuple, obj: dict) -> None:
         filed = {}
@@ -250,13 +397,16 @@ class CachedClient(Client):
     """
 
     def __init__(self, inner: Client,
-                 extra_indexes: Optional[dict] = None):
+                 extra_indexes: Optional[dict] = None,
+                 relist_chunk: Optional[int] = None):
         self.inner = inner
         self._stores: dict[tuple, _Store] = {}
         self._meta = threading.Lock()
         self._cancels: list[Callable[[], None]] = []
         self._extra = dict(extra_indexes or {})
         self._closed = False
+        self.relist_chunk = (env_relist_chunk() if relist_chunk is None
+                             else max(0, relist_chunk))
         # observability for the bench/tests: reads served without touching
         # the apiserver, and heals performed
         self.cache_reads = 0
@@ -278,6 +428,8 @@ class CachedClient(Client):
                 indexes = (tuple(DEFAULT_INDEXES.get(gvk, ()))
                            + tuple(self._extra.get(gvk, ())))
                 store = _Store(api_version, kind, indexes)
+                if PROJECTION_GATE.enabled:
+                    store.projection = PROJECTIONS.get(gvk)
                 self._stores[gvk] = store
                 creator = True
             else:
@@ -299,13 +451,22 @@ class CachedClient(Client):
         def handler(event: WatchEvent):
             if event.type == "DELETED":
                 store.remove(event.obj)
+                self._publish_bytes(store)
                 return
             # freeze-on-ingest: a fake/cached inner already publishes
             # frozen views (shared zero-copy); a mutable event object is
             # converted once here — leaves are immutable scalars, so
-            # structural sharing with other subscribers is safe
-            obj = freeze_obj(event.obj)
-            outcome = store.upsert(obj)
+            # structural sharing with other subscribers is safe. With a
+            # projection installed, the slimmed view is frozen instead
+            # (new top-level dicts, leaves still structurally shared).
+            if store.projection is not None:
+                obj = freeze_obj(store.projection(event.obj))
+                full_b = measure_bytes(event.obj)
+            else:
+                obj = freeze_obj(event.obj)
+                full_b = None
+            outcome = store.upsert(obj, full_bytes=full_b)
+            self._publish_bytes(store)
             if event.type == "ADDED" and outcome in ("same", "stale"):
                 key = store.key_of(obj)
                 rv = get_nested(obj, "metadata", "resourceVersion")
@@ -323,22 +484,52 @@ class CachedClient(Client):
     def _maybe_relist(self, store: _Store) -> None:
         if not store.needs_relist:
             return
-        with store.relist_lock:
-            if not store.needs_relist:
-                return
-            self._relist(store)
+        # non-blocking per-store guard: one heal per store at a time, and
+        # readers that lose the race serve the current (RV-monotonic, so
+        # never-corrupt, at worst gap-stale) view instead of convoying
+        # behind the relist — a watch-drop storm on two kinds heals each
+        # store once, in whichever reader thread got there first
+        if not store.relist_lock.acquire(blocking=False):
+            return
+        try:
+            if store.needs_relist:
+                self._relist(store)
+        finally:
+            store.relist_lock.release()
+
+    def _list_inner_chunked(self, store: _Store) -> Iterable[dict]:
+        """Page through the inner client's list when it supports
+        ``limit``/``continue_`` — a 10k-node relist touches
+        ``relist_chunk`` objects at a time instead of materializing the
+        fleet — else one full list."""
+        if (self.relist_chunk > 0
+                and getattr(self.inner, "supports_chunked_list", False)):
+            token = None
+            while True:
+                page = self.inner.list(
+                    store.api_version, store.kind,
+                    ListOptions(limit=self.relist_chunk, continue_=token))
+                yield from page
+                token = getattr(page, "continue_", None)
+                if not token:
+                    return
+        else:
+            yield from self.inner.list(store.api_version, store.kind)
 
     def _relist(self, store: _Store) -> None:
-        """Full list through the inner client + prune: the 410-Gone heal.
-        May raise (the inner client is allowed to fail); the dirty flag
-        stays set so the next read retries."""
+        """List through the inner client (chunked when supported) + prune:
+        the 410-Gone heal. May raise (the inner client is allowed to
+        fail); the dirty flag stays set so the next read retries."""
         with store.lock:
             pre = {k: _rv_int(o) for k, o in store.objects.items()}
-        listed = self.inner.list(store.api_version, store.kind)
         listed_keys = set()
-        for obj in listed:
+        for obj in self._list_inner_chunked(store):
             listed_keys.add(store.key_of(obj))
-            store.upsert(freeze_obj(obj))
+            if store.projection is not None:
+                store.upsert(freeze_obj(store.projection(obj)),
+                             full_bytes=measure_bytes(obj))
+            else:
+                store.upsert(freeze_obj(obj))
         with store.lock:
             for key in list(store.objects):
                 if key in listed_keys or key not in pre:
@@ -348,9 +539,16 @@ class CachedClient(Client):
             store.needs_relist = False
             store.relist_total += 1
         self.relists += 1
+        self._publish_bytes(store)
         from ..metrics.operator_metrics import OPERATOR_METRICS
 
         OPERATOR_METRICS.cache_relists.labels(kind=store.kind).inc()
+
+    def _publish_bytes(self, store: _Store) -> None:
+        from ..metrics.operator_metrics import OPERATOR_METRICS
+
+        OPERATOR_METRICS.cache_store_bytes.labels(
+            kind=store.kind).set(store.bytes_total)
 
     def resync(self) -> None:
         """Force a relist of every cached kind (client-go resync analog)."""
@@ -456,6 +654,32 @@ class CachedClient(Client):
         with self._meta:
             return sorted(self._stores)
 
+    def cache_stats(self) -> dict:
+        """Per-kind store sizes, index bucket counts, and measured
+        projected-vs-full bytes — the JSON body of the Manager's
+        ``/debug/cache`` endpoint and ``tpuop-cfg cache``."""
+        with self._meta:
+            stores = dict(self._stores)
+        kinds = {}
+        for (av, kind), store in sorted(stores.items()):
+            with store.lock:
+                kinds[f"{av}/{kind}"] = {
+                    "objects": len(store.objects),
+                    "indexes": {name: len(store._buckets[name])
+                                for name in sorted(store.indexes)},
+                    "bytes": store.bytes_total,
+                    "full_bytes": store.full_bytes_total,
+                    "projected": store.projection is not None,
+                    "relists": store.relist_total,
+                }
+        return {
+            "projection_enabled": PROJECTION_GATE.enabled,
+            "relist_chunk": self.relist_chunk,
+            "cache_reads": self.cache_reads,
+            "relists": self.relists,
+            "kinds": kinds,
+        }
+
     def store_snapshot(self, api_version: str, kind: str) -> dict:
         """(ns, name) -> resourceVersion for every cached object of the
         kind; no informer is created if none exists."""
@@ -472,17 +696,27 @@ class CachedClient(Client):
         store = self._stores.get((obj.get("apiVersion", ""),
                                   obj.get("kind", "")))
         if store is not None:
-            # a frozen inner result (FakeClient) IS the authoritative
-            # stored view — share it zero-copy; a mutable one (HTTP
-            # client) is copied then frozen so later caller edits can't
-            # reach the store
-            frozen = (obj if type(obj) is FrozenDict
-                      else freeze_obj(deepcopy_obj(obj)))
+            full_b = None
+            if store.projection is not None:
+                # projected kinds store the slim view of the write echo
+                # too, so a write never re-inflates the store
+                frozen = freeze_obj(store.projection(obj))
+                full_b = measure_bytes(obj)
+            else:
+                # a frozen inner result (FakeClient) IS the authoritative
+                # stored view — share it zero-copy; a mutable one (HTTP
+                # client) is copied then frozen so later caller edits
+                # can't reach the store
+                frozen = (obj if type(obj) is FrozenDict
+                          else freeze_obj(deepcopy_obj(obj)))
             key = store.key_of(frozen)
             rv = get_nested(frozen, "metadata", "resourceVersion")
             with store.lock:
-                if store.upsert(frozen) in ("new", "replaced") and rv:
+                if store.upsert(frozen,
+                                full_bytes=full_b) in ("new", "replaced") \
+                        and rv:
                     store.written_rvs[key] = rv
+            self._publish_bytes(store)
         return obj
 
     def create(self, obj):
@@ -505,6 +739,7 @@ class CachedClient(Client):
         if store is not None:
             ns = namespace or "" if is_namespaced(kind) else ""
             store.remove((ns, name))
+            self._publish_bytes(store)
 
     # -- watch / lifecycle ----------------------------------------------------
 

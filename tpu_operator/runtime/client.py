@@ -114,10 +114,30 @@ class ListOptions:
     namespace: Optional[str] = None
     label_selector: Optional[Mapping] = None  # LabelSelector or matchLabels dict
     field_selector: Optional[Mapping[str, str]] = None  # only metadata.name/.namespace
+    # apiserver-style pagination: at most ``limit`` objects per call;
+    # ``continue_`` resumes after the previous page (the token from that
+    # page's ``PagedList.continue_``). Clients that don't support chunking
+    # (``supports_chunked_list`` False) may ignore both and return the
+    # full set — callers must tolerate an over-full page.
+    limit: Optional[int] = None
+    continue_: Optional[str] = None
+
+
+class PagedList(list):
+    """One page of a chunked list. ``continue_`` is the opaque token for
+    the next page (None/"" on the final page) — the analog of
+    ``metadata.continue`` on a real apiserver list response."""
+
+    continue_: Optional[str] = None
 
 
 class Client(abc.ABC):
     """Minimal typed-by-convention CRUD + watch client."""
+
+    #: True when ``list`` honors ``ListOptions.limit``/``continue_`` and
+    #: returns :class:`PagedList` pages — lets the informer relist a 10k
+    #: node fleet in chunks instead of materializing it all at once.
+    supports_chunked_list = False
 
     @abc.abstractmethod
     def get(self, api_version: str, kind: str, name: str,

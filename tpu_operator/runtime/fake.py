@@ -20,6 +20,7 @@ from .client import (
     ConflictError,
     ListOptions,
     NotFoundError,
+    PagedList,
     WatchEvent,
     WatchHub,
     merge_patch,
@@ -42,6 +43,8 @@ from ..utils.hash import object_hash
 
 
 class FakeClient(Client):
+    supports_chunked_list = True
+
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
@@ -126,6 +129,18 @@ class FakeClient(Client):
                         continue
                 out.append(obj)
         out.sort(key=obj_key)
+        # pagination: the sort key within one (apiVersion, kind) reduces
+        # to (namespace, name), so the continue token is "ns/name" of the
+        # last object returned (K8s names cannot contain "/")
+        if opts.continue_:
+            tns, _, tname = opts.continue_.partition("/")
+            out = [o for o in out
+                   if (namespace_of(o), name_of(o)) > (tns, tname)]
+        if opts.limit is not None and 0 < opts.limit < len(out):
+            page = PagedList(out[:opts.limit])
+            last = page[-1]
+            page.continue_ = f"{namespace_of(last)}/{name_of(last)}"
+            return page
         return out
 
     def create(self, obj):

@@ -22,7 +22,9 @@ Two knobs the seed deliberately pinned are now open:
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import zlib
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Iterable, Optional
@@ -30,9 +32,73 @@ from typing import Callable, Iterable, Optional
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from .client import Client, WatchEvent
 from .objects import get_nested, name_of, namespace_of
-from .workqueue import RateLimiter, WorkQueue
+from .workqueue import RateLimiter, WorkQueue, WriteBudget, env_write_qps
 
 log = logging.getLogger("tpu_operator.manager")
+
+
+def env_shards(env=None) -> int:
+    """Reconcile-plane shard count (worker groups per controller).
+    Defaults to 1 — one queue, one worker group: exactly the unsharded
+    behavior. At K>1, reconcile keys hash across K independent
+    queue+worker-group shards; per-key serialization holds because a key
+    always maps to exactly one live shard."""
+    try:
+        n = int((env or os.environ).get("OPERATOR_SHARDS", "1"))
+    except (TypeError, ValueError):
+        return 1
+    return max(1, n)
+
+
+def shard_of(key: str, shards) -> int:
+    """Deterministic key->shard assignment over the live shard list.
+
+    Rendezvous (highest-random-weight) hashing with crc32 — NOT Python's
+    randomized ``hash()``, and NOT ``crc32 % len``: a modulo would remap
+    almost every key when the live set shrinks, letting a key in flight
+    on a surviving shard be re-routed (and reconciled concurrently) on
+    another. Under rendezvous hashing, killing a shard moves only the
+    dead shard's keys; every key on a survivor keeps its shard, so the
+    per-key serialization argument stays local to one WorkQueue."""
+    best = None
+    best_w = -1
+    for s in shards:
+        w = zlib.crc32(f"{s}:{key}".encode())
+        if w > best_w:
+            best, best_w = s, w
+    return best if best is not None else 0
+
+
+class ThrottledWriteClient:
+    """Per-controller write gate over the manager's client: every write
+    verb takes one token from the shared :class:`WriteBudget` before
+    reaching the apiserver (client-side priority-and-fairness). Reads,
+    watches and everything else pass straight through. Seconds spent
+    blocked are counted per controller on
+    ``client_write_throttle_seconds_total``."""
+
+    _WRITE_VERBS = ("create", "update", "update_status", "patch",
+                    "delete", "evict")
+
+    def __init__(self, inner: Client, budget: WriteBudget, controller: str):
+        self.inner = inner
+        self.budget = budget
+        self.controller = controller
+
+    def _gate(self) -> None:
+        waited = self.budget.acquire()
+        if waited > 0:
+            OPERATOR_METRICS.client_write_throttle.labels(
+                controller=self.controller).inc(waited)
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name in self._WRITE_VERBS and callable(attr):
+            def gated(*args, **kwargs):
+                self._gate()
+                return attr(*args, **kwargs)
+            return gated
+        return attr
 
 
 @dataclass(frozen=True)
@@ -135,32 +201,54 @@ def enqueue_constant(name: str, namespace: str = ""):
 
 
 class Controller:
-    """One reconciler + its watches + its queue + its worker threads.
+    """One reconciler + its watches + its sharded queues + worker groups.
 
     ``workers`` is the MaxConcurrentReconciles analog: N worker threads
     drain one queue. Distinct keys reconcile concurrently; the same key
     never does (WorkQueue's processing set defers a re-add of an in-flight
-    key to its ``done``)."""
+    key to its ``done``).
+
+    ``shards`` (default: ``OPERATOR_SHARDS``, itself defaulting to 1)
+    splits the queue into K independent shards, each with its own worker
+    group of ``workers`` threads. Keys hash deterministically onto the
+    *live* shard list, so per-key serialization survives sharding: one
+    key, one shard, one queue's processing set. ``kill_shard`` models a
+    worker-group failure — the dead shard's queued keys rehash onto the
+    survivors with no key lost (and only after the dead workers have
+    drained, so a key never runs on two shards at once)."""
 
     def __init__(self, name: str, reconciler: Reconciler, client: Client,
                  rate_limiter: Optional[RateLimiter] = None,
-                 workers: int = 1):
+                 workers: int = 1, shards: Optional[int] = None):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         self.name = name
         self.reconciler = reconciler
         self.client = client
         self.workers = workers
-        self.queue = WorkQueue(
-            rate_limiter or RateLimiter(0.1, 3.0),
-            on_coalesced=OPERATOR_METRICS.workqueue_coalesced.labels(
-                controller=name).inc)
+        self.shards = env_shards() if shards is None else max(1, shards)
+        rl = rate_limiter or RateLimiter(0.1, 3.0)
+        coalesced = OPERATOR_METRICS.workqueue_coalesced.labels(
+            controller=name).inc
+        # one RateLimiter shared by every shard: backoff state is per
+        # key, so it survives a key rehashing to another shard
+        self.queues = [WorkQueue(rl, on_coalesced=coalesced)
+                       for _ in range(self.shards)]
+        self.queue = self.queues[0]  # unsharded-compat alias (shards=1)
+        # routing state: _live is the ordered live-shard list keys hash
+        # onto; _shard_lock makes route+add atomic so a kill_shard
+        # transfer can't race an enqueue into the dying shard
+        self._live: list[int] = list(range(self.shards))
+        self._dead: set[int] = set()
+        self._shard_lock = threading.Lock()
         self._watch_cancels: list[Callable[[], None]] = []
         # _last_seen feeds predicates their "old" object; watch events can
         # arrive from any publishing thread, so all access is under a lock
         self._last_seen: dict[tuple, dict] = {}
         self._seen_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
+        self._threads_by_shard: dict[int, list[threading.Thread]] = {
+            i: [] for i in range(self.shards)}
         self._stopped = threading.Event()
         # reconcile counters shared by N workers: guarded, not bare ints
         self._stats_lock = threading.Lock()
@@ -173,9 +261,79 @@ class Controller:
             if error:
                 self.reconcile_errors += 1
 
+    # -- shard routing ------------------------------------------------------
+
+    def _queue_for_locked(self, req) -> WorkQueue:
+        return self.queues[shard_of(str(req), self._live)]
+
+    def enqueue(self, req: Request, lane: Optional[str] = None) -> None:
+        """Route a request to its shard's queue under the declared lane."""
+        with self._shard_lock:
+            self._queue_for_locked(req).add(req, lane=lane)
+
+    def _requeue_after(self, req: Request, delay: float) -> None:
+        with self._shard_lock:
+            self._queue_for_locked(req).add_after(req, delay)
+
+    def _requeue_rate_limited(self, req: Request) -> None:
+        with self._shard_lock:
+            self._queue_for_locked(req).add_rate_limited(req)
+
+    def kill_shard(self, shard: int) -> int:
+        """Fail one shard's worker group and rehash its keys onto the
+        survivors. Returns the number of keys transferred. Ordering
+        matters for the no-concurrent-same-key guarantee: freeze (stop
+        handing out items), join the shard's workers (in-flight
+        reconciles finish), THEN atomically reroute + transfer under the
+        shard lock so no enqueue lands in the dead queue after the
+        drain."""
+        with self._shard_lock:
+            if shard in self._dead or shard not in self._live:
+                raise ValueError(f"shard {shard} is not live")
+            if len(self._live) <= 1:
+                raise ValueError("cannot kill the last live shard")
+            self._dead.add(shard)
+        dead_queue = self.queues[shard]
+        dead_queue.freeze()  # keep accepting adds; stop handing out items
+        for t in self._threads_by_shard.get(shard, ()):
+            if t is not threading.current_thread():
+                t.join(timeout=30.0)
+        with self._shard_lock:
+            self._live.remove(shard)
+            moved = dead_queue.drain_pending()
+            for item, lane in moved:
+                self._queue_for_locked(item).add(item, lane=lane)
+        dead_queue.shutdown()
+        self._update_depth_metrics()
+        return len(moved)
+
+    def live_shards(self) -> list[int]:
+        with self._shard_lock:
+            return list(self._live)
+
+    def _update_depth_metrics(self) -> None:
+        depth = 0
+        lane_depths: dict[str, int] = {}
+        for i, q in enumerate(self.queues):
+            if i in self._dead:
+                continue
+            depth += len(q)
+            for lane, n in q.lane_depths().items():
+                lane_depths[lane] = lane_depths.get(lane, 0) + n
+        OPERATOR_METRICS.workqueue_depth.labels(
+            controller=self.name).set(depth)
+        for lane, n in lane_depths.items():
+            OPERATOR_METRICS.workqueue_lane_depth.labels(
+                controller=self.name, lane=lane).set(n)
+
     def watch(self, api_version: str, kind: str,
               predicate: Callable[[WatchEvent, Optional[dict]], bool] = any_event,
-              mapper: Callable[[WatchEvent], Iterable[Request]] = enqueue_object) -> None:
+              mapper: Callable[[WatchEvent], Iterable[Request]] = enqueue_object,
+              lane: Optional[str] = None) -> None:
+        """Register a watch. ``lane`` declares the priority lane every
+        request mapped from this watch enqueues under (health >
+        placement > bulk; default bulk) — e.g. a node-conditions watch
+        declares ``health`` so its events preempt rollout churn."""
         def handler(event: WatchEvent):
             key = (api_version, kind, namespace_of(event.obj), name_of(event.obj))
             with self._seen_lock:
@@ -188,20 +346,22 @@ class Controller:
                 if not predicate(event, old):
                     return
                 for req in mapper(event):
-                    self.queue.add(req)
-                OPERATOR_METRICS.workqueue_depth.labels(
-                    controller=self.name).set(len(self.queue))
+                    self.enqueue(req, lane=lane)
+                self._update_depth_metrics()
             except Exception:  # watch handlers must never kill the stream
                 log.exception("[%s] watch handler failed for %s/%s",
                               self.name, kind, name_of(event.obj))
 
         self._watch_cancels.append(self.client.watch(api_version, kind, handler))
 
-    def _worker(self):
+    def _worker(self, shard: int = 0):
         from .tracing import TRACER
+        queue = self.queues[shard]
         while not self._stopped.is_set():
-            req, waited = self.queue.get_with_wait(timeout=0.5)
+            req, waited, lane = queue.get_with_info(timeout=0.5)
             if req is None:
+                if shard in self._dead:
+                    return  # shard killed: worker group retires
                 continue
             OPERATOR_METRICS.workqueue_queue_duration.labels(
                 controller=self.name).set(waited)
@@ -217,34 +377,40 @@ class Controller:
                 with TRACER.trace(self.name, str(req), queue_wait_s=waited):
                     result = self.reconciler.reconcile(req)
                 self._count_reconcile(error=False)
+                # re-adds route through the live-shard mapping, not this
+                # worker's queue: after a failover the key may belong to
+                # a different shard than it was dequeued from
                 if result and result.requeue_after > 0:
-                    self.queue.forget(req)
-                    self.queue.add_after(req, result.requeue_after)
+                    queue.forget(req)
+                    self._requeue_after(req, result.requeue_after)
                 elif result and result.requeue:
                     # keep the failure count: repeated requeue=True must back
                     # off toward the 3s cap, like controller-runtime
-                    self.queue.add_rate_limited(req)
+                    self._requeue_rate_limited(req)
                 else:
-                    self.queue.forget(req)
+                    queue.forget(req)
             except Exception:
                 self._count_reconcile(error=True)
                 log.exception("[%s] reconcile %s failed", self.name, req)
-                self.queue.add_rate_limited(req)
+                self._requeue_rate_limited(req)
             finally:
-                self.queue.done(req)
-                OPERATOR_METRICS.workqueue_depth.labels(
-                    controller=self.name).set(len(self.queue))
+                queue.done(req)
+                self._update_depth_metrics()
 
     def start(self):
-        for i in range(self.workers):
-            t = threading.Thread(target=self._worker,
-                                 name=f"ctrl-{self.name}-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+        for shard in range(self.shards):
+            for i in range(self.workers):
+                t = threading.Thread(
+                    target=self._worker, kwargs={"shard": shard},
+                    name=f"ctrl-{self.name}-s{shard}-{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
+                self._threads_by_shard[shard].append(t)
 
     def stop(self):
         self._stopped.set()
-        self.queue.shutdown()
+        for q in self.queues:
+            q.shutdown()
         for cancel in self._watch_cancels:
             cancel()
         # join the workers: stop() returning must mean no reconcile is
@@ -269,7 +435,8 @@ class Controller:
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self.queue.snapshot().idle(horizon=horizon):
+            if all(self.queues[i].snapshot().idle(horizon=horizon)
+                   for i in self.live_shards()):
                 return True
             time.sleep(0.01)
         return False
@@ -288,6 +455,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
         elif url.path == "/metrics":
             from ..metrics.registry import render_prometheus
             body, code = render_prometheus().encode(), 200
+        elif url.path == "/debug/cache":
+            import json
+
+            cache = self.manager.find_cache()
+            if cache is None:
+                body = b'{"cached": false}'
+            else:
+                body = json.dumps({"cached": True, **cache.cache_stats()},
+                                  sort_keys=True).encode()
+            code = 200
+            ctype = "application/json"
         elif url.path == "/debug/traces":
             import json
 
@@ -338,7 +516,8 @@ class Manager:
     def __init__(self, client: Client, namespace: str = "tpu-operator",
                  health_port: Optional[int] = None,
                  leader_elect: bool = False,
-                 on_lost_leadership: Optional[Callable[[], None]] = None):
+                 on_lost_leadership: Optional[Callable[[], None]] = None,
+                 write_qps: Optional[float] = None):
         self.client = client
         self.namespace = namespace
         self.controllers: list[Controller] = []
@@ -347,6 +526,25 @@ class Manager:
         self.leader_elect = leader_elect
         self.elector = None
         self._on_lost = on_lost_leadership or self._default_on_lost
+        # ONE token bucket for the whole manager: per-controller write
+        # gates all draw from this shared budget (OPERATOR_WRITE_QPS;
+        # <=0 = unlimited, the pre-budget behavior)
+        qps = env_write_qps() if write_qps is None else write_qps
+        self.write_budget = WriteBudget(qps)
+
+    def find_cache(self):
+        """The CachedClient in this manager's client chain, if any —
+        tracing/throttling wrappers are unwrapped via their ``inner``
+        links (the /debug/cache and cache-metrics source)."""
+        from .cache import CachedClient
+
+        c, hops = self.client, 0
+        while c is not None and hops < 8:
+            if isinstance(c, CachedClient):
+                return c
+            c = getattr(c, "inner", None)
+            hops += 1
+        return None
 
     @staticmethod
     def _default_on_lost():  # pragma: no cover - process exit
@@ -357,9 +555,20 @@ class Manager:
 
     def add_reconciler(self, reconciler: Reconciler,
                        rate_limiter: Optional[RateLimiter] = None,
-                       workers: int = 1) -> Controller:
-        ctrl = Controller(reconciler.name, reconciler, self.client,
-                          rate_limiter, workers=workers)
+                       workers: int = 1,
+                       shards: Optional[int] = None) -> Controller:
+        client = self.client
+        if self.write_budget.qps > 0:
+            client = ThrottledWriteClient(client, self.write_budget,
+                                          reconciler.name)
+            # reconcilers are constructed with the manager's client; when
+            # the write budget is on, re-point them at their gated view so
+            # their writes actually draw tokens (only when they hold the
+            # exact manager client — a custom client stays untouched)
+            if getattr(reconciler, "client", None) is self.client:
+                reconciler.client = client
+        ctrl = Controller(reconciler.name, reconciler, client,
+                          rate_limiter, workers=workers, shards=shards)
         self.controllers.append(ctrl)
         reconciler.setup_controller(ctrl, self)  # type: ignore[attr-defined]
         return ctrl
