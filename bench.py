@@ -572,6 +572,29 @@ def _controlplane_doc() -> dict | None:
                     fl["fleet_p99_queue_ms"], 4)
             except Exception as e:
                 doc["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+        # causal-lineage stamping overhead on the hot enqueue/dequeue
+        # path (its own try for the same reason as rollout's).
+        # lineage_overhead_ratio at top level is the headline figure
+        # tests/test_bench_guard.py tracks: paired-median on/off ratio,
+        # so machine speed cancels out.
+        try:
+            from tpu_operator.benchmarks.controlplane import (
+                run_lineage_bench,
+            )
+
+            lb = run_lineage_bench()
+            doc["lineage"] = {
+                "items": lb["items"],
+                "rounds": lb["rounds"],
+                "cause_ns_per_op": round(lb["cause_ns_per_op"], 1),
+                "bare_ns_per_op": round(lb["bare_ns_per_op"], 1),
+                "overhead_ratio": round(
+                    lb["lineage_overhead_ratio"], 4),
+            }
+            doc["lineage_overhead_ratio"] = round(
+                lb["lineage_overhead_ratio"], 4)
+        except Exception as e:
+            doc["lineage"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

@@ -191,6 +191,29 @@ def test_fleet_bytes_per_node_not_regressed():
         f"{latest:.0f}B regressed >25% vs best on record ({best:.0f}B)")
 
 
+def test_lineage_overhead_not_regressed():
+    """Same contract again, for the causal-lineage stamping overhead on
+    the hot enqueue/dequeue path (benchmarks.controlplane.
+    run_lineage_bench): the latest round's lineage_overhead_ratio (a
+    paired-median on/off ratio, so machine speed cancels out) may be at
+    most 25% above the best on record. Skips until a round carrying the
+    key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "lineage_overhead_ratio")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records lineage_overhead_ratio yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} lineage_overhead_ratio="
+        f"{latest:.4f} regressed >25% vs best on record ({best:.4f})")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

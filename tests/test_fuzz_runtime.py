@@ -214,7 +214,7 @@ class TestLaneDisciplineProperties:
             q.add(key, lane=lane)
         served = []
         while True:
-            item, _, lane = q.get_with_info(timeout=0)
+            item, _, lane, _ = q.get_with_info(timeout=0)
             if item is None:
                 break
             served.append((item, lane))
@@ -243,8 +243,9 @@ class TestLaneDisciplineProperties:
         queues[dead].freeze()
         moved = queues[dead].drain_pending()
         survivors = [s for s in live if s != dead]
-        for item, lane in moved:
-            queues[shard_of(item, survivors)].add(item, lane=lane)
+        for item, lane, causes in moved:
+            queues[shard_of(item, survivors)].add(item, lane=lane,
+                                                  cause=causes)
         after = set()
         for s in survivors:
             after |= set(queues[s].snapshot().queued)

@@ -1,0 +1,500 @@
+"""Causal lineage plane: Cause stamping/merging on the workqueue,
+the per-object TimelineRecorder, the SLO burn-rate engine, the
+/debug/timeline + /debug/slo endpoints, and the `tpuop-cfg why` /
+`tpuop-cfg slo` renderers.
+
+The queue-side tests pin the semantics the manager and the chaos runner
+both rely on: coalesced re-adds MERGE causes (bounded, earliest-wins,
+dedup'd), `add()` reports fresh-vs-coalesced so timeline attribution
+records each bought reconcile exactly once, and the satellite fix —
+queue-wait attribution on a dirty re-add starts at the FIRST re-add,
+not at done().
+"""
+
+import json
+import time
+
+import pytest
+
+from tpu_operator.runtime.workqueue import (
+    LANE_BULK,
+    LANE_HEALTH,
+    MAX_CAUSES,
+    Cause,
+    WorkQueue,
+)
+
+
+class TestCauseStamping:
+    def test_fresh_add_carries_cause_through_dequeue(self):
+        q = WorkQueue()
+        c = Cause(reason="watch:ADDED", origin="Node/tpu-0", trace_id=3)
+        assert q.add("a", cause=c) is True
+        item, _, _, causes = q.get_with_info(timeout=0)
+        assert item == "a"
+        assert causes == (c,)
+        # causes are popped with the item, not leaked for the next run
+        q.done("a")
+        q.add("a")
+        assert q.get_with_info(timeout=0)[3] == ()
+
+    def test_coalesce_merges_and_dedups_causes(self):
+        q = WorkQueue()
+        c1 = Cause(reason="watch:ADDED", origin="Node/tpu-0")
+        c2 = Cause(reason="watch:MODIFIED", origin="Node/tpu-1")
+        assert q.add("a", cause=c1) is True
+        assert q.add("a", cause=c2) is False      # coalesced, cause kept
+        assert q.add("a", cause=c1) is False      # exact dup collapses
+        _, _, _, causes = q.get_with_info(timeout=0)
+        assert causes == (c1, c2)
+
+    def test_cause_list_is_bounded_earliest_win(self):
+        q = WorkQueue()
+        first = Cause(reason="r0", origin="o0")
+        q.add("a", cause=first)
+        for i in range(1, MAX_CAUSES + 5):
+            q.add("a", cause=Cause(reason=f"r{i}", origin=f"o{i}"))
+        _, _, _, causes = q.get_with_info(timeout=0)
+        assert len(causes) == MAX_CAUSES
+        # the earliest causes explain the re-run; late storm entries drop
+        assert causes[0] == first
+        assert causes[-1].reason == f"r{MAX_CAUSES - 1}"
+
+    def test_delayed_add_stamps_cause_at_promotion(self):
+        q = WorkQueue()
+        c = Cause(reason="retry-backoff", origin="upgrade", trace_id=9)
+        q.add_after("a", 0.01, cause=c)
+        item, _, _, causes = q.get_with_info(timeout=1.0)
+        assert item == "a"
+        assert causes == (c,)
+
+    def test_dirty_readd_of_inflight_key_reports_fresh_once(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get(timeout=0) == "a"            # in flight
+        c = Cause(reason="watch:MODIFIED", origin="Node/tpu-2")
+        assert q.add("a", cause=c) is True        # first dirty mark
+        assert q.add("a", cause=c) is False       # coalesced behind it
+        q.done("a")                               # dirty => re-filed
+        _, _, _, causes = q.get_with_info(timeout=0)
+        assert causes == (c,)
+
+    def test_drain_pending_transfers_causes(self):
+        # the shard-failover path: queued + delayed keys move with their
+        # provenance, and re-adding (item, lane, causes) on the target
+        # shard round-trips the whole list
+        q = WorkQueue()
+        c1 = Cause(reason="watch:ADDED", origin="Node/tpu-0")
+        c2 = Cause(reason="requeue-after", origin="slicerequest")
+        q.add("a", lane=LANE_HEALTH, cause=c1)
+        q.add_after("b", 30.0, cause=c2)
+        moved = q.drain_pending()
+        assert sorted((i, lane, causes) for i, lane, causes in moved) == [
+            ("a", LANE_HEALTH, (c1,)), ("b", LANE_BULK, (c2,))]
+        assert len(q) == 0
+        target = WorkQueue()
+        xfer = Cause(reason="failover-transfer", origin="upgrade:shard0")
+        for item, lane, causes in moved:
+            target.add(item, lane=lane, cause=causes + (xfer,))
+        _, _, lane, causes = target.get_with_info(timeout=0)
+        assert lane == LANE_HEALTH and causes == (c1, xfer)
+
+    def test_cause_to_dict_omits_empty_fields(self):
+        assert Cause(reason="requeue").to_dict() == {"reason": "requeue"}
+        assert Cause(reason="watch:ADDED", origin="Node/n", trace_id=4
+                     ).to_dict() == {"reason": "watch:ADDED",
+                                     "origin": "Node/n", "trace_id": 4}
+
+
+class TestQueueWaitAttribution:
+    """Satellite fix: a re-enqueue of an already-queued / in-flight key
+    keeps the EARLIEST enqueue stamp, so the queue-time histogram
+    charges the full wait, not just the tail after the last coalesce."""
+
+    def test_coalesced_readd_keeps_earliest_stamp(self):
+        q = WorkQueue()
+        q.add("a")
+        time.sleep(0.05)
+        q.add("a")                                # coalesced duplicate
+        _, waited, _, _ = q.get_with_info(timeout=0)
+        assert waited >= 0.05
+
+    def test_dirty_readd_waits_from_first_readd_not_done(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get(timeout=0) == "a"            # in flight
+        q.add("a")                                # dirty mark: clock starts
+        time.sleep(0.05)
+        q.add("a")                                # later coalesce: no reset
+        time.sleep(0.02)
+        q.done("a")                               # re-filed now
+        _, waited, _, _ = q.get_with_info(timeout=0)
+        assert waited >= 0.07                     # from FIRST re-add
+
+
+class TestTimelineRecorder:
+    def _recorder(self, **kw):
+        from tpu_operator.runtime.timeline import TimelineRecorder
+
+        ticks = iter(range(1, 10_000))
+        kw.setdefault("clock", lambda: float(next(ticks)))
+        kw.setdefault("enabled", True)
+        return TimelineRecorder(**kw)
+
+    def test_record_and_timeline_round_trip(self):
+        tl = self._recorder()
+        c = Cause(reason="watch:ADDED", origin="Node/tpu-0", trace_id=1)
+        tl.record("SliceRequest", "default/r1", "enqueue", causes=(c,))
+        tl.record("SliceRequest", "default/r1", "placed",
+                  {"pool": "p0", "score": "1.5"})
+        events = tl.timeline("SliceRequest", "default/r1")
+        assert [e["event"] for e in events] == ["enqueue", "placed"]
+        assert events[0]["causes"] == [c.to_dict()]
+        assert events[1]["detail"] == {"pool": "p0", "score": "1.5"}
+        assert tl.timeline("SliceRequest", "missing") == []
+
+    def test_ring_bounds_history_per_key(self):
+        tl = self._recorder(ring=4)
+        for i in range(10):
+            tl.record("K", "n", f"e{i}")
+        events = tl.timeline("K", "n")
+        assert [e["event"] for e in events] == ["e6", "e7", "e8", "e9"]
+
+    def test_lru_evicts_coldest_key(self):
+        tl = self._recorder(max_keys=2)
+        tl.record("K", "a", "e")
+        tl.record("K", "b", "e")
+        tl.record("K", "a", "e")                  # touch a => b coldest
+        tl.record("K", "c", "e")                  # evicts b
+        assert tl.keys() == [("K", "a"), ("K", "c")]
+
+    def test_snapshot_is_sorted_and_json_safe(self):
+        tl = self._recorder()
+        tl.record("Zeta", "z", "e")
+        tl.record("Alpha", "a", "e")
+        snap = tl.snapshot()
+        assert list(snap) == ["Alpha/a", "Zeta/z"]
+        json.dumps(snap)                          # must serialize as-is
+
+    def test_disabled_recorder_is_a_no_op(self):
+        tl = self._recorder(enabled=False)
+        tl.record("K", "n", "e")
+        assert tl.keys() == []
+
+    def test_reset_clears_and_swaps_clock(self):
+        tl = self._recorder()
+        tl.record("K", "n", "e")
+        tl.reset(clock=lambda: 42.0)
+        assert tl.keys() == []
+        tl.record("K", "n", "e")
+        assert tl.timeline("K", "n")[0]["ts"] == 42.0
+
+
+class TestBurnVerdict:
+    def test_burn_rate_math(self):
+        from tpu_operator.metrics.slo import burn_verdict
+
+        # 5% errors against a 1% budget burns 5x
+        v = burn_verdict(95.0, 5.0, objective=0.99, threshold=2.0)
+        assert v["error_rate"] == 0.05
+        assert v["burn_rate"] == 5.0
+        assert v["budget_remaining"] == 0.0
+        assert v["breached"] is True
+        # same split, laxer objective: under threshold
+        v = burn_verdict(95.0, 5.0, objective=0.90, threshold=2.0)
+        assert v["burn_rate"] == 0.5
+        assert v["breached"] is False
+
+    def test_no_events_is_trivially_met(self):
+        from tpu_operator.metrics.slo import burn_verdict
+
+        v = burn_verdict(0.0, 0.0, objective=0.99, threshold=0.0)
+        assert v["burn_rate"] == 0.0 and v["breached"] is False
+
+
+class TestSLOEngine:
+    def _engine(self, clock):
+        from prometheus_client import CollectorRegistry, Counter
+
+        from tpu_operator.metrics.slo import SLOEngine, SLOSpec
+
+        reg = CollectorRegistry()
+        ctr = Counter("tpu_operator_demo", "demo", ["outcome"],
+                      registry=reg)
+        spec = SLOSpec(
+            name="demo-success", description="demo", objective=0.90,
+            sli="ratio", counter="tpu_operator_demo_total",
+            label="outcome", good=("ok",), bad=("err",),
+            windows=(("fast", 60.0, 2.0), ("slow", 600.0, 1.0)))
+        return SLOEngine(specs=(spec,), registry=reg, clock=clock), ctr
+
+    def test_windowed_burn_breaches_only_when_all_windows_burn(self):
+        now = [0.0]
+        engine, ctr = self._engine(lambda: now[0])
+        # long healthy history fills the slow window with good events
+        for _ in range(20):
+            ctr.labels(outcome="ok").inc(10)
+            engine.evaluate()
+            now[0] += 30.0
+        report = engine.evaluate()
+        slo = report["slos"][0]
+        assert slo["breached"] is False
+        # a sudden error cliff: the fast window burns hot; the slow
+        # window, diluted by history, decides whether it pages
+        ctr.labels(outcome="err").inc(200)
+        now[0] += 30.0
+        report = engine.evaluate()
+        slo = report["slos"][0]
+        assert slo["windows"]["fast"]["breached"] is True
+        assert slo["breached"] is slo["windows"]["slow"]["breached"]
+        assert slo["windows"]["fast"]["burn_rate"] > \
+            slo["windows"]["slow"]["burn_rate"]
+
+    def test_query_window_rides_along(self):
+        now = [0.0]
+        engine, ctr = self._engine(lambda: now[0])
+        ctr.labels(outcome="ok").inc(5)
+        report = engine.evaluate(extra_window_s=7.5)
+        w = report["slos"][0]["windows"]["query"]
+        assert w["seconds"] == 7.5 and w["good"] == 5.0
+
+    def test_default_engine_exports_gauges(self):
+        from tpu_operator.metrics.registry import render_prometheus
+        from tpu_operator.metrics.slo import SLO_ENGINE
+
+        report = SLO_ENGINE.evaluate()
+        assert {s["name"] for s in report["slos"]} >= {
+            "convergence-latency", "health-lane-queue",
+            "migration-success", "placement-latency"}
+        text = render_prometheus()
+        for series in ("tpu_operator_slo_burn_rate",
+                       "tpu_operator_slo_error_budget_remaining",
+                       "tpu_operator_slo_breached"):
+            assert f'{series}{{slo="convergence-latency"' in text, series
+        assert 'window="fast"' in text and 'window="slow"' in text
+
+
+@pytest.fixture()
+def health_port():
+    from tpu_operator.runtime import FakeClient
+    from tpu_operator.runtime.manager import Manager
+
+    mgr = Manager(FakeClient(), namespace="tpu-operator", health_port=0)
+    mgr.start()
+    try:
+        yield mgr._http.server_address[1]
+    finally:
+        mgr.stop()
+
+
+def _get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestDebugEndpoints:
+    def test_timeline_endpoint_serves_recorded_events(self, health_port):
+        from tpu_operator.runtime.timeline import TIMELINE
+
+        prev = TIMELINE.enabled
+        TIMELINE.reset(enabled=True)
+        try:
+            TIMELINE.record("SliceRequest", "default/r1", "placed",
+                            {"pool": "p0"})
+            status, doc = _get(
+                health_port,
+                "/debug/timeline?kind=SliceRequest&name=default/r1")
+        finally:
+            TIMELINE.reset(enabled=prev)
+        assert status == 200
+        assert doc["count"] == 1
+        assert doc["events"][0]["event"] == "placed"
+
+    @pytest.mark.parametrize("query", [
+        "",                                   # both missing
+        "kind=SliceRequest",                  # name missing
+        "name=default/r1",                    # kind missing
+        "kind=Slice%20Request&name=r1",       # space in kind
+        "kind=K&name=a%0ab",                  # control char in name
+    ])
+    def test_timeline_endpoint_rejects_bad_params(self, health_port,
+                                                  query):
+        status, doc = _get(health_port, "/debug/timeline?" + query)
+        assert status == 400
+        assert "kind and name" in doc["error"]
+
+    def test_slo_endpoint_serves_report(self, health_port):
+        status, doc = _get(health_port, "/debug/slo?window=120")
+        assert status == 200
+        names = {s["name"] for s in doc["slos"]}
+        assert "convergence-latency" in names
+        assert all("query" in s["windows"] for s in doc["slos"])
+
+    @pytest.mark.parametrize("query", ["window=bogus", "window=0",
+                                       "window=-5"])
+    def test_slo_endpoint_rejects_bad_window(self, health_port, query):
+        status, doc = _get(health_port, "/debug/slo?" + query)
+        assert status == 400
+        assert "window" in doc["error"]
+
+
+class TestWhyCLI:
+    def _snapshot_file(self, tmp_path):
+        snap = {"SliceRequest/default/r1": [
+            {"ts": 1.0, "event": "enqueue",
+             "causes": [{"reason": "watch:ADDED", "origin": "Node/tpu-0",
+                         "trace_id": 3}]},
+            {"ts": 2.0, "event": "placed",
+             "detail": {"pool": "p0", "score": "1.500000"}},
+            {"ts": 3.0, "event": "migration:Resumed",
+             "detail": {"restoredStep": 40}},
+        ]}
+        f = tmp_path / "timeline.json"
+        f.write_text(json.dumps(snap))
+        return f
+
+    def test_why_renders_causal_story_from_file(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = self._snapshot_file(tmp_path)
+        rc = main(["why", "SliceRequest/default/r1", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "SliceRequest/default/r1 — 3 event(s)" in out
+        assert "<- watch:ADDED Node/tpu-0 (trace #3)" in out
+        assert "migration:Resumed" in out and "restoredStep=40" in out
+
+    def test_why_json_output(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = self._snapshot_file(tmp_path)
+        rc = main(["why", "SliceRequest/default/r1", "-f", str(f), "-o",
+                   "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["count"] == 3
+
+    def test_why_rejects_bare_object(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        rc = main(["why", "just-a-name", "-f", "unused"])
+        assert rc == 1
+        assert "Kind" in capsys.readouterr().err
+
+    def test_why_empty_timeline_exits_nonzero(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = self._snapshot_file(tmp_path)
+        rc = main(["why", "SliceRequest/default/ghost", "-f", str(f)])
+        assert rc == 1
+        assert "no timeline recorded" in capsys.readouterr().out
+
+    def test_why_against_live_endpoint(self, health_port, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+        from tpu_operator.runtime.timeline import TIMELINE
+
+        prev = TIMELINE.enabled
+        TIMELINE.reset(enabled=True)
+        try:
+            TIMELINE.record("TPUClusterPolicy", "p1", "reconcile",
+                            {"outcome": "ok"})
+            rc = main(["why", "TPUClusterPolicy/p1", "--url",
+                       f"http://127.0.0.1:{health_port}"])
+        finally:
+            TIMELINE.reset(enabled=prev)
+        assert rc == 0
+        assert "reconcile" in capsys.readouterr().out
+
+
+class TestSloCLI:
+    def _report(self, breached):
+        return {"evaluated_at": 1.0, "slos": [{
+            "name": "migration-success", "description": "d",
+            "objective": 0.90, "sli": "ratio", "breached": breached,
+            "budget_remaining": 0.0 if breached else 1.0,
+            "total": {"good": 2.0, "bad": 6.0 if breached else 0.0,
+                      "error_rate": 0.75 if breached else 0.0},
+            "windows": {"fast": {
+                "burn_rate": 7.5 if breached else 0.0, "threshold": 2.0,
+                "seconds": 300.0, "breached": breached}},
+        }]}
+
+    def test_slo_healthy_exits_zero(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = tmp_path / "slo.json"
+        f.write_text(json.dumps(self._report(breached=False)))
+        rc = main(["slo", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "migration-success" in out and "ok" in out
+        assert "breached:" not in out
+
+    def test_slo_breach_exits_two(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        f = tmp_path / "slo.json"
+        f.write_text(json.dumps(self._report(breached=True)))
+        rc = main(["slo", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "BREACHED" in out
+        assert "breached: migration-success" in out
+
+    def test_slo_against_live_endpoint(self, health_port, capsys):
+        from tpu_operator.cli.tpuop_cfg import main
+
+        rc = main(["slo", "--url", f"http://127.0.0.1:{health_port}",
+                   "--window", "60"])
+        out = capsys.readouterr().out
+        assert rc in (0, 2)                   # registry state is shared
+        assert "convergence-latency" in out
+
+
+class TestMustGatherLineage:
+    def test_bundle_carries_timeline_slo_and_cache(self, tmp_path):
+        from tpu_operator.cli.must_gather import main
+        from tpu_operator.runtime.timeline import TIMELINE
+
+        prev = TIMELINE.enabled
+        TIMELINE.reset(enabled=True)
+        try:
+            TIMELINE.record("TPUClusterPolicy", "tpu-cluster-policy",
+                            "reconcile", {"outcome": "ok"})
+            out = tmp_path / "mg"
+            rc = main(["-o", str(out), "--fake-demo"])
+        finally:
+            TIMELINE.reset(enabled=prev)
+        assert rc == 0
+        snap = json.loads((out / "timeline" / "timeline.json").read_text())
+        assert "TPUClusterPolicy/tpu-cluster-policy" in snap
+        slo = json.loads((out / "slo" / "slo.json").read_text())
+        assert {s["name"] for s in slo["slos"]} >= {"migration-success"}
+        summary = json.loads((out / "summary.json").read_text())
+        assert summary["timeline_objects"] >= 1
+        assert summary["slo_rendered"] is True
+
+    def test_bundle_carries_cache_stats_from_cached_client(self, tmp_path):
+        # the PR 8 informer-cache picture the bundle used to miss:
+        # gather() unwraps the client stack to find cache_stats()
+        from tpu_operator.cli.must_gather import gather
+        from tpu_operator.runtime import CachedClient, FakeClient
+
+        fake = FakeClient()
+        fake.add_node("tpu-0", labels={}, allocatable={})
+        cached = CachedClient(fake)
+        try:
+            cached.list("v1", "Node")             # warm the informer
+            out = tmp_path / "mg"
+            summary = gather(cached, out)
+        finally:
+            cached.close()
+        assert summary["cache_rendered"] is True
+        stats = json.loads((out / "cache" / "cache.json").read_text())
+        assert isinstance(stats, dict) and stats

@@ -499,7 +499,7 @@ def drain_with_lanes(q):
     """Pop everything, returning [(item, lane)] in service order."""
     out = []
     while True:
-        item, _, lane = q.get_with_info(timeout=0)
+        item, _, lane, _ = q.get_with_info(timeout=0)
         if item is None:
             return out
         out.append((item, lane))

@@ -319,6 +319,66 @@ class TestTracerOverhead:
             f"(diffs ms: {[round(d * 1000, 2) for d in diffs]})")
 
 
+    def test_cause_stamping_overhead_under_5_percent_800_nodes(self):
+        """The lineage plane rides the same budget: stamping a Cause on
+        every enqueue and surfacing it at dequeue must stay <5% of the
+        bare enqueue/dequeue wall at the 800-node fleet smoke scale.
+        Same ABBA paired-median shape as the tracing test above, and the
+        same kill switch: OPERATOR_TRACE=0 means the watch handler
+        passes cause=None, which this measures as the bare arm."""
+        import statistics
+        import time
+
+        from tpu_operator.runtime.tracing import env_trace_enabled
+        from tpu_operator.runtime.workqueue import Cause, WorkQueue
+
+        # OPERATOR_TRACE=0 really reads as off — the manager's watch
+        # handler then never constructs a Cause, restoring the bare arm
+        assert env_trace_enabled({"OPERATOR_TRACE": "0"}) is False
+        assert env_trace_enabled({"OPERATOR_TRACE": "1"}) is True
+
+        items = [f"tpu-{i}" for i in range(880)]  # 800 TPU + heads
+        cause = Cause(reason="watch:MODIFIED", origin="Node/tpu-0",
+                      trace_id=7)
+
+        def timed_pass(with_cause):
+            q = WorkQueue()
+            stamped = 0
+            t0 = time.perf_counter()
+            for it in items:
+                q.add(it, cause=cause if with_cause else None)
+            while True:
+                item, _, _, causes = q.get_with_info(timeout=0)
+                if item is None:
+                    break
+                stamped += len(causes)
+                q.done(item)
+            dt = time.perf_counter() - t0
+            # kill-switch arm carries no lineage at dequeue; the traced
+            # arm carries exactly one Cause per item
+            assert stamped == (len(items) if with_cause else 0)
+            q.shutdown()
+            return dt
+
+        for _ in range(3):                   # warm both paths
+            timed_pass(True)
+            timed_pass(False)
+
+        diffs, offs = [], []
+        for i in range(10):                  # ABBA: off,on / on,off ...
+            order = (False, True) if i % 2 == 0 else (True, False)
+            pair = {on: timed_pass(on) for on in order}
+            offs.append(pair[False])
+            diffs.append(pair[True] - pair[False])
+
+        overhead = statistics.median(diffs)
+        floor = min(offs)
+        assert overhead <= floor * 0.05 + 0.002 * load_factor(), (
+            f"cause stamping blew the 5% budget: median delta "
+            f"{overhead * 1000:.3f}ms on a {floor * 1000:.3f}ms pass "
+            f"(diffs ms: {[round(d * 1000, 3) for d in diffs]})")
+
+
 class TestFleetBench:
     """run_fleet_bench: the 10k-node survivability figures. The full 10k
     run is slow-tier; a scaled-down pass rides tier-1 so the bench code

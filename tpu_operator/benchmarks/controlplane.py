@@ -796,7 +796,7 @@ def _lane_churn(churn_items: int) -> Dict:
     max_depth: Dict[str, int] = {lane: 0 for lane in LANES}
 
     def pop_one() -> bool:
-        item, waited, lane = q.get_with_info(timeout=0)
+        item, waited, lane, _ = q.get_with_info(timeout=0)
         if item is None:
             return False
         waits[lane].append(waited)
@@ -972,4 +972,61 @@ def run_fleet_bench(n_tpu: int = 10000, baseline_tpu: int = 500,
         "lane_max_depth": lanes["max_depth"],
         "lane_served": lanes["served"],
         "max_rss_mb": rss_mb,
+    }
+
+
+def run_lineage_bench(items: int = 20000, rounds: int = 5) -> Dict:
+    """Cost of the cause-stamping lineage plane on the workqueue hot
+    path: enqueue+dequeue ``items`` keys per round, once with a
+    :class:`~tpu_operator.runtime.workqueue.Cause` stamped per add (and
+    popped via ``get_with_info``) and once bare — ABBA-interleaved and
+    paired per round, same discipline as the tracer-overhead scale test,
+    so ambient machine drift cancels. The guard figure is the median
+    paired overhead ratio: cause stamping must stay within a few percent
+    of the bare path or the OPERATOR_TRACE kill switch stops being a
+    choice at fleet scale."""
+    import statistics
+
+    from ..runtime.workqueue import Cause, WorkQueue
+
+    cause = Cause(reason="watch:MODIFIED", origin="Node/bench", trace_id=7)
+
+    def run_once(with_cause: bool) -> float:
+        q = WorkQueue()
+        batch = 64  # queue a small batch then drain: the real add/pop
+        stamp = cause if with_cause else None  # mix, queue never balloons
+        t0 = time.perf_counter()
+        for base in range(0, items, batch):
+            for i in range(base, min(base + batch, items)):
+                q.add(i, cause=stamp)
+            while True:
+                item, _, _, _ = q.get_with_info(timeout=0)
+                if item is None:
+                    break
+                q.done(item)
+        dt = time.perf_counter() - t0
+        q.shutdown()
+        return dt
+
+    run_once(True)
+    run_once(False)  # warm-up both paths
+    ratios, on_times, off_times = [], [], []
+    for _ in range(rounds):
+        a_on = run_once(True)       # ABBA: on/off/off/on per round
+        a_off = run_once(False)
+        b_off = run_once(False)
+        b_on = run_once(True)
+        on = (a_on + b_on) / 2.0
+        off = (a_off + b_off) / 2.0
+        on_times.append(on)
+        off_times.append(off)
+        ratios.append(on / off if off else 1.0)
+    on_best, off_best = min(on_times), min(off_times)
+    return {
+        "items": items,
+        "rounds": rounds,
+        "cause_ns_per_op": on_best / items * 1e9,
+        "bare_ns_per_op": off_best / items * 1e9,
+        # the bench-guard figure: median paired causes-on/causes-off
+        "lineage_overhead_ratio": statistics.median(ratios),
     }

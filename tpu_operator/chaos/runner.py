@@ -59,6 +59,9 @@ from ..runtime.client import (
 )
 from ..runtime.fake import simulate_kubelet
 from ..runtime.manager import any_event, enqueue_object, shard_of
+from ..runtime.timeline import TIMELINE
+from ..runtime.tracing import TRACER
+from ..runtime.workqueue import MAX_CAUSES, Cause
 from ..runtime.objects import (
     annotations_of,
     get_nested,
@@ -144,11 +147,15 @@ class _SyncController:
         self.client = client
         self.clock = clock
         self.name = name
+        self.timeline_kind = getattr(reconciler, "primary_kind", None)
         self.shards = max(1, shards)
         self._live: List[int] = list(range(self.shards))
         self._queues: List[Dict[str, List[Request]]] = [
             {lane: [] for lane in LANES} for _ in range(self.shards)]
         self._lane_of: Dict[Request, str] = {}
+        # cause provenance per queued key, same bounded-merge discipline
+        # as the production WorkQueue — popped with the key at drain
+        self._causes: Dict[Request, tuple] = {}
         self._delayed: Dict[Request, float] = {}
         self._last_seen: Dict[tuple, dict] = {}
         self.reconcile_errors = 0
@@ -174,8 +181,18 @@ class _SyncController:
             try:
                 if not predicate(event, old):
                     return
+                cause = None
+                if TRACER.enabled:
+                    # watch delivery is synchronous from the writer, so
+                    # the trace open on this thread IS the reconcile
+                    # whose write fired the event — the causal link
+                    tr = TRACER.current_trace()
+                    cause = Cause(
+                        reason=f"watch:{event.type}",
+                        origin=f"{kind}/{name_of(event.obj)}",
+                        trace_id=tr.seq if tr is not None else -1)
                 for req in mapper(event):
-                    self.add(req, lane=lane)
+                    self.add(req, lane=lane, cause=cause)
             except ApiError:
                 # the mapper's LIST ate an armed fault; the per-tick
                 # resync (and any relist) re-enqueues what this loses
@@ -186,8 +203,33 @@ class _SyncController:
     def _shard_for(self, request: Request) -> int:
         return shard_of(str(request), self._live)
 
-    def add(self, request: Request, lane: Optional[str] = None) -> None:
+    def _stamp_cause(self, request: Request, cause) -> None:
+        if cause is None:
+            return
+        causes = (cause,) if isinstance(cause, Cause) else tuple(cause)
+        cur = self._causes.get(request, ())
+        for c in causes:
+            if len(cur) >= MAX_CAUSES:
+                break
+            if c not in cur:
+                cur = cur + (c,)
+        if cur:
+            self._causes[request] = cur
+
+    def add(self, request: Request, lane: Optional[str] = None,
+            cause=None) -> None:
         lane = lane if lane in LANES else LANE_BULK
+        self._stamp_cause(request, cause)
+        if (cause is not None and self.timeline_kind is not None
+                and TIMELINE.enabled
+                and self._lane_of.get(request) is None):
+            # same per-object enqueue attribution the production
+            # Controller.enqueue records: caused FRESH adds only — the
+            # per-tick resync and coalesced duplicates would be noise
+            TIMELINE.record(self.timeline_kind, str(request), "enqueue",
+                            {"controller": self.name, "lane": lane},
+                            causes=(cause,) if isinstance(cause, Cause)
+                            else tuple(cause))
         cur = self._lane_of.get(request)
         if cur is not None:
             # already queued: promote to the higher-priority lane only
@@ -235,7 +277,9 @@ class _SyncController:
         for lane in LANES:
             for req in dead[lane]:
                 del self._lane_of[req]
-                self.add(req, lane=lane)
+                self.add(req, lane=lane,
+                         cause=Cause(reason="failover-transfer",
+                                     origin=f"{self.name}:shard{shard}"))
                 moved += 1
         self.keys_moved_on_failover += moved
         return moved
@@ -260,7 +304,8 @@ class _SyncController:
                     return req
         return None
 
-    def _schedule(self, request: Request, due: float) -> None:
+    def _schedule(self, request: Request, due: float, cause=None) -> None:
+        self._stamp_cause(request, cause)
         prev = self._delayed.get(request)
         self._delayed[request] = due if prev is None else min(prev, due)
 
@@ -278,18 +323,34 @@ class _SyncController:
             if req is None:
                 break
             done += 1
+            causes = self._causes.pop(req, ())
+            tr = None
             try:
-                result = self.reconciler.reconcile(req)
+                # open the root here (the reconciler's own wrapper nests
+                # as a passthrough) so the popped causes ride the trace —
+                # same dual-path treatment the production _worker gives
+                with TRACER.trace(self.reconciler.name, str(req),
+                                  causes=causes) as t:
+                    tr = t
+                    result = self.reconciler.reconcile(req)
             except ApiError:
                 # an injected 409/429/5xx escaped the reconcile: retry
                 # with a (virtual) delay, like the workqueue rate limiter
                 self.reconcile_errors += 1
-                self._schedule(req, self.clock() + RETRY_DELAY_S)
+                self._schedule(req, self.clock() + RETRY_DELAY_S,
+                               cause=Cause(
+                                   reason="retry-backoff", origin=self.name,
+                                   trace_id=tr.seq if tr else -1))
                 continue
             if result and result.requeue_after > 0:
-                self._schedule(req, self.clock() + result.requeue_after)
+                self._schedule(req, self.clock() + result.requeue_after,
+                               cause=Cause(
+                                   reason="requeue-after", origin=self.name,
+                                   trace_id=tr.seq if tr else -1))
             elif result and result.requeue:
-                self.add(req)
+                self.add(req, cause=Cause(
+                    reason="requeue", origin=self.name,
+                    trace_id=tr.seq if tr else -1))
             self._promote()
         return done
 
@@ -660,6 +721,57 @@ def _migration_summary(fake: FakeClient) -> dict:
     }
 
 
+# the convergence SLO's virtual budget: converging inside this many
+# virtual seconds past the last fault is "good". Generous next to the
+# soak budget (150 passes * 20s) so only a genuinely struggling run
+# burns it — convergence FAILURE already fails the verdict outright.
+CONVERGENCE_SLO_VIRTUAL_S = 600.0
+# single-window burn threshold for the chaos SLO block: the settled
+# store is one window (there is no time series to diff), so the classic
+# fast/slow pair collapses to one threshold
+CHAOS_BURN_THRESHOLD = 2.0
+
+
+def _slo_verdict(scenario: str, out: dict,
+                 conv_s: Optional[float]) -> dict:
+    """Deterministic SLO block for the verdict: settled-store event
+    counts (never wall-clock histograms) fed through the same
+    :func:`~tpu_operator.metrics.slo.burn_verdict` math the production
+    SLOEngine runs, so the verdicts are byte-identical per seed yet
+    exercise the identical formula. Scenarios engineered to violate an
+    objective (slice-migrate's rigid requests, the contention storm's
+    preemptions) must show up in ``breached`` — a chaos invariant."""
+    from ..api.slicerequest import MIG_ABORTED, MIG_RESUMED
+    from ..metrics.slo import burn_verdict
+
+    conv_ok = (out["converged"] and conv_s is not None
+               and conv_s <= CONVERGENCE_SLO_VIRTUAL_S)
+    slos = {
+        # 0/1 SLI: the run either converged inside the virtual budget or
+        # it torched the whole error budget
+        "convergence-latency": burn_verdict(
+            good=1 if conv_ok else 0, bad=0 if conv_ok else 1,
+            objective=0.99, threshold=CHAOS_BURN_THRESHOLD),
+    }
+    pl = out.get("placement")
+    if pl is not None:
+        slos["placement-stability"] = burn_verdict(
+            good=pl["phases"].get(PHASE_PLACED, 0),
+            bad=pl["evictions"],
+            objective=0.90, threshold=CHAOS_BURN_THRESHOLD)
+    mig = out.get("migrations")
+    if mig is not None:
+        slos["migration-success"] = burn_verdict(
+            good=mig["phases"].get(MIG_RESUMED, 0),
+            bad=mig["phases"].get(MIG_ABORTED, 0),
+            objective=0.90, threshold=CHAOS_BURN_THRESHOLD)
+    return {
+        "objective_threshold": CHAOS_BURN_THRESHOLD,
+        "slos": {k: slos[k] for k in sorted(slos)},
+        "breached": sorted(n for n, v in slos.items() if v["breached"]),
+    }
+
+
 # -- scenario driver --------------------------------------------------------
 
 
@@ -704,6 +816,11 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
     clock = VirtualClock()
     prev_clock, prev_enabled = TRACER.clock, TRACER.enabled
     TRACER.reset(clock=clock, enabled=True)
+    # the timeline recorder follows the tracer onto the virtual clock so
+    # per-object timelines embedded in the verdict are part of the same
+    # byte-identical-per-seed output
+    prev_tl_clock, prev_tl_enabled = TIMELINE.clock, TIMELINE.enabled
+    TIMELINE.reset(clock=clock, enabled=True)
     # the DAG scheduler runs in VIRTUAL mode: waves execute sequentially
     # in a seeded shuffle, so branch interleaving is adversarial (a fault
     # lands on a different parallel branch per seed) yet the run stays
@@ -718,6 +835,7 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
     finally:
         DAG_GATE.enabled, DAG_GATE.virtual_rng = prev_dag, prev_rng
         TRACER.reset(clock=prev_clock, enabled=prev_enabled)
+        TIMELINE.reset(clock=prev_tl_clock, enabled=prev_tl_enabled)
 
 
 def _run_scenario_impl(scenario: str, nodes: int, seed: int,
@@ -876,6 +994,17 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             out["placement"] = _placement_summary(fake)
         if scenario == "slice-migrate":
             out["migrations"] = _migration_summary(fake)
+            # the per-object causal story (enqueue causes, migration
+            # phases, placement decisions) rides the verdict for the
+            # migrate scenario — the `tpuop-cfg why` golden chain. Only
+            # the kinds that tell that story: operand write-avoided
+            # noise would dwarf the verdict
+            out["timelines"] = {
+                k: ev for k, ev in TIMELINE.snapshot().items()
+                if k.split("/", 1)[0] in ("SliceRequest",
+                                          "TPUClusterPolicy",
+                                          "UpgradeUnit")}
+        out["slo"] = _slo_verdict(scenario, out, conv_s)
         return out
 
     # baseline convergence — faults only start from a known-good state,

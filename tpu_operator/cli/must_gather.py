@@ -134,6 +134,49 @@ def gather(client, out_dir: pathlib.Path) -> dict:
         summary["traces"] = len(traces)
     except Exception as e:
         summary["errors"].append(f"traces: {e}")
+    try:
+        from ..runtime.timeline import TIMELINE
+
+        snap = TIMELINE.snapshot()
+        d = out_dir / "timeline"
+        d.mkdir(parents=True, exist_ok=True)
+        # one snapshot file (the `tpuop-cfg why -f` input) — per-object
+        # files would explode on a large fleet
+        (d / "timeline.json").write_text(
+            json.dumps(snap, indent=2, sort_keys=True))
+        summary["timeline_objects"] = len(snap)
+    except Exception as e:
+        summary["errors"].append(f"timeline: {e}")
+    try:
+        from ..metrics.slo import SLO_ENGINE
+
+        d = out_dir / "slo"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "slo.json").write_text(
+            json.dumps(SLO_ENGINE.evaluate(), indent=2, sort_keys=True))
+        summary["slo_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"slo: {e}")
+    try:
+        # the informer-cache picture (/debug/cache equivalent): unwrap
+        # the client stack the same way Manager.find_cache does
+        inner, stats = client, None
+        for _ in range(8):
+            if hasattr(inner, "cache_stats"):
+                stats = inner.cache_stats()
+                break
+            nxt = getattr(inner, "inner", None)
+            if nxt is None:
+                break
+            inner = nxt
+        if stats is not None:
+            d = out_dir / "cache"
+            d.mkdir(parents=True, exist_ok=True)
+            (d / "cache.json").write_text(
+                json.dumps(stats, indent=2, sort_keys=True))
+            summary["cache_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"cache: {e}")
 
     (out_dir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary

@@ -592,6 +592,136 @@ def _cache(args) -> int:
     return 0
 
 
+def render_timeline(payload: dict) -> str:
+    """One object's /debug/timeline body as the causal story `why`
+    tells: each event with its timestamp, detail, and the cause chain
+    (reason, origin object, and the trace id of the reconcile whose
+    write fired it) indented under it."""
+    events = payload.get("events") or []
+    lines = [f"{payload.get('kind')}/{payload.get('name')} — "
+             f"{len(events)} event(s)"]
+    for ev in events:
+        detail = ev.get("detail") or {}
+        detail_s = " ".join(f"{k}={detail[k]}" for k in sorted(detail))
+        lines.append(f"  t={ev.get('ts', 0):>10.3f}  "
+                     f"{ev.get('event', ''):<22s} {detail_s}".rstrip())
+        for cause in ev.get("causes") or []:
+            line = f"      <- {cause.get('reason', '')}"
+            if cause.get("origin"):
+                line += f" {cause['origin']}"
+            if cause.get("trace_id", -1) >= 0:
+                line += f" (trace #{cause['trace_id']})"
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def _why(args) -> int:
+    """Answer "why is this object in this state": fetch the object's
+    timeline from the manager's /debug/timeline (or a must-gather
+    timeline dump) and render it as a causal story — every enqueue with
+    its cause chain, reconcile outcome, FSM/migration transition and
+    placement decision, oldest first."""
+    import pathlib
+    import urllib.parse
+    import urllib.request
+
+    if "/" not in args.object:
+        print("object must be <Kind>/[namespace/]<name>", file=sys.stderr)
+        return 1
+    kind, name = args.object.split("/", 1)
+    if args.file:
+        try:
+            data = json.loads(pathlib.Path(args.file).read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read timeline from {args.file}: {e}",
+                  file=sys.stderr)
+            return 1
+        # must-gather dumps TIMELINE.snapshot(): {"Kind/name": [events]}
+        events = data.get(f"{kind}/{name}", []) if isinstance(data, dict) \
+            else data
+        payload = {"kind": kind, "name": name, "count": len(events),
+                   "events": events}
+    else:
+        url = (args.url.rstrip("/") + "/debug/timeline?"
+               + urllib.parse.urlencode({"kind": kind, "name": name}))
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                payload = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    if not payload.get("events"):
+        print(f"no timeline recorded for {kind}/{name} (is the lineage "
+              f"plane enabled? OPERATOR_TRACE=0 disables it)")
+        return 1
+    print(render_timeline(payload))
+    return 0
+
+
+def render_slo_report(report: dict) -> str:
+    """The /debug/slo body as a table: one row per SLO with its
+    objective, breach verdict, remaining error budget, and per-window
+    burn rates."""
+    lines = []
+    for slo in report.get("slos") or []:
+        verdict = "BREACHED" if slo.get("breached") else "ok"
+        total = slo.get("total") or {}
+        lines.append(
+            f"{slo.get('name', ''):<22s} {verdict:<9s}"
+            f" objective={slo.get('objective', 0):.2%}"
+            f" budget={slo.get('budget_remaining', 0):.1%}"
+            f" good={total.get('good', 0):g} bad={total.get('bad', 0):g}")
+        for wname, w in sorted((slo.get("windows") or {}).items()):
+            lines.append(
+                f"    {wname:<6s} burn={w.get('burn_rate', 0):g}"
+                f" (threshold {w.get('threshold', 0):g}"
+                f", {w.get('seconds', 0):g}s)"
+                + ("  BREACHED" if w.get("breached") else ""))
+    return "\n".join(lines) if lines else "no SLOs configured"
+
+
+def _slo(args) -> int:
+    """Fetch the SLO burn-rate report from the manager's /debug/slo (or
+    a must-gather slo.json) and print it; exit 2 when any SLO is
+    breached so the command scripts as a health probe."""
+    import pathlib
+    import urllib.parse
+    import urllib.request
+
+    if args.file:
+        try:
+            report = json.loads(pathlib.Path(args.file).read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read SLO report from {args.file}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        url = args.url.rstrip("/") + "/debug/slo"
+        if args.window is not None:
+            url += "?" + urllib.parse.urlencode({"window": args.window})
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                report = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if not isinstance(report, dict):
+        print("SLO report payload is not an object", file=sys.stderr)
+        return 1
+    breached = [s["name"] for s in report.get("slos") or []
+                if s.get("breached")]
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_slo_report(report))
+        if breached:
+            print("breached: " + ", ".join(sorted(breached)))
+    return 2 if breached else 0
+
+
 def _dag(args) -> int:
     """Render the operand dependency DAG the scheduler compiles at
     startup: every state with its requires(), the parallel sync waves
@@ -876,6 +1006,40 @@ def main(argv=None) -> int:
                     default="text")
     ca.add_argument("--timeout", type=float, default=10.0)
 
+    wy = sub.add_parser(
+        "why", help="per-object causal timeline from /debug/timeline "
+                    "(or a must-gather timeline dump): every enqueue "
+                    "with its cause chain, reconcile outcome, FSM/"
+                    "migration transition and placement decision, in "
+                    "order — 'why is this object in this state'")
+    wy.add_argument("object",
+                    help="<Kind>/[namespace/]<name>, e.g. "
+                         "SliceRequest/tpu-operator/ereq-001")
+    wy.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    wy.add_argument("-f", "--file", default=None,
+                    help="read a must-gather timeline snapshot JSON "
+                         "instead of fetching")
+    wy.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    wy.add_argument("--timeout", type=float, default=10.0)
+
+    so = sub.add_parser(
+        "slo", help="SLO burn-rate report from /debug/slo (or a "
+                    "must-gather slo.json): per-SLO breach verdict, "
+                    "remaining error budget and multi-window burn "
+                    "rates; exit 2 when any SLO is breached")
+    so.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    so.add_argument("-f", "--file", default=None,
+                    help="read an slo.json dump instead of fetching")
+    so.add_argument("--window", type=float, default=None,
+                    help="add one ad-hoc burn window of this many "
+                         "seconds to the report")
+    so.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    so.add_argument("--timeout", type=float, default=10.0)
+
     dg = sub.add_parser(
         "dag", help="show the operand state dependency DAG the scheduler "
                     "compiles at startup: sync waves, per-state "
@@ -924,6 +1088,10 @@ def main(argv=None) -> int:
         return _trace(args)
     if args.cmd == "cache":
         return _cache(args)
+    if args.cmd == "why":
+        return _why(args)
+    if args.cmd == "slo":
+        return _slo(args)
     if args.cmd == "dag":
         return _dag(args)
     if args.cmd == "place":

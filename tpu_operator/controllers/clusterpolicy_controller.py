@@ -47,6 +47,7 @@ REQUEUE_NO_TPU_NODES_S = 45.0  # :199 (NFD-missing poll analog)
 
 class ClusterPolicyReconciler(Reconciler):
     name = "tpuclusterpolicy"
+    primary_kind = KIND_CLUSTER_POLICY
 
     def __init__(self, client, namespace: Optional[str] = None,
                  state_manager: Optional[StateManager] = None,
@@ -338,6 +339,12 @@ class ClusterPolicyReconciler(Reconciler):
     def _set_state(self, cr: dict, state: str) -> None:
         prev = get_nested(cr, "status", "state", default=None)
         if prev != state:
+            from ..runtime.timeline import TIMELINE
+
+            if TIMELINE.enabled:
+                TIMELINE.record(KIND_CLUSTER_POLICY, name_of(cr), "state",
+                                {"controller": self.name,
+                                 "from": prev or "new", "to": state})
             # transition-only: a 5s not-ready requeue must not flood
             # Events (the recorder would dedup-count, but even counting
             # is noise for a non-transition)

@@ -52,6 +52,7 @@ from ..api.slicerequest import (
     SliceRequestSpec,
 )
 from ..metrics.operator_metrics import OPERATOR_METRICS
+from ..runtime.timeline import TIMELINE
 from ..runtime import (
     LANE_HEALTH,
     LANE_PLACEMENT,
@@ -147,6 +148,7 @@ def _node_placement_changed(event: WatchEvent, old: Optional[dict]) -> bool:
 
 class PlacementReconciler(Reconciler):
     name = "sliceplacement"
+    primary_kind = "SliceRequest"
 
     def __init__(self, client, namespace: Optional[str] = None,
                  preemption: Optional[bool] = None,
@@ -217,6 +219,9 @@ class PlacementReconciler(Reconciler):
             if self._release_leases(key):
                 OPERATOR_METRICS.placement_decisions.labels(
                     outcome="released").inc()
+                if TIMELINE.enabled:
+                    TIMELINE.record("SliceRequest", key, "released",
+                                    {"controller": self.name})
             return Result()
         cr = thaw_obj(live)
         spec = SliceRequestSpec.from_obj(cr)
@@ -269,6 +274,10 @@ class PlacementReconciler(Reconciler):
             update_status_with_retry(self.client, cr, live=live)
             OPERATOR_METRICS.placement_decisions.labels(
                 outcome="evicted").inc()
+            if TIMELINE.enabled:
+                TIMELINE.record("SliceRequest", key, "evicted",
+                                {"controller": self.name,
+                                 "reason": broken})
             log.info("request %s drained: %s", key, broken)
             return Result(requeue=True)
 
@@ -300,6 +309,10 @@ class PlacementReconciler(Reconciler):
                 update_status_with_retry(self.client, cr, live=live)
                 OPERATOR_METRICS.placement_decisions.labels(
                     outcome="unschedulable").inc()
+                if TIMELINE.enabled:
+                    TIMELINE.record("SliceRequest", key, "unschedulable",
+                                    {"controller": self.name,
+                                     "reason": reason})
                 OPERATOR_METRICS.placement_latency.observe(
                     _time.perf_counter() - t0)
                 self._export_gauges(nodes)
@@ -339,6 +352,11 @@ class PlacementReconciler(Reconciler):
         OPERATOR_METRICS.placement_decisions.labels(outcome="placed").inc()
         OPERATOR_METRICS.placement_latency.observe(
             _time.perf_counter() - t0)
+        if TIMELINE.enabled:
+            TIMELINE.record("SliceRequest", key, "placed",
+                            {"controller": self.name, "pool": best.pool,
+                             "score": f"{best.score:.6f}",
+                             "nodes": sorted(best.nodes)})
         self._export_gauges(None)
         log.info("request %s placed on %s (%d nodes, score %s)",
                  key, best.pool, len(best.nodes), f"{best.score:.6f}")

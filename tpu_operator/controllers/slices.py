@@ -49,6 +49,7 @@ from ..api.slicerequest import (
 )
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime.client import Client, ListOptions
+from ..runtime.timeline import TIMELINE
 from ..runtime.objects import (
     annotations_of,
     get_nested,
@@ -217,6 +218,10 @@ def post_intent(client: Client, cr: dict, live: dict, intent: str,
     mig.update(extra or {})
     set_nested(cr, mig, "status", "migration")
     update_status_with_retry(client, cr, live=live)
+    if TIMELINE.enabled:
+        TIMELINE.record("SliceRequest", key, "migration:" + MIG_MIGRATING,
+                        {"intent": intent, "deadline": _fmt_ts(deadline),
+                         "from": mig["from"]})
     log.info("posted %s intent on %s (deadline %s)", intent, key,
              _fmt_ts(deadline))
 
@@ -235,6 +240,10 @@ def abort_migration(client: Client, cr: dict, live: dict, reason: str,
     set_nested(cr, mig, "status", "migration")
     update_status_with_retry(client, cr, live=live)
     OPERATOR_METRICS.slice_migrations.labels(outcome=outcome).inc()
+    if TIMELINE.enabled:
+        TIMELINE.record("SliceRequest", request_key(cr),
+                        "migration:" + MIG_ABORTED,
+                        {"outcome": outcome, "reason": reason})
     log.warning("migration of %s aborted (%s): %s",
                 request_key(cr), outcome, reason)
 
@@ -274,6 +283,11 @@ def rebind_request(client: Client, cr: dict, live: dict,
                          {"metadata": {"annotations": {L.PLACED_BY: None}}})
     clear_intent(client, cr)
     OPERATOR_METRICS.slice_migrations.labels(outcome=outcome).inc()
+    if TIMELINE.enabled:
+        TIMELINE.record("SliceRequest", key, "migration:" + MIG_REBOUND,
+                        {"outcome": outcome, "pool": candidate.pool,
+                         "score": f"{candidate.score:.6f}",
+                         "from": sorted(old), "to": sorted(new)})
     started = mig.get("startedAt")
     if started:
         OPERATOR_METRICS.slice_migration_duration.observe(

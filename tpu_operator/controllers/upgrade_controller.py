@@ -133,6 +133,7 @@ class _Member:
 
 class UpgradeReconciler(Reconciler):
     name = "tpu-upgrade"
+    primary_kind = "TPUClusterPolicy"  # requests name the owning policy
 
     def __init__(self, client, namespace: str = "tpu-operator",
                  now=time.time, recorder=None):
@@ -373,8 +374,13 @@ class UpgradeReconciler(Reconciler):
         return min(present, key=_STAGE_ORDER.index)
 
     def _set_unit_state(self, members: List[_Member], state: str) -> None:
+        from ..runtime.timeline import TIMELINE
         from ..runtime.tracing import TRACER
 
+        if TIMELINE.enabled:
+            TIMELINE.record("UpgradeUnit", members[0].name, "fsm:" + state,
+                            {"controller": self.name,
+                             "nodes": len(members)})
         with TRACER.span("fsm:" + state, unit=members[0].name,
                          nodes=len(members)):
             for m in members:
@@ -407,8 +413,12 @@ class UpgradeReconciler(Reconciler):
             self._annotate(m.node, **{L.UPGRADE_STAGE_STARTED: stamp})
 
     def _fail_unit(self, members: List[_Member], reason: str) -> None:
+        from ..runtime.timeline import TIMELINE
         from ..runtime.tracing import TRACER
 
+        if TIMELINE.enabled:
+            TIMELINE.record("UpgradeUnit", members[0].name, "fsm:failed",
+                            {"controller": self.name, "reason": reason})
         stamp = str(self.now())
         log.error("upgrade unit [%s] failed: %s",
                   ",".join(m.name for m in members), reason)

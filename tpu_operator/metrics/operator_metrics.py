@@ -231,6 +231,29 @@ class OperatorMetrics:
             "tpu_operator_checkpoint_restore_fallbacks_total",
             "Restores that skipped a partial/corrupt latest checkpoint "
             "and fell back to an older retained step")
+        # causal lineage plane (runtime/timeline.py + metrics/slo.py):
+        # per-lane queue-time distribution (the health-lane-queue SLO's
+        # SLI source — the per-controller queue-time histogram above
+        # can't split lanes), and the SLO engine's exported verdicts
+        self.workqueue_lane_queue_latency = h(
+            "tpu_operator_workqueue_lane_queue_time_seconds",
+            "Time items spent queued before dequeue, per priority lane",
+            labelnames=("lane",))
+        self.slo_burn_rate = g(
+            "tpu_operator_slo_burn_rate",
+            "Error-budget burn rate per SLO and evaluation window "
+            "(1.0 = spending budget exactly at the sustainable rate)",
+            labelnames=("slo", "window"))
+        self.slo_budget_remaining = g(
+            "tpu_operator_slo_error_budget_remaining",
+            "Fraction of the error budget left over the engine's "
+            "retained history (1.0 = untouched, 0.0 = exhausted)",
+            labelnames=("slo",))
+        self.slo_breached = g(
+            "tpu_operator_slo_breached",
+            "1 when every evaluation window of the SLO burns past its "
+            "threshold (the multi-window page condition)",
+            labelnames=("slo",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -32,7 +32,13 @@ from typing import Callable, Iterable, Optional
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from .client import Client, WatchEvent
 from .objects import get_nested, name_of, namespace_of
-from .workqueue import RateLimiter, WorkQueue, WriteBudget, env_write_qps
+from .workqueue import (
+    Cause,
+    RateLimiter,
+    WorkQueue,
+    WriteBudget,
+    env_write_qps,
+)
 
 log = logging.getLogger("tpu_operator.manager")
 
@@ -226,6 +232,10 @@ class Controller:
         self.reconciler = reconciler
         self.client = client
         self.workers = workers
+        # the (kind) this controller's requests refer to, for per-object
+        # timeline attribution; reconcilers that want timelines declare
+        # ``primary_kind`` (e.g. "SliceRequest")
+        self.timeline_kind = getattr(reconciler, "primary_kind", None)
         self.shards = env_shards() if shards is None else max(1, shards)
         rl = rate_limiter or RateLimiter(0.1, 3.0)
         coalesced = OPERATOR_METRICS.workqueue_coalesced.labels(
@@ -266,18 +276,33 @@ class Controller:
     def _queue_for_locked(self, req) -> WorkQueue:
         return self.queues[shard_of(str(req), self._live)]
 
-    def enqueue(self, req: Request, lane: Optional[str] = None) -> None:
-        """Route a request to its shard's queue under the declared lane."""
+    def enqueue(self, req: Request, lane: Optional[str] = None,
+                cause: Optional[Cause] = None) -> None:
+        """Route a request to its shard's queue under the declared lane,
+        stamping the enqueue's :class:`Cause` (if any) onto the item and
+        onto the object's timeline. Coalesced duplicates merge their
+        cause into the queued item but add no timeline entry — a node
+        storm fanning out to one key must not flood its ring."""
         with self._shard_lock:
-            self._queue_for_locked(req).add(req, lane=lane)
+            fresh = self._queue_for_locked(req).add(req, lane=lane,
+                                                    cause=cause)
+        if fresh and cause is not None and self.timeline_kind is not None:
+            from .timeline import TIMELINE
 
-    def _requeue_after(self, req: Request, delay: float) -> None:
-        with self._shard_lock:
-            self._queue_for_locked(req).add_after(req, delay)
+            TIMELINE.record(self.timeline_kind, str(req), "enqueue",
+                            {"controller": self.name,
+                             "lane": lane or "bulk"},
+                            causes=(cause,))
 
-    def _requeue_rate_limited(self, req: Request) -> None:
+    def _requeue_after(self, req: Request, delay: float,
+                       cause: Optional[Cause] = None) -> None:
         with self._shard_lock:
-            self._queue_for_locked(req).add_rate_limited(req)
+            self._queue_for_locked(req).add_after(req, delay, cause=cause)
+
+    def _requeue_rate_limited(self, req: Request,
+                              cause: Optional[Cause] = None) -> None:
+        with self._shard_lock:
+            self._queue_for_locked(req).add_rate_limited(req, cause=cause)
 
     def kill_shard(self, shard: int) -> int:
         """Fail one shard's worker group and rehash its keys onto the
@@ -301,8 +326,14 @@ class Controller:
         with self._shard_lock:
             self._live.remove(shard)
             moved = dead_queue.drain_pending()
-            for item, lane in moved:
-                self._queue_for_locked(item).add(item, lane=lane)
+            for item, lane, causes in moved:
+                # the cause provenance rides the transfer, plus a marker
+                # recording that the key crossed a shard failover
+                self._queue_for_locked(item).add(
+                    item, lane=lane,
+                    cause=causes + (Cause(
+                        reason="failover-transfer",
+                        origin=f"{self.name}:shard{shard}"),))
         dead_queue.shutdown()
         self._update_depth_metrics()
         return len(moved)
@@ -335,6 +366,8 @@ class Controller:
         placement > bulk; default bulk) — e.g. a node-conditions watch
         declares ``health`` so its events preempt rollout churn."""
         def handler(event: WatchEvent):
+            from .tracing import TRACER
+
             key = (api_version, kind, namespace_of(event.obj), name_of(event.obj))
             with self._seen_lock:
                 old = self._last_seen.get(key)
@@ -345,8 +378,20 @@ class Controller:
             try:
                 if not predicate(event, old):
                     return
+                cause = None
+                if TRACER.enabled:
+                    # watch delivery is synchronous from the writer, so
+                    # the trace open on THIS thread (if any) is the
+                    # reconcile whose write fired the event — the
+                    # cross-controller causal link
+                    origin_tr = TRACER.current_trace()
+                    cause = Cause(
+                        reason=f"watch:{event.type}",
+                        origin=f"{kind}/{name_of(event.obj)}",
+                        trace_id=(origin_tr.seq if origin_tr is not None
+                                  else -1))
                 for req in mapper(event):
-                    self.enqueue(req, lane=lane)
+                    self.enqueue(req, lane=lane, cause=cause)
                 self._update_depth_metrics()
             except Exception:  # watch handlers must never kill the stream
                 log.exception("[%s] watch handler failed for %s/%s",
@@ -355,10 +400,11 @@ class Controller:
         self._watch_cancels.append(self.client.watch(api_version, kind, handler))
 
     def _worker(self, shard: int = 0):
+        from .timeline import TIMELINE
         from .tracing import TRACER
         queue = self.queues[shard]
         while not self._stopped.is_set():
-            req, waited, lane = queue.get_with_info(timeout=0.5)
+            req, waited, lane, causes = queue.get_with_info(timeout=0.5)
             if req is None:
                 if shard in self._dead:
                     return  # shard killed: worker group retires
@@ -367,32 +413,57 @@ class Controller:
                 controller=self.name).set(waited)
             OPERATOR_METRICS.workqueue_queue_latency.labels(
                 controller=self.name).observe(waited)
+            OPERATOR_METRICS.workqueue_lane_queue_latency.labels(
+                lane=lane).observe(waited)
+
+            def retry_cause(reason: str, tr) -> Optional[Cause]:
+                if not TRACER.enabled:
+                    return None
+                return Cause(reason=reason, origin=self.name,
+                             trace_id=tr.seq if tr is not None else -1)
+
             try:
                 # the trace's root span opens here, at dequeue, carrying
-                # the queue wait; the reconciler's own wrapper (which
-                # also covers direct-driven runs) sees a trace is active
-                # and passes through. The duration *histogram* is
-                # observed in that wrapper — once per reconcile on every
-                # path — not here.
-                with TRACER.trace(self.name, str(req), queue_wait_s=waited):
+                # the queue wait AND the cause chain the enqueuers
+                # stamped; the reconciler's own wrapper (which also
+                # covers direct-driven runs) sees a trace is active and
+                # passes through. The duration *histogram* is observed
+                # in that wrapper — once per reconcile on every path —
+                # not here.
+                with TRACER.trace(self.name, str(req), queue_wait_s=waited,
+                                  causes=causes) as tr:
                     result = self.reconciler.reconcile(req)
                 self._count_reconcile(error=False)
+                if TIMELINE.enabled and self.timeline_kind is not None:
+                    TIMELINE.record(
+                        self.timeline_kind, str(req), "reconcile",
+                        {"controller": self.name, "outcome": "ok",
+                         "lane": lane}, causes=causes)
                 # re-adds route through the live-shard mapping, not this
                 # worker's queue: after a failover the key may belong to
                 # a different shard than it was dequeued from
                 if result and result.requeue_after > 0:
                     queue.forget(req)
-                    self._requeue_after(req, result.requeue_after)
+                    self._requeue_after(req, result.requeue_after,
+                                        cause=retry_cause("requeue-after",
+                                                          tr))
                 elif result and result.requeue:
                     # keep the failure count: repeated requeue=True must back
                     # off toward the 3s cap, like controller-runtime
-                    self._requeue_rate_limited(req)
+                    self._requeue_rate_limited(
+                        req, cause=retry_cause("requeue", tr))
                 else:
                     queue.forget(req)
             except Exception:
                 self._count_reconcile(error=True)
                 log.exception("[%s] reconcile %s failed", self.name, req)
-                self._requeue_rate_limited(req)
+                if TIMELINE.enabled and self.timeline_kind is not None:
+                    TIMELINE.record(
+                        self.timeline_kind, str(req), "reconcile",
+                        {"controller": self.name, "outcome": "error",
+                         "lane": lane}, causes=causes)
+                self._requeue_rate_limited(
+                    req, cause=retry_cause("retry-backoff", None))
             finally:
                 queue.done(req)
                 self._update_depth_metrics()
@@ -491,6 +562,56 @@ class _HealthHandler(BaseHTTPRequestHandler):
                                        limit=limit)
                 body = json.dumps({"count": len(traces), "traces": traces},
                                   sort_keys=True).encode()
+                code = 200
+            ctype = "application/json"
+        elif url.path == "/debug/timeline":
+            import json
+            import re
+
+            from .timeline import TIMELINE
+
+            q = parse_qs(url.query)
+
+            def one(key):
+                vals = q.get(key)
+                return vals[-1] if vals else None
+
+            kind, name = one("kind"), one("name")
+            # kind is a bare identifier; name may carry a namespace/
+            # prefix. Anything else (empty, missing, control chars) is a
+            # client error, reported as JSON like /debug/traces does.
+            if (not kind or not name
+                    or not re.fullmatch(r"[A-Za-z0-9._-]+", kind)
+                    or not re.fullmatch(r"[A-Za-z0-9._/-]+", name)):
+                body = (b'{"error": "kind and name are required '
+                        b'(kind=<Kind>&name=[ns/]<name>)"}')
+                code = 400
+            else:
+                events = TIMELINE.timeline(kind, name)
+                body = json.dumps(
+                    {"kind": kind, "name": name, "count": len(events),
+                     "events": events}, sort_keys=True).encode()
+                code = 200
+            ctype = "application/json"
+        elif url.path == "/debug/slo":
+            import json
+
+            from ..metrics.slo import SLO_ENGINE
+
+            q = parse_qs(url.query)
+            vals = q.get("window")
+            window = vals[-1] if vals else None
+            try:
+                window_s = float(window) if window is not None else None
+                if window_s is not None and window_s <= 0:
+                    raise ValueError(window)
+            except ValueError:
+                body = b'{"error": "window must be a positive number ' \
+                       b'of seconds"}'
+                code = 400
+            else:
+                report = SLO_ENGINE.evaluate(extra_window_s=window_s)
+                body = json.dumps(report, sort_keys=True).encode()
                 code = 200
             ctype = "application/json"
         else:

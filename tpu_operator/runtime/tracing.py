@@ -108,13 +108,16 @@ class Span:
 
 class Trace:
     """A finished (or in-flight) reconcile: the root span plus identity
-    and outcome. ``seq`` is assigned at record time and orders traces."""
+    and outcome. ``seq`` is assigned when the trace opens, so an enqueue
+    performed *during* the reconcile (a watch event fired by one of its
+    own writes) can already cite this trace as its cause."""
 
     __slots__ = ("seq", "controller", "key", "root", "outcome", "error",
-                 "queue_wait_s")
+                 "queue_wait_s", "causes")
 
     def __init__(self, controller: str, key: str, root: Span,
-                 queue_wait_s: Optional[float] = None):
+                 queue_wait_s: Optional[float] = None,
+                 causes: tuple = ()):
         self.seq = -1
         self.controller = controller
         self.key = key
@@ -122,13 +125,16 @@ class Trace:
         self.outcome = "ok"
         self.error: Optional[str] = None
         self.queue_wait_s = queue_wait_s
+        # Cause tuple popped off the workqueue with the item: why this
+        # reconcile ran, each entry linking the trace that enqueued it
+        self.causes = causes
 
     @property
     def duration_s(self) -> float:
         return self.root.duration_s
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "id": self.seq,
             "controller": self.controller,
             "key": self.key,
@@ -139,6 +145,9 @@ class Trace:
                              else _round(self.queue_wait_s)),
             "root": self.root.to_dict(),
         }
+        if self.causes:
+            d["causes"] = [c.to_dict() for c in self.causes]
+        return d
 
 
 class Tracer:
@@ -190,16 +199,24 @@ class Tracer:
 
     @contextmanager
     def trace(self, controller: str, key: str,
-              queue_wait_s: Optional[float] = None):
+              queue_wait_s: Optional[float] = None,
+              causes: tuple = ()):
         """Open the root span of a reconcile. Nested calls (a Controller
         worker already opened the trace, then the reconciler's own
         wrapper asks again) are a passthrough — one reconcile, one trace,
-        whichever layer saw it first."""
+        whichever layer saw it first. ``causes`` is the Cause tuple the
+        workqueue popped with the item — the cross-controller link."""
         if not self.enabled or self._stack():
             yield None
             return
         root = Span("reconcile", self.clock())
-        tr = Trace(controller, key, root, queue_wait_s=queue_wait_s)
+        tr = Trace(controller, key, root, queue_wait_s=queue_wait_s,
+                   causes=tuple(causes))
+        with self._lock:
+            # seq at open (not record): a watch handler firing inside
+            # this reconcile needs the id to stamp into its Cause
+            tr.seq = self._seq
+            self._seq += 1
         self._stack().append((tr, root))
         try:
             yield tr
@@ -279,8 +296,6 @@ class Tracer:
 
     def _record(self, tr: Trace) -> None:
         with self._lock:
-            tr.seq = self._seq
-            self._seq += 1
             self._ring.append(tr)
             if tr.outcome == "error":
                 self._failed.append(tr)

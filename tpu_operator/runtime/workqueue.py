@@ -43,6 +43,32 @@ LANE_BULK = "bulk"
 LANES = (LANE_HEALTH, LANE_PLACEMENT, LANE_BULK)
 _LANE_RANK = {lane: i for i, lane in enumerate(LANES)}
 
+#: Cap on the causes carried per queued item: a coalescing storm on one
+#: key must not grow an unbounded provenance list — beyond this the
+#: earliest causes win (they are the ones that explain the re-run).
+MAX_CAUSES = 8
+
+
+@dataclass(frozen=True)
+class Cause:
+    """Provenance of one enqueue: which trace (if any) produced it, from
+    which origin span/object, and why. Stamped by the enqueuer (watch
+    handler, requeue path, failover transfer), merged on coalesce, and
+    surfaced by :meth:`WorkQueue.get_with_info` so the reconcile's root
+    trace can link back to the event that caused it."""
+
+    reason: str
+    origin: str = ""
+    trace_id: int = -1
+
+    def to_dict(self) -> dict:
+        d: dict = {"reason": self.reason}
+        if self.origin:
+            d["origin"] = self.origin
+        if self.trace_id >= 0:
+            d["trace_id"] = self.trace_id
+        return d
+
 
 def env_lanes_enabled(env=None) -> bool:
     """Priority lanes default ON; OPERATOR_QUEUE_LANES=0 (or
@@ -196,10 +222,13 @@ class WorkQueue:
         self._pending: set = set()
         self._processing: set = set()
         self._dirty: set = set()
-        self._delayed: list[tuple[float, int, Any, str]] = []
+        self._delayed: list[tuple[float, int, Any, str, Any]] = []
         self._enqueued_at: dict[Any, float] = {}
         # lane assignment of every pending/dirty item (popped with it)
         self._lane: dict[Any, str] = {}
+        # cause list (capped at MAX_CAUSES) of every pending/dirty item;
+        # coalesced re-adds merge into it, get_with_info pops it
+        self._causes: dict[Any, tuple] = {}
         self._seq = 0
         self._shutdown = False
         self._frozen = False
@@ -236,6 +265,23 @@ class WorkQueue:
         if cur is None or _LANE_RANK[lane] < _LANE_RANK[cur]:
             self._lane[item] = lane
 
+    def _stamp_cause_locked(self, item: Any, cause: Any) -> None:
+        """Merge ``cause`` (a Cause, or an iterable of them — the
+        failover-transfer path re-adds a whole list) into the item's
+        bounded cause tuple. Earliest causes win past the cap; exact
+        duplicates collapse."""
+        if cause is None:
+            return
+        causes = (cause,) if isinstance(cause, Cause) else tuple(cause)
+        cur = self._causes.get(item, ())
+        for c in causes:
+            if len(cur) >= MAX_CAUSES:
+                break
+            if c not in cur:
+                cur = cur + (c,)
+        if cur:
+            self._causes[item] = cur
+
     def _enqueue_locked(self, item: Any, lane: str, now: float) -> None:
         self._pending.add(item)
         self._lane[item] = lane
@@ -243,20 +289,34 @@ class WorkQueue:
         self._queues[lane].append(item)
         self._cond.notify()
 
-    def add(self, item: Any, lane: Optional[str] = None) -> None:
+    def add(self, item: Any, lane: Optional[str] = None,
+            cause: Any = None) -> bool:
+        """Enqueue (or coalesce) the item. Returns True when this add
+        genuinely bought a future reconcile the item did not already
+        have — a fresh enqueue or the first dirty mark of an in-flight
+        key — and False for a coalesced/promoted duplicate. Callers use
+        the distinction for per-object timeline attribution: a merged
+        duplicate keeps its cause (stamped either way) but should not
+        produce another timeline entry."""
         lane = self._resolve_lane(lane)
         with self._cond:
             if self._shutdown:
-                return
+                return False
+            self._stamp_cause_locked(item, cause)
             if item in self._processing:
+                fresh = item not in self._dirty
                 # first re-add of an in-flight key buys exactly one
                 # re-run (the dirty mark); further adds are coalesced
-                if item in self._dirty:
-                    self._coalesced_locked()
-                else:
+                if fresh:
                     self._dirty.add(item)
+                else:
+                    self._coalesced_locked()
+                # queue-wait attribution: the re-run's wait clock starts
+                # at the FIRST re-add, not when done() files the item —
+                # setdefault keeps the earliest stamp under churn
+                self._enqueued_at.setdefault(item, time.monotonic())
                 self._note_lane_locked(item, lane)
-                return
+                return fresh
             if item in self._pending:
                 cur = self._lane.get(item, LANE_BULK)
                 if _LANE_RANK[lane] < _LANE_RANK[cur]:
@@ -271,25 +331,29 @@ class WorkQueue:
                         self._queues[lane].append(item)
                         self._cond.notify()
                 self._coalesced_locked()
-                return
+                return False
             self._enqueue_locked(item, lane, time.monotonic())
+            return True
 
     def add_after(self, item: Any, delay: float,
-                  lane: Optional[str] = None) -> None:
+                  lane: Optional[str] = None, cause: Any = None) -> None:
         if delay <= 0:
-            self.add(item, lane=lane)
+            self.add(item, lane=lane, cause=cause)
             return
         lane = self._resolve_lane(lane)
         with self._cond:
             if self._shutdown:
                 return
             self._seq += 1
-            heapq.heappush(self._delayed,
-                           (time.monotonic() + delay, self._seq, item, lane))
+            heapq.heappush(
+                self._delayed,
+                (time.monotonic() + delay, self._seq, item, lane, cause))
             self._cond.notify()
 
-    def add_rate_limited(self, item: Any, lane: Optional[str] = None) -> None:
-        self.add_after(item, self.rate_limiter.when(item), lane=lane)
+    def add_rate_limited(self, item: Any, lane: Optional[str] = None,
+                         cause: Any = None) -> None:
+        self.add_after(item, self.rate_limiter.when(item), lane=lane,
+                       cause=cause)
 
     def forget(self, item: Any) -> None:
         self.rate_limiter.forget(item)
@@ -299,9 +363,10 @@ class WorkQueue:
         now = time.monotonic()
         wait = None
         while self._delayed:
-            due, _, item, lane = self._delayed[0]
+            due, _, item, lane, cause = self._delayed[0]
             if due <= now:
                 heapq.heappop(self._delayed)
+                self._stamp_cause_locked(item, cause)
                 if item not in self._pending and item not in self._processing:
                     self._enqueue_locked(item, lane, now)
                 elif item in self._processing:
@@ -309,6 +374,9 @@ class WorkQueue:
                         self._coalesced_locked()
                     else:
                         self._dirty.add(item)
+                    # same earliest-stamp rule as add(): the dirty
+                    # re-run's wait starts when the delay expired
+                    self._enqueued_at.setdefault(item, now)
                     self._note_lane_locked(item, lane)
                 else:  # already pending: the promotion collapsed into it
                     self._coalesced_locked()
@@ -333,17 +401,19 @@ class WorkQueue:
                       ) -> tuple[Optional[Any], float]:
         """Like :meth:`get`, plus the seconds the returned item spent
         queued. Returns ``(None, 0.0)`` on shutdown or timeout."""
-        item, waited, _ = self.get_with_info(timeout)
+        item, waited, _, _ = self.get_with_info(timeout)
         return item, waited
 
     def get_with_info(self, timeout: Optional[float] = None
-                      ) -> tuple[Optional[Any], float, str]:
+                      ) -> tuple[Optional[Any], float, str, tuple]:
         """Like :meth:`get`, plus the seconds the returned item spent
-        queued and the lane it was served from. The shared ``last_wait``
-        field is racy under N workers — this per-item figure (computed
-        under the lock) is what the queue-time histogram, the per-lane
-        depth gauge, and the reconcile trace's root span carry. Returns
-        ``(None, 0.0, "bulk")`` on shutdown or timeout."""
+        queued, the lane it was served from, and the merged
+        :class:`Cause` tuple stamped by its enqueuers. The shared
+        ``last_wait`` field is racy under N workers — this per-item
+        figure (computed under the lock) is what the queue-time
+        histogram, the per-lane depth gauge, and the reconcile trace's
+        root span carry. Returns ``(None, 0.0, "bulk", ())`` on shutdown
+        or timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while True:
@@ -351,7 +421,7 @@ class WorkQueue:
                     # frozen (shard being failed over): stop handing out
                     # items — they will be transferred — but keep
                     # accepting adds so no key racing the failover is lost
-                    return None, 0.0, LANE_BULK
+                    return None, 0.0, LANE_BULK, ()
                 wait = self._promote_delayed_locked()
                 popped = self._pop_locked()
                 if popped is not None:
@@ -360,18 +430,19 @@ class WorkQueue:
                     self._lane.pop(item, None)
                     self._processing.add(item)
                     added = self._enqueued_at.pop(item, None)
+                    causes = self._causes.pop(item, ())
                     waited = 0.0
                     if added is not None:
                         waited = time.monotonic() - added
                         self.last_wait = waited
                     self.last_lane = lane
-                    return item, waited, lane
+                    return item, waited, lane, causes
                 if self._shutdown:
-                    return None, 0.0, LANE_BULK
+                    return None, 0.0, LANE_BULK, ()
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return None, 0.0, LANE_BULK
+                        return None, 0.0, LANE_BULK, ()
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
 
@@ -395,37 +466,47 @@ class WorkQueue:
                 queued=queued,
                 processing=tuple(self._processing),
                 delayed=tuple((due, item)
-                              for due, _, item, _ in self._delayed))
+                              for due, _, item, _, _ in self._delayed))
 
     def lane_depths(self) -> dict[str, int]:
         """Items waiting per lane (queued + delayed) — the
         workqueue_lane_depth observable."""
         with self._cond:
             depths = {lane: len(self._queues[lane]) for lane in LANES}
-            for _, _, _, lane in self._delayed:
+            for _, _, _, lane, _ in self._delayed:
                 depths[lane] = depths.get(lane, 0) + 1
             return depths
 
-    def drain_pending(self) -> list[tuple[Any, str]]:
+    def drain_pending(self) -> list[tuple[Any, str, tuple]]:
         """Atomically remove and return every not-in-flight item as
-        ``(item, lane)``, delayed and dirty included — the shard-failover
-        transfer: a killed shard's queued keys are re-hashed onto the
-        surviving shards with no key lost. In-flight (processing) items
-        are NOT returned; the caller must drain/join the shard's workers
-        first to preserve per-key serialization."""
+        ``(item, lane, causes)``, delayed and dirty included — the
+        shard-failover transfer: a killed shard's queued keys are
+        re-hashed onto the surviving shards with no key (and no cause
+        provenance) lost. In-flight (processing) items are NOT returned;
+        the caller must drain/join the shard's workers first to preserve
+        per-key serialization."""
         with self._cond:
-            out = [(item, lane) for lane in LANES
-                   for item in self._queues[lane]]
+            out = [(item, lane, self._causes.get(item, ()))
+                   for lane in LANES for item in self._queues[lane]]
             for lane in LANES:
                 self._queues[lane].clear()
-            out.extend((item, lane) for _, _, item, lane in self._delayed)
+            for _, _, item, lane, cause in self._delayed:
+                causes = self._causes.get(item, ())
+                if cause is not None:
+                    extra = ((cause,) if isinstance(cause, Cause)
+                             else tuple(cause))
+                    causes = causes + tuple(
+                        c for c in extra if c not in causes)
+                out.append((item, lane, causes[:MAX_CAUSES]))
             self._delayed.clear()
             for item in self._dirty:
-                out.append((item, self._lane.get(item, LANE_BULK)))
+                out.append((item, self._lane.get(item, LANE_BULK),
+                            self._causes.get(item, ())))
             self._dirty.clear()
             self._pending.clear()
             self._enqueued_at.clear()
             self._lane.clear()
+            self._causes.clear()
             return out
 
     def freeze(self) -> None:

@@ -49,6 +49,7 @@ from ..runtime.objects import (
     set_nested,
     thaw_obj,
 )
+from ..runtime.timeline import TIMELINE
 
 log = logging.getLogger("tpu_operator.elastic")
 
@@ -211,6 +212,11 @@ class ElasticWorkload:
                 mig["phase"] = MIG_RESUMED
             set_nested(cr, mig, "status", "migration")
             update_status_with_retry(self.client, cr, live=live)
+            if TIMELINE.enabled and phase == MIG_REBOUND:
+                TIMELINE.record("SliceRequest", self.key,
+                                "migration:" + MIG_RESUMED,
+                                {"restoredStep": restored,
+                                 "nodes": len(nodes)})
             log.info("workload %s restored step %d on %d node(s)",
                      self.key, restored, len(nodes))
             self._nodes_seen = nodes
@@ -248,6 +254,11 @@ class ElasticWorkload:
                     int(mig.get("ackedStep", -1) or -1), self.step)
                 set_nested(cr, mig, "status", "migration")
                 update_status_with_retry(self.client, cr, live=live)
+                if TIMELINE.enabled:
+                    TIMELINE.record("SliceRequest", self.key,
+                                    "migration:" + MIG_CHECKPOINTED,
+                                    {"intent": intent,
+                                     "ackedStep": self.step})
                 log.info("workload %s acked %s at step %d",
                          self.key, intent, self.step)
         if self._last_save_at is not None:
