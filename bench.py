@@ -572,6 +572,43 @@ def _controlplane_doc() -> dict | None:
                     fl["fleet_p99_queue_ms"], 4)
             except Exception as e:
                 doc["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+        # placement at fleet scale: incremental index vs per-request
+        # rescan at 10k nodes (its own try for the same reason as
+        # rollout's). placement_fleet_p99_ms / placement_storm_rps at
+        # top level are the headline figures tests/test_bench_guard.py
+        # tracks. TPUOP_BENCH_PLACEMENT_FLEET_NODES scales it down for
+        # smoke runs; TPUOP_BENCH_SKIP_PLACEMENT_FLEET skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_PLACEMENT_FLEET"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_placement_fleet_bench,
+                )
+
+                pf_n = int(os.environ.get(
+                    "TPUOP_BENCH_PLACEMENT_FLEET_NODES", "10000"))
+                pf = run_placement_fleet_bench(pf_n)
+                doc["placement_fleet"] = {
+                    "n_tpu_nodes": pf["n_tpu_nodes"],
+                    "baseline_tpu_nodes": pf["baseline_tpu_nodes"],
+                    "n_requests": pf["n_requests"],
+                    "placed": pf["indexed_placed"],
+                    "unschedulable": pf["indexed_unschedulable"],
+                    "baseline_p99_ms": round(
+                        pf["placement_baseline_p99_ms"], 3),
+                    "p99_flatness_x": round(pf["p99_flatness_x"], 2),
+                    "rescan_rps": round(pf["rescan_rps"], 2),
+                    "rescan_p99_ms": round(pf["rescan_p99_ms"], 1),
+                    "storm_speedup_x": round(pf["storm_speedup_x"], 1),
+                    "domains": pf["index_stats"]["domains"],
+                    "spec_shapes": pf["index_stats"]["spec_shapes"],
+                }
+                doc["placement_fleet_p99_ms"] = round(
+                    pf["placement_fleet_p99_ms"], 3)
+                doc["placement_storm_rps"] = round(
+                    pf["placement_storm_rps"], 1)
+            except Exception as e:
+                doc["placement_fleet"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         # causal-lineage stamping overhead on the hot enqueue/dequeue
         # path (its own try for the same reason as rollout's).
         # lineage_overhead_ratio at top level is the headline figure

@@ -214,6 +214,50 @@ def test_lineage_overhead_not_regressed():
         f"{latest:.4f} regressed >25% vs best on record ({best:.4f})")
 
 
+def test_placement_fleet_p99_not_regressed():
+    """Same contract again, for the incremental placement index's
+    per-decision p99 at 10k nodes (benchmarks.controlplane.
+    run_placement_fleet_bench): the latest round's
+    placement_fleet_p99_ms may be at most 25% above the best on record.
+    Skips until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "placement_fleet_p99_ms")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records placement_fleet_p99_ms yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} placement_fleet_p99_ms="
+        f"{latest:.3f}ms regressed >25% vs best on record ({best:.3f}ms)")
+
+
+def test_placement_storm_rps_not_regressed():
+    """The storm-throughput twin of the fleet-p99 guard, inverted:
+    placement_storm_rps is higher-is-better (indexed decisions per
+    second while a 5k-request backlog drains at 10k nodes), so the
+    latest round must stay above best / 1.25. Skips until a round
+    carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "placement_storm_rps")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: max(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records placement_storm_rps yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = max(rounds_with_figure.values())
+    assert latest >= best / REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} placement_storm_rps="
+        f"{latest:.1f} regressed >25% vs best on record ({best:.1f})")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

@@ -268,6 +268,9 @@ class TestChaosSLOVerdicts:
     EXPECTED_BREACH = {
         "slice-migrate": ["migration-success"],
         "placement-contention": ["placement-stability"],
+        # the storm floods Pending demand but barely evicts (churn only),
+        # so placement-stability stays inside its burn budget
+        "placement-storm": [],
         "shard-failover": [],
         "upgrade-under-fire": [],
     }

@@ -1,0 +1,429 @@
+"""Incremental placement index (topology/index.py) and the batched
+gang-placement pass built on it.
+
+The load-bearing property: a long-lived ``FleetIndex`` fed any
+interleaving of watch deltas, resyncs, and book/release calls must
+serve byte-identical rankings to a ``FleetState`` rebuilt from scratch
+over the same nodes — candidate for candidate, including the
+UNLABELED_TPU chunking path and ``unschedulable_reason``. It runs as a
+stdlib seeded-random interleaving test always, and additionally under
+hypothesis when the package is installed.
+"""
+
+import random
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.slicerequest import (
+    KIND_SLICE_REQUEST,
+    PHASE_PENDING,
+    PHASE_PLACED,
+    PHASE_UNSCHEDULABLE,
+    V1ALPHA1,
+    SliceRequestSpec,
+    new_slice_request,
+)
+from tpu_operator.controllers.placement_controller import PlacementReconciler
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.runtime.objects import annotations_of, get_nested, thaw_obj
+from tpu_operator.topology.index import (
+    PLACEMENT_INDEX_GATE,
+    FleetIndex,
+    env_placement_index_enabled,
+)
+from tpu_operator.topology.placement import (
+    FleetState,
+    rank_candidates,
+    unschedulable_reason,
+)
+
+
+def add_tpu(c, name, accel="tpu-v5e-slice", topo="2x4", chips=4,
+            worker_id=None, pool=None):
+    labels = {
+        L.GKE_TPU_ACCELERATOR: accel,
+        L.GKE_TPU_TOPOLOGY: topo,
+        L.GKE_ACCELERATOR_COUNT: str(chips),
+    }
+    if worker_id is not None:
+        labels[L.GKE_TPU_WORKER_ID] = str(worker_id)
+    if pool is not None:
+        labels[L.GKE_NODEPOOL] = pool
+    return c.add_node(name, labels=labels,
+                      allocatable={"google.com/tpu": str(chips)})
+
+
+def churn_fleet():
+    """Heterogeneous fleet that exercises every index code path: a
+    labeled v5p 4x4 slice, v4 singles, and an UNLABELED v5e pool (no
+    worker ids) big enough to trigger the topology-chunking fallback."""
+    c = FakeClient()
+    for i in range(6):
+        add_tpu(c, f"v5e-{i}")                      # unlabeled -> chunked
+    for i in range(4):
+        add_tpu(c, f"v5p-{i}", accel="tpu-v5p-slice", topo="4x4",
+                worker_id=i)
+    for i in range(2):
+        add_tpu(c, f"v4-{i}", accel="tpu-v4-podslice", topo="2x2x1")
+    return c
+
+
+PROBES = [SliceRequestSpec(chips=n) for n in (4, 8, 16, 32)] + [
+    SliceRequestSpec(chips=8, accelerator="tpu-v5p-slice"),
+    SliceRequestSpec(chips=8, preferred_generations=("v5p", "v4")),
+    SliceRequestSpec(chips=10 ** 6),  # always unschedulable
+]
+
+
+def _assert_coherent(index, nodes, context):
+    fleet = FleetState(list(nodes.values()))
+    for spec in PROBES:
+        scratch = [c.sort_key() for c in rank_candidates(spec, fleet)]
+        served = [c.sort_key() for c in index.rank(spec)]
+        assert served == scratch, (context, spec.chips)
+        best = index.best(spec)
+        assert (best.sort_key() if best else None) == \
+            (scratch[0] if scratch else None), (context, spec.chips)
+        assert index.unschedulable_reason(spec) == \
+            unschedulable_reason(spec, fleet), (context, spec.chips)
+
+
+def _run_interleaving(seed, steps=60, check_every=12):
+    """Drive one seeded interleaving of node churn (via apply AND
+    resync), cordon flips, lease-annotation echoes, and direct
+    book/release; assert index == from-scratch FleetState along the
+    way. Shared by the always-on stdlib test and the hypothesis one."""
+    rng = random.Random(seed)
+    client = churn_fleet()
+    nodes = {get_nested(n, "metadata", "name"): thaw_obj(n)
+             for n in client.list("v1", "Node")}
+    index = FleetIndex(list(nodes.values()))
+    owners = {}
+
+    def mutate(name, fn, rv):
+        node = thaw_obj(nodes[name])
+        fn(node)
+        node["metadata"]["resourceVersion"] = str(rv)
+        nodes[name] = node
+        return node
+
+    for step in range(steps):
+        op = rng.random()
+        if op < 0.20 and nodes:  # lease-annotation echo
+            name = rng.choice(sorted(nodes))
+
+            def flip(node):
+                ann = node.setdefault("metadata", {}).setdefault(
+                    "annotations", {})
+                if rng.random() < 0.5:
+                    ann[L.PLACED_BY] = f"ns/req-{rng.randrange(6)}"
+                else:
+                    ann.pop(L.PLACED_BY, None)
+
+            index.apply("MODIFIED", mutate(name, flip, 1000 + step))
+        elif op < 0.38 and nodes:  # cordon flip, via apply or resync
+            name = rng.choice(sorted(nodes))
+
+            def cordon(node):
+                spec = node.setdefault("spec", {})
+                spec["unschedulable"] = not spec.get("unschedulable")
+
+            changed = mutate(name, cordon, 1000 + step)
+            if rng.random() < 0.5:
+                index.apply("MODIFIED", changed)
+            else:
+                index.resync(list(nodes.values()))
+        elif op < 0.50 and len(nodes) > 6:  # node removal
+            name = rng.choice(sorted(nodes))
+            gone = nodes.pop(name)
+            for held in owners.values():
+                held.discard(name)
+            index.apply("DELETED", gone)
+        elif op < 0.62:  # node join (keeps the unlabeled pool churning)
+            name = f"join-{step}"
+            add_tpu(client, name)
+            fresh = thaw_obj(client.get("v1", "Node", name))
+            nodes[name] = fresh
+            if rng.random() < 0.5:
+                index.apply("ADDED", fresh)
+            else:
+                index.resync(list(nodes.values()))
+        elif op < 0.85:  # place + book, mirrored into annotations
+            spec = rng.choice(PROBES[:6])
+            best = index.best(spec)
+            if best:
+                owner = f"ns/g-{step}"
+                index.book(best.nodes, owner)
+                owners[owner] = set(best.nodes)
+                for bound in best.nodes:
+                    if bound in nodes:
+                        def lease(node, o=owner):
+                            node.setdefault("metadata", {}).setdefault(
+                                "annotations", {})[L.PLACED_BY] = o
+                        mutate(bound, lease, 2000 + step)
+        elif owners:  # O(owned) release, echoed back
+            owner = rng.choice(sorted(owners))
+            held = owners.pop(owner)
+            index.release(owner=owner)
+            for bound in held:
+                if bound in nodes:
+                    def clear(node, o=owner):
+                        ann = node.setdefault("metadata", {}).setdefault(
+                            "annotations", {})
+                        if ann.get(L.PLACED_BY) == o:
+                            ann.pop(L.PLACED_BY)
+                    index.apply("MODIFIED",
+                                mutate(bound, clear, 2000 + step))
+        if step % check_every == 0:
+            _assert_coherent(index, nodes, (seed, step))
+    _assert_coherent(index, nodes, (seed, "final"))
+
+
+class TestIndexCoherenceProperty:
+    @pytest.mark.parametrize("seed", [0, 1, 7, 42, 1337])
+    def test_seeded_interleavings_match_rescan(self, seed):
+        """Stdlib fallback for the property: always runs, no hypothesis
+        needed — five fixed seeds over 60-step interleavings."""
+        _run_interleaving(seed)
+
+    def test_hypothesis_interleavings_match_rescan(self):
+        pytest.importorskip("hypothesis")
+        from hypothesis import HealthCheck, given, settings
+        from hypothesis import strategies as st
+
+        @settings(max_examples=20, deadline=None,
+                  suppress_health_check=list(HealthCheck))
+        @given(st.integers(min_value=0, max_value=2 ** 32 - 1))
+        def check(seed):
+            _run_interleaving(seed, steps=40, check_every=10)
+
+        check()
+
+    def test_snapshot_state_is_independent_trial_board(self):
+        index = FleetIndex(churn_fleet().list("v1", "Node"))
+        best = index.best(SliceRequestSpec(chips=8))
+        index.book(best.nodes, "ns/held")
+        twin = index.snapshot_state()
+        twin.release(owner="ns/held")  # trial drain
+        # the trial sees the capacity back...
+        assert rank_candidates(SliceRequestSpec(chips=8), twin)
+        # ...the live index still holds the lease
+        assert index.owned_nodes("ns/held") == tuple(sorted(best.nodes))
+
+
+class TestOwnerReverseIndex:
+    """FleetState.release(owner=) rides the owner->nodes reverse index:
+    O(nodes that owner holds), never a scan of the whole lease table."""
+
+    def test_release_by_owner_touches_only_owned_entries(self):
+        c = FakeClient()
+        for i in range(40):
+            add_tpu(c, f"n-{i:02d}")
+        fleet = FleetState(c.list("v1", "Node"))
+        for i in range(0, 36, 2):
+            fleet.book([f"n-{i:02d}", f"n-{i + 1:02d}"], f"ns/o-{i // 2}")
+
+        class CountingDict(dict):
+            pops = 0
+
+            def pop(self, *a):
+                CountingDict.pops += 1
+                return super().pop(*a)
+
+        fleet.owner_of = CountingDict(fleet.owner_of)
+        CountingDict.pops = 0
+        fleet.release(owner="ns/o-3")
+        # exactly the two owned entries left the table — not O(leases)
+        assert CountingDict.pops == 2
+        assert fleet.owned_nodes("ns/o-3") == ()
+        assert fleet.owned_nodes("ns/o-4") == ("n-08", "n-09")
+
+    def test_book_steal_keeps_reverse_index_consistent(self):
+        c = FakeClient()
+        for i in range(4):
+            add_tpu(c, f"n-{i}")
+        fleet = FleetState(c.list("v1", "Node"))
+        fleet.book(["n-0", "n-1"], "ns/a")
+        fleet.book(["n-1"], "ns/b")  # steal one
+        assert fleet.owned_nodes("ns/a") == ("n-0",)
+        assert fleet.owned_nodes("ns/b") == ("n-1",)
+        fleet.release(owner="ns/a")
+        fleet.release(owner="ns/b")
+        assert not fleet.owner_of and not fleet._owner_nodes
+
+
+class TestCacheDeltaListener:
+    """CachedClient.add_delta_listener: the informer-to-index hook fires
+    after the store reflects each change, for watch ingest and
+    write-through alike, and cancel() detaches it."""
+
+    def _cached(self):
+        from tpu_operator.runtime.cache import CachedClient
+
+        fake = churn_fleet()
+        return fake, CachedClient(fake)
+
+    def test_listener_sees_watch_and_write_through_deltas(self):
+        fake, cached = self._cached()
+        events = []
+        cancel = cached.add_delta_listener(
+            "v1", "Node", lambda et, obj: events.append(
+                (et, get_nested(obj, "metadata", "name"))))
+        cached.list("v1", "Node")  # prime the store
+        events.clear()
+        cached.patch("v1", "Node", "v5e-0",
+                     {"metadata": {"annotations": {L.PLACED_BY: "ns/x"}}})
+        assert ("MODIFIED", "v5e-0") in events
+        # the store already reflects the change when the listener fires
+        seen = annotations_of(cached.get("v1", "Node", "v5e-0"))
+        assert seen.get(L.PLACED_BY) == "ns/x"
+        cached.delete("v1", "Node", "v4-0")
+        assert ("DELETED", "v4-0") in events
+        n = len(events)
+        cancel()
+        cached.patch("v1", "Node", "v5e-1",
+                     {"metadata": {"annotations": {L.PLACED_BY: "ns/y"}}})
+        assert len(events) == n  # detached
+
+    def test_listener_exceptions_never_break_ingest(self):
+        fake, cached = self._cached()
+
+        def boom(et, obj):
+            raise RuntimeError("listener bug")
+
+        cached.add_delta_listener("v1", "Node", boom)
+        cached.list("v1", "Node")
+        cached.patch("v1", "Node", "v5e-0",
+                     {"metadata": {"labels": {"x": "y"}}})  # must not raise
+        assert cached.get("v1", "Node", "v5e-0") is not None
+
+
+@pytest.fixture
+def gate_on():
+    prev = PLACEMENT_INDEX_GATE.enabled
+    PLACEMENT_INDEX_GATE.enabled = True
+    yield
+    PLACEMENT_INDEX_GATE.enabled = prev
+
+
+@pytest.fixture
+def gate_off():
+    prev = PLACEMENT_INDEX_GATE.enabled
+    PLACEMENT_INDEX_GATE.enabled = False
+    yield
+    PLACEMENT_INDEX_GATE.enabled = prev
+
+
+class TestBatchedGangPlacement:
+    def make(self):
+        c = churn_fleet()
+        rec = PlacementReconciler(client=c, namespace="default")
+        return c, rec
+
+    def pend(self, c, name, **kw):
+        c.create(new_slice_request(
+            name, spec=SliceRequestSpec(**kw).to_obj(),
+            namespace="default"))
+        return Request(name=name, namespace="default")
+
+    def phase(self, c, name):
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, name, "default")
+        return get_nested(cr, "status", "phase")
+
+    def test_one_pass_drains_all_pending(self, gate_on):
+        """The tentpole batching contract: reconciling ONE pending
+        request places every queued sibling in the same pass, against
+        one shared index snapshot."""
+        from tpu_operator.metrics.operator_metrics import OPERATOR_METRICS
+
+        c, rec = self.make()
+        reqs = [self.pend(c, f"r-{i}", chips=4) for i in range(3)]
+        rec.reconcile(reqs[0])
+        assert [self.phase(c, f"r-{i}") for i in range(3)] == \
+            [PHASE_PLACED] * 3
+        assert OPERATOR_METRICS.placement_batch_size._value.get() == 3
+
+    def test_batch_places_by_priority_not_arrival(self, gate_on):
+        """Two requests contend for the only v5p domain; the
+        higher-priority one wins even though it arrived second."""
+        c, rec = self.make()
+        self.pend(c, "late-low", chips=16, accelerator="tpu-v5p-slice",
+                  priority=0)
+        self.pend(c, "high", chips=16, accelerator="tpu-v5p-slice",
+                  priority=5)
+        rec.reconcile(Request(name="late-low", namespace="default"))
+        assert self.phase(c, "high") == PHASE_PLACED
+        assert self.phase(c, "late-low") == PHASE_UNSCHEDULABLE
+
+    def test_batch_skips_unschedulable_siblings(self, gate_on):
+        """A sibling already in Unschedulable keeps its own backoff
+        cadence — the batch must not re-score it on every pass."""
+        c, rec = self.make()
+        big = self.pend(c, "big", chips=10 ** 4)
+        rec.reconcile(big)
+        assert self.phase(c, "big") == PHASE_UNSCHEDULABLE
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "big", "default")
+        rv = get_nested(cr, "metadata", "resourceVersion")
+        rec.reconcile(self.pend(c, "small", chips=4))
+        assert self.phase(c, "small") == PHASE_PLACED
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "big", "default")
+        assert get_nested(cr, "metadata", "resourceVersion") == rv
+
+    def test_in_pass_booking_prevents_double_grant(self, gate_on):
+        """Both pending requests want the single v5p 4x4 domain whole;
+        the batch books in-pass, so exactly one wins — no overlapping
+        leases, no stale-snapshot double grant."""
+        c, rec = self.make()
+        self.pend(c, "gang-a", chips=16, accelerator="tpu-v5p-slice")
+        self.pend(c, "gang-b", chips=16, accelerator="tpu-v5p-slice")
+        rec.reconcile(Request(name="gang-a", namespace="default"))
+        phases = sorted([self.phase(c, "gang-a"), self.phase(c, "gang-b")])
+        assert phases == [PHASE_PLACED, PHASE_UNSCHEDULABLE]
+        leased = [get_nested(n, "metadata", "name")
+                  for n in c.list("v1", "Node")
+                  if annotations_of(n).get(L.PLACED_BY)]
+        assert len(leased) == 4  # one grant, not two overlapping
+
+    def test_kill_switch_falls_back_to_per_request(self, gate_off):
+        """OPERATOR_PLACEMENT_INDEX=0: the triggering request still
+        places (FleetState path), but siblings wait for their own
+        reconcile — the pre-index behavior, exactly."""
+        c, rec = self.make()
+        reqs = [self.pend(c, f"r-{i}", chips=4) for i in range(3)]
+        rec.reconcile(reqs[0])
+        assert self.phase(c, "r-0") == PHASE_PLACED
+        assert self.phase(c, "r-1") is None  # untouched this pass
+        rec.reconcile(reqs[1])
+        assert self.phase(c, "r-1") == PHASE_PLACED
+
+    def test_index_survives_eviction_and_replace(self, gate_on):
+        """Controller-driven lifecycle keeps the long-lived index
+        coherent: place, kill a bound node, evict, re-place — then the
+        index's view must equal a from-scratch rescan."""
+        c, rec = self.make()
+        req = self.pend(c, "a", chips=4)
+        rec.reconcile(req)
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "a", "default")
+        [bound] = get_nested(cr, "status", "nodes")
+        c.delete("v1", "Node", bound)
+        rec.reconcile(req)  # eviction
+        assert self.phase(c, "a") == PHASE_PENDING
+        rec.reconcile(req)  # re-place
+        assert self.phase(c, "a") == PHASE_PLACED
+        nodes = {get_nested(n, "metadata", "name"): thaw_obj(n)
+                 for n in c.list("v1", "Node")}
+        engine = rec._fleet_snapshot()
+        assert isinstance(engine, FleetIndex)
+        _assert_coherent(engine, nodes, "post-eviction")
+
+
+class TestKillSwitchEnv:
+    def test_env_spellings(self):
+        for off in ("0", "false", "no", "off", " OFF "):
+            assert not env_placement_index_enabled(
+                {"OPERATOR_PLACEMENT_INDEX": off})
+        for on in ("1", "true", "yes", "on", ""):
+            assert env_placement_index_enabled(
+                {"OPERATOR_PLACEMENT_INDEX": on})
+        assert env_placement_index_enabled({})  # default on

@@ -596,6 +596,152 @@ def run_placement_bench(n_tpu: int = 500, n_requests: int = 2000,
     }
 
 
+def run_placement_fleet_bench(n_tpu: int = 10000, baseline_tpu: int = 500,
+                              n_requests: int = 5000, lifetime: int = 300,
+                              rescan_sample: int = 40,
+                              seed: int = 0) -> Dict:
+    """Placement at fleet scale: the incremental index vs the per-request
+    rescan, and p99 flatness from ``baseline_tpu`` to ``n_tpu`` nodes.
+
+    The same seeded request stream (same shape mix as
+    ``run_placement_bench``: sizes, hard pins, soft preferences,
+    lifetime-slot releases) is driven three ways:
+
+    - **indexed @ baseline_tpu** — one long-lived ``FleetIndex``, per
+      decision a ``best()`` heap peek; the 500-node p99 anchor. The
+      anchor's lease lifetime is scaled by the fleet ratio (weak
+      scaling) so both runs hold the same utilization fraction —
+      otherwise the small fleet saturates and its p99 measures the
+      cheap nothing-fits path instead of real decisions.
+    - **indexed @ n_tpu** — the same, at fleet scale. The tentpole
+      target is ``placement_fleet_p99_ms`` within 2x of the anchor:
+      decision cost tracks *dirtied domains*, not fleet size.
+    - **rescan @ n_tpu** — what the controller does under
+      ``OPERATOR_PLACEMENT_INDEX=0``: a fresh ``FleetState(nodes)`` +
+      full ``rank_candidates`` per request. Driven over a small sample
+      (``rescan_sample``) because at 10k nodes it is the slow path by
+      design; its throughput is extrapolated from that sample.
+
+    Guard keys: ``placement_fleet_p99_ms`` (lower is better) and
+    ``placement_storm_rps`` (higher is better), both pinned by
+    tests/test_bench_guard.py."""
+    import random
+
+    from ..api.slicerequest import SliceRequestSpec
+    from ..topology.index import FleetIndex
+    from ..topology.placement import FleetState, rank_candidates
+
+    rng = random.Random(seed)
+    sizes = (4, 4, 8, 8, 16, 32)
+    specs = []
+    for _ in range(n_requests):
+        kw = {"chips": rng.choice(sizes)}
+        r = rng.random()
+        if r < 0.15:
+            kw["accelerator"] = rng.choice(
+                ("tpu-v5e-slice", "tpu-v5p-slice", "tpu-v4-podslice"))
+        elif r < 0.40:
+            kw["preferred_generations"] = rng.sample(
+                ["v4", "v5e", "v5p"], 2)
+        specs.append(SliceRequestSpec(**kw))
+
+    def pct(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1000.0
+
+    def drive_indexed(nodes, slots) -> Dict:
+        index = FleetIndex(nodes)
+        # steady-state warmup: a novel request *shape* pays one O(fleet)
+        # fragment build on first sight, amortized over the shape's
+        # lifetime in a long-lived controller index. Touch each distinct
+        # shape once untimed so the measured distribution is the steady
+        # state the controller actually runs in.
+        seen = set()
+        for spec in specs:
+            sk = FleetIndex._spec_key(spec)
+            if sk not in seen:
+                seen.add(sk)
+                index.best(spec)
+        live: Dict[int, tuple] = {}
+        lat = []
+        placed = unschedulable = 0
+        t_all = time.perf_counter()
+        for i, spec in enumerate(specs):
+            gone = i - slots
+            if gone in live:
+                index.release(node_names=live.pop(gone))
+            t0 = time.perf_counter()
+            best = index.best(spec)
+            lat.append(time.perf_counter() - t0)
+            if best is None:
+                unschedulable += 1
+            else:
+                index.book(best.nodes, f"bench/r{i}")
+                live[i] = best.nodes
+                placed += 1
+        wall = time.perf_counter() - t_all
+        return {
+            "placed": placed, "unschedulable": unschedulable,
+            "p50_ms": pct(lat, 0.50), "p99_ms": pct(lat, 0.99),
+            "rps": len(specs) / wall if wall > 0 else 0.0,
+            "stats": index.index_stats(),
+        }
+
+    def drive_rescan(nodes) -> Dict:
+        # the OPERATOR_PLACEMENT_INDEX=0 controller path per request:
+        # rebuild the fleet view, replay the live leases (the annotation
+        # ingest a real rebuild performs), full rank
+        live: Dict[int, tuple] = {}
+        lat = []
+        t_all = time.perf_counter()
+        n = min(rescan_sample, len(specs))
+        for i, spec in enumerate(specs[:n]):
+            gone = i - lifetime
+            if gone in live:
+                live.pop(gone)
+            t0 = time.perf_counter()
+            fleet = FleetState(nodes)
+            for j, ns in live.items():
+                fleet.book(ns, f"bench/r{j}")
+            ranked = rank_candidates(spec, fleet)
+            lat.append(time.perf_counter() - t0)
+            if ranked:
+                live[i] = ranked[0].nodes
+        wall = time.perf_counter() - t_all
+        return {
+            "sample": n, "p99_ms": pct(lat, 0.99),
+            "rps": n / wall if wall > 0 else 0.0,
+        }
+
+    base_nodes = build_cluster(baseline_tpu).list("v1", "Node")
+    fleet_nodes = build_cluster(n_tpu).list("v1", "Node")
+    # weak scaling: hold the live-lease fraction constant across fleet
+    # sizes so the anchor p99 measures real decisions, not saturation
+    anchor_slots = max(1, round(lifetime * baseline_tpu / n_tpu))
+    anchor = drive_indexed(base_nodes, anchor_slots)
+    indexed = drive_indexed(fleet_nodes, lifetime)
+    rescan = drive_rescan(fleet_nodes)
+    return {
+        "n_tpu_nodes": n_tpu,
+        "baseline_tpu_nodes": baseline_tpu,
+        "n_requests": n_requests,
+        "lifetime": lifetime,
+        "indexed_placed": indexed["placed"],
+        "indexed_unschedulable": indexed["unschedulable"],
+        "placement_baseline_p99_ms": anchor["p99_ms"],
+        "placement_fleet_p99_ms": indexed["p99_ms"],
+        "p99_flatness_x": (indexed["p99_ms"] / anchor["p99_ms"]
+                           if anchor["p99_ms"] > 0 else 0.0),
+        "placement_storm_rps": indexed["rps"],
+        "rescan_sample": rescan["sample"],
+        "rescan_rps": rescan["rps"],
+        "rescan_p99_ms": rescan["p99_ms"],
+        "storm_speedup_x": (indexed["rps"] / rescan["rps"]
+                            if rescan["rps"] > 0 else 0.0),
+        "index_stats": indexed["stats"],
+    }
+
+
 def run_migration_bench(n_tpu: int = 100, n_requests: int = 6,
                         pass_budget: int = 300, seed: int = 0) -> Dict:
     """Workload recovery latency across a full driver rollout: the

@@ -131,6 +131,7 @@ class FaultPlan:
             "operand-drift": cls._operand_drift,
             "dag-race": cls._dag_race,
             "placement-contention": cls._placement_contention,
+            "placement-storm": cls._placement_storm,
             "slice-migrate": cls._slice_migrate,
             "shard-failover": cls._shard_failover,
         }.get(scenario)
@@ -307,6 +308,49 @@ class FaultPlan:
                 # a bound node vanishing is the explicit drain event the
                 # eviction path exists for; never remove a node scheduled
                 # to heal later
+                flapped = {f.arg for f in out if f.kind == NODE_FLAP}
+                candidates = [n for n in nodes if n not in flapped]
+                if candidates:
+                    victim = rng.choice(candidates)
+                    nodes.remove(victim)
+                    out.append(Fault(step, NODE_REMOVE, arg=victim))
+        return out
+
+    @classmethod
+    def _placement_storm(cls, rng, nodes, steps) -> List[Fault]:
+        """Batched-gang-placement stress: the whole demand wave lands
+        Pending in the opening steps (2 requests per TPU node — 2k
+        requests on a 1k-node fleet), so the controller's first passes
+        drain deep batches against one shared index snapshot while nodes
+        flap, join and vanish and watch drops force the index through
+        its relist/resync healing. The index-coherence invariant then
+        holds the O(delta) view to a from-scratch rescan at settle."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8, 8, 16, 32)
+        flood = max(24, 2 * len(nodes))
+        front = max(1, min(3, steps))
+        for i in range(flood):
+            out.append(Fault(i % front, SLICE_REQUEST,
+                             arg=f"storm-{i:04d}",
+                             count=rng.choice(sizes),
+                             seconds=float(rng.randrange(0, 3))))
+        join = 0
+        for step in range(steps):
+            if step % 3 == 1 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(step + 2, steps - 1), NODE_HEAL,
+                                 arg=victim))
+            if step % 4 == 2:
+                join += 1
+                out.append(Fault(step, NODE_ADD, arg=f"storm-join-{join}"))
+            if step % 5 == 3:
+                out.append(Fault(step, WATCH_DROP))
+            if step % 6 == 4:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 7 == 5 and len(nodes) > 1:
+                # never remove a node scheduled to heal later
                 flapped = {f.arg for f in out if f.kind == NODE_FLAP}
                 candidates = [n for n in nodes if n not in flapped]
                 if candidates:

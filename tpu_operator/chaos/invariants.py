@@ -58,6 +58,15 @@ point*, not just at convergence:
   regresses, and every restore (``status.migration.restoredStep``
   changing) lands at or above it — acknowledged training work must
   survive any migrate/resize/crash interleaving the storm produces.
+- ``index-coherence``: the incremental placement index
+  (:class:`~tpu_operator.topology.index.FleetIndex`), fed O(delta) from
+  the node-list diffs between observation points exactly as the
+  placement controller's resync path feeds it, must rank
+  candidate-for-candidate identically to a from-scratch ``FleetState``
+  — same ``sort_key`` order, same ``unschedulable_reason`` — for a
+  panel of probe request shapes at every settle point. A divergence
+  means the O(delta) maintenance lost or invented structure the full
+  rebuild sees.
 - ``lane-priority`` (recorded by the runner): no health-lane event may
   be dequeued having waited behind more than the runner's
   ``LANE_PRIORITY_BUDGET`` bulk reconciles — the workload-aware
@@ -120,6 +129,9 @@ class InvariantChecker:
         # request key -> (acked high-water step, last restoredStep seen)
         # for the no-lost-work audit
         self._work: Dict[str, Tuple[Optional[int], Optional[int]]] = {}
+        # long-lived FleetIndex fed by node-list diffs across the whole
+        # run (index-coherence); built lazily on the first observation
+        self._fleet_index = None
 
     def record(self, invariant: str, step: int, detail: str) -> None:
         self.violations.append(Violation(invariant, step, detail))
@@ -140,6 +152,67 @@ class InvariantChecker:
         self._check_dag(step)
         self._check_placement(step, nodes, settled=False)
         self._check_work(step)
+        self._feed_index(nodes)
+
+    # -- incremental-index coherence ----------------------------------------
+
+    def _feed_index(self, nodes: Dict[str, dict]) -> None:
+        from ..topology.index import FleetIndex
+
+        if self._fleet_index is None:
+            self._fleet_index = FleetIndex(list(nodes.values()))
+        else:
+            # the same O(delta) diff feed the controller uses when the
+            # client has no delta hook — so the index under audit has
+            # lived through every churn step, never a fresh rebuild
+            self._fleet_index.resync(list(nodes.values()))
+
+    def _check_index(self, step: int, nodes: Dict[str, dict]) -> None:
+        """index-coherence (see module docstring): candidate-for-candidate
+        equality between the run-long incrementally-fed FleetIndex and a
+        from-scratch FleetState, across probe shapes covering plain,
+        pinned, preferred-generation, and infeasible requests."""
+        from ..api.slicerequest import SliceRequestSpec
+        from ..topology.placement import (
+            FleetState,
+            rank_candidates,
+            unschedulable_reason,
+        )
+
+        self._feed_index(nodes)
+        idx = self._fleet_index
+        scratch = FleetState(list(nodes.values()))
+        probes = [SliceRequestSpec(chips=c) for c in (4, 8, 16, 32)]
+        probes += [SliceRequestSpec(chips=8,
+                                    accelerator="tpu-v5p-slice"),
+                   SliceRequestSpec(chips=8,
+                                    preferred_generations=("v5p",))]
+        for spec in probes:
+            want = [c.sort_key() for c in rank_candidates(spec, scratch)]
+            got = [c.sort_key() for c in idx.rank(spec)]
+            if got != want:
+                self.record(
+                    "index-coherence", step,
+                    f"spec chips={spec.chips_needed()} "
+                    f"acc={spec.accelerator!r}: index ranked "
+                    f"{len(got)} candidates (top {got[:1]}), rescan "
+                    f"ranked {len(want)} (top {want[:1]})")
+            best = idx.best(spec)
+            top = (best.sort_key() if best is not None else None)
+            if top != (want[0] if want else None):
+                self.record(
+                    "index-coherence", step,
+                    f"spec chips={spec.chips_needed()}: index best() "
+                    f"{top} != rescan top "
+                    f"{want[0] if want else None}")
+        impossible = SliceRequestSpec(chips=10 ** 6)
+        want_reason = unschedulable_reason(impossible, scratch)
+        got_reason = idx.unschedulable_reason(impossible)
+        if got_reason != want_reason:
+            self.record(
+                "index-coherence", step,
+                f"unschedulable_reason diverged: index {got_reason!r} "
+                f"!= rescan {want_reason!r}")
 
     # -- slice placement ----------------------------------------------------
 
@@ -502,6 +575,7 @@ class InvariantChecker:
         nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
         self._check_placement(step, nodes, settled=True)
         self._check_work(step)
+        self._check_index(step, nodes)
 
 
 def namespace_key(obj: dict) -> str:

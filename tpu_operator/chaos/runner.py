@@ -102,8 +102,8 @@ from .invariants import InvariantChecker
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
-             "dag-race", "placement-contention", "slice-migrate",
-             "shard-failover")
+             "dag-race", "placement-contention", "placement-storm",
+             "slice-migrate", "shard-failover")
 
 # virtual deadlines for the slice-migrate scenario, sized in runner steps
 # (STEP_DT each): long enough for the elastic handshake (~3 passes),
@@ -879,8 +879,12 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     # also exercises the priority-eviction path under fire; the migrate
     # scenario keeps it off so every rebind is a migration, not an
     # eviction, and runs on the virtual clock for the intent deadlines.
+    # the storm scenario keeps preemption off: its whole demand wave is
+    # same-age Pending, so the interesting machinery is the batched gang
+    # pass and the index's churn survival, not the eviction path
     place_ctrl = None
-    if scenario in ("placement-contention", "slice-migrate"):
+    if scenario in ("placement-contention", "placement-storm",
+                    "slice-migrate"):
         lrec = PlacementReconciler(
             client=traced, namespace=NAMESPACE,
             preemption=(scenario == "placement-contention"),

@@ -828,8 +828,22 @@ def _place(args) -> int:
     fleet = FleetState(nodes)
     ranked = rank_candidates(spec, fleet)
     shown = ranked[:args.top] if args.top > 0 else ranked
+    stats = None
+    if getattr(args, "index_stats", False):
+        # the same fixture through the incremental index the controller
+        # runs: structure counters plus an agreement bit against the
+        # from-scratch ranking just computed — the field check for
+        # "is the index serving what a rescan would"
+        from ..topology.index import FleetIndex
+
+        index = FleetIndex(nodes)
+        served = index.rank(spec)
+        stats = index.index_stats()
+        stats["agrees_with_rescan"] = (
+            [c.sort_key() for c in served]
+            == [c.sort_key() for c in ranked])
     if args.output == "json":
-        print(json.dumps({
+        doc = {
             "request": spec.to_obj(),
             "candidates": [{
                 "pool": c.pool, "slice": c.slice_id,
@@ -839,7 +853,10 @@ def _place(args) -> int:
                               for k, v in sorted(c.breakdown.items())},
             } for c in shown],
             "reason": None if ranked else unschedulable_reason(spec, fleet),
-        }, indent=2, sort_keys=True))
+        }
+        if stats is not None:
+            doc["index_stats"] = stats
+        print(json.dumps(doc, indent=2, sort_keys=True))
         return 0 if ranked else 1
     totals = fleet.chip_totals()
     fleet_line = " ".join(
@@ -851,6 +868,13 @@ def _place(args) -> int:
           + (f" accelerator={spec.accelerator}" if spec.accelerator else "")
           + (f" prefer={','.join(spec.preferred_generations)}"
              if spec.preferred_generations else ""))
+    if stats is not None:
+        print(f"index: nodes={stats['nodes']} pools={stats['pools']} "
+              f"domains={stats['domains']} leases={stats['leases']} "
+              f"spec_shapes={stats['spec_shapes']} "
+              f"heap_entries={stats['heap_entries']}")
+        print("index agrees with rescan: "
+              + ("yes" if stats["agrees_with_rescan"] else "NO"))
     if not ranked:
         print(f"UNSCHEDULABLE: {unschedulable_reason(spec, fleet)}")
         return 1
@@ -1073,6 +1097,12 @@ def main(argv=None) -> int:
     pl.add_argument("--top", type=int, default=10,
                     help="candidates shown with --explain/-o json "
                          "(0 = all)")
+    pl.add_argument("--index-stats", action="store_true",
+                    dest="index_stats",
+                    help="also build the incremental placement index "
+                         "over the fixture and print its structure "
+                         "counters plus an agreement check against "
+                         "the from-scratch ranking")
     pl.add_argument("-o", "--output", choices=("text", "json"),
                     default="text")
 

@@ -74,6 +74,7 @@ from ..runtime.objects import (
     set_nested,
     thaw_obj,
 )
+from ..topology.index import PLACEMENT_INDEX_GATE, FleetIndex
 from ..topology.placement import (
     FleetState,
     rank_candidates,
@@ -163,6 +164,13 @@ class PlacementReconciler(Reconciler):
         # place-and-bind is read-rank-annotate: serialized so N workers
         # placing different requests can't both observe a node as free
         self._bind_lock = threading.Lock()
+        # long-lived incremental fleet view (OPERATOR_PLACEMENT_INDEX=0
+        # falls back to per-request FleetState rebuilds). When the client
+        # exposes a delta-listener hook the index rides watch events in
+        # O(delta); otherwise each pass resyncs it from a list diff.
+        self._index: Optional[FleetIndex] = None
+        self._index_live = False
+        self._index_mu = threading.RLock()
         # Unschedulable backoff attempt per request key; reset on any
         # successful placement or deletion. In-memory by design: a
         # controller restart restarting the schedule from the fast end
@@ -207,8 +215,6 @@ class PlacementReconciler(Reconciler):
                     _time.perf_counter() - started)
 
     def _reconcile(self, request: Request) -> Result:
-        import time as _time
-
         key = f"{request.namespace or 'default'}/{request.name}"
         live = self.client.get_or_none(
             V1ALPHA1, KIND_SLICE_REQUEST, request.name,
@@ -281,74 +287,169 @@ class PlacementReconciler(Reconciler):
             log.info("request %s drained: %s", key, broken)
             return Result(requeue=True)
 
-        # Pending / Unschedulable / new: run a scoring pass
-        t0 = _time.perf_counter()
+        # Pending / Unschedulable / new: run a scoring pass. With the
+        # incremental index enabled, all Pending siblings visible right
+        # now ride the same pass against one shared snapshot with
+        # in-pass booking (batched gang placement) — a mass submission
+        # costs one fleet view, not one rebuild per request. Their own
+        # queued reconciles then no-op (they observe Placed) or pick up
+        # their backoff (they observe Unschedulable).
         with self._bind_lock:
-            from ..runtime.tracing import TRACER
+            engine = self._fleet_snapshot()
+            if PLACEMENT_INDEX_GATE.enabled:
+                batch = self._drain_batch(key, cr, live, spec)
+            else:
+                batch = [(key, cr, live, spec)]
+            OPERATOR_METRICS.placement_batch_size.set(len(batch))
+            my_result = Result()
+            for bkey, bcr, blive, bspec in batch:
+                res = self._place_one(bkey, bcr, blive, bspec, engine)
+                if bkey == key:
+                    my_result = res
+            self._export_gauges(None, fleet=engine)
+        return my_result
 
-            nodes = self.client.list("v1", "Node")
-            fleet = FleetState(nodes)
-            with TRACER.trace("placement.score", key):
-                ranked = rank_candidates(spec, fleet, reclaim=key)
-            if not ranked and self.preemption and self._preempt(spec, key):
-                # bind in THIS pass: requeueing instead would let the
-                # victims re-place onto the freed nodes before we run
-                # again — a preemption livelock
-                nodes = self.client.list("v1", "Node")
-                fleet = FleetState(nodes)
-                ranked = rank_candidates(spec, fleet, reclaim=key)
-            if not ranked:
-                # a partially-failed earlier bind may have leased nodes
-                # before crashing; nothing fits now, so hand them back
-                # rather than strand them behind an Unschedulable request
-                self._release_leases(key)
-                reason = unschedulable_reason(spec, fleet)
-                set_nested(cr, PHASE_UNSCHEDULABLE, "status", "phase")
-                set_nested(cr, [], "status", "nodes")
-                set_nested(cr, reason, "status", "reason")
-                update_status_with_retry(self.client, cr, live=live)
-                OPERATOR_METRICS.placement_decisions.labels(
-                    outcome="unschedulable").inc()
-                if TIMELINE.enabled:
-                    TIMELINE.record("SliceRequest", key, "unschedulable",
-                                    {"controller": self.name,
-                                     "reason": reason})
-                OPERATOR_METRICS.placement_latency.observe(
-                    _time.perf_counter() - t0)
-                self._export_gauges(nodes)
-                attempt = self._unsched_attempts.get(key, 0)
-                self._unsched_attempts[key] = attempt + 1
-                OPERATOR_METRICS.placement_requeues.inc()
-                return Result(
-                    requeue_after=unschedulable_backoff(key, attempt))
+    # -- placement pass ----------------------------------------------------
 
-            best = ranked[0]
-            # drop any stale self-leases outside the chosen window, then
-            # lease the window BEFORE publishing status: a crash between
-            # the two leaves leased-but-Pending (recoverable via
-            # reclaim), never Placed-but-unleased
-            chosen = set(best.nodes)
-            for node in nodes:
-                n = name_of(node)
-                if (annotations_of(node).get(L.PLACED_BY) == key
-                        and n not in chosen):
-                    self.client.patch(
-                        "v1", "Node", n,
-                        {"metadata": {"annotations": {L.PLACED_BY: None}}})
-            for n in best.nodes:
+    def _fleet_snapshot(self):
+        """The pass's bookable fleet view: the long-lived FleetIndex
+        (built once, then O(delta) via the client's delta listener or a
+        per-pass list diff), or a fresh FleetState when the index is
+        killed — either way ONE snapshot per pass, shared by scoring,
+        preemption trials, lease bookkeeping and gauges."""
+        if not PLACEMENT_INDEX_GATE.enabled:
+            return FleetState(self.client.list("v1", "Node"))
+        with self._index_mu:
+            idx = self._index
+            if idx is None:
+                reg = getattr(self.client, "add_delta_listener", None)
+                if callable(reg):
+                    # register BEFORE the seeding list: deltas racing the
+                    # build block on the init lock and fold in after it
+                    reg("v1", "Node", self._on_node_delta)
+                    self._index_live = True
+                idx = FleetIndex(self.client.list("v1", "Node"))
+                self._index = idx
+                OPERATOR_METRICS.placement_index_updates.labels(
+                    event="replace").inc()
+                return idx
+        if not self._index_live:
+            idx.resync(self.client.list("v1", "Node"))
+            OPERATOR_METRICS.placement_index_updates.labels(
+                event="resync").inc()
+        return idx
+
+    def _on_node_delta(self, event_type: str, obj: dict) -> None:
+        with self._index_mu:
+            idx = self._index
+            if idx is None:
+                # pre-build replay; the seeding list covers these
+                return
+            idx.apply(event_type, obj)
+        OPERATOR_METRICS.placement_index_updates.labels(
+            event=str(event_type).lower()).inc()
+
+    def _drain_batch(self, key: str, cr: dict, live: dict,
+                     spec: SliceRequestSpec) -> list:
+        """The gang for this pass: every Pending/new SliceRequest
+        visible now, ordered by priority (desc), age, key. Unschedulable
+        siblings keep their own backoff cadence — re-scoring them on
+        every sibling's pass would defeat it — but the triggering
+        request always rides, whatever its phase."""
+        batch = {key: (cr, live, spec)}
+        for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+            okey = f"{namespace_of(other) or 'default'}/{name_of(other)}"
+            if okey in batch:
+                continue
+            if get_nested(other, "status", "phase") in (
+                    PHASE_PLACED, PHASE_UNSCHEDULABLE):
+                continue
+            ocr = thaw_obj(other)
+            batch[okey] = (ocr, other, SliceRequestSpec.from_obj(ocr))
+
+        def order(item):
+            k, (c, _unused, s) = item
+            return (-int(s.priority or 0),
+                    str(get_nested(c, "metadata", "creationTimestamp",
+                                   default="") or ""), k)
+
+        return [(k, c, l, s)
+                for k, (c, l, s) in sorted(batch.items(), key=order)]
+
+    def _best_for(self, spec: SliceRequestSpec, key: str, engine):
+        if isinstance(engine, FleetIndex):
+            return engine.best(spec, reclaim=key)
+        ranked = rank_candidates(spec, engine, reclaim=key)
+        return ranked[0] if ranked else None
+
+    def _place_one(self, key: str, cr: dict, live: dict,
+                   spec: SliceRequestSpec, engine) -> Result:
+        """One request's placement decision against the pass's shared
+        snapshot. Caller holds the bind lock."""
+        import time as _time
+
+        from ..runtime.tracing import TRACER
+
+        t0 = _time.perf_counter()
+        with TRACER.trace("placement.score", key):
+            best = self._best_for(spec, key, engine)
+        if best is None and self.preemption \
+                and self._preempt(spec, key, engine):
+            # bind in THIS pass: requeueing instead would let the
+            # victims re-place onto the freed nodes before we run
+            # again — a preemption livelock
+            best = self._best_for(spec, key, engine)
+        if best is None:
+            # a partially-failed earlier bind may have leased nodes
+            # before crashing; nothing fits now, so hand them back
+            # rather than strand them behind an Unschedulable request
+            self._release_leases(key, engine=engine)
+            reason = engine.unschedulable_reason(spec) \
+                if isinstance(engine, FleetIndex) \
+                else unschedulable_reason(spec, engine)
+            set_nested(cr, PHASE_UNSCHEDULABLE, "status", "phase")
+            set_nested(cr, [], "status", "nodes")
+            set_nested(cr, reason, "status", "reason")
+            update_status_with_retry(self.client, cr, live=live)
+            OPERATOR_METRICS.placement_decisions.labels(
+                outcome="unschedulable").inc()
+            if TIMELINE.enabled:
+                TIMELINE.record("SliceRequest", key, "unschedulable",
+                                {"controller": self.name,
+                                 "reason": reason})
+            OPERATOR_METRICS.placement_latency.observe(
+                _time.perf_counter() - t0)
+            attempt = self._unsched_attempts.get(key, 0)
+            self._unsched_attempts[key] = attempt + 1
+            OPERATOR_METRICS.placement_requeues.inc()
+            return Result(
+                requeue_after=unschedulable_backoff(key, attempt))
+
+        # drop any stale self-leases outside the chosen window, then
+        # lease the window BEFORE publishing status: a crash between
+        # the two leaves leased-but-Pending (recoverable via
+        # reclaim), never Placed-but-unleased
+        chosen = set(best.nodes)
+        for n in engine.owned_nodes(key):
+            if n not in chosen:
                 self.client.patch(
                     "v1", "Node", n,
-                    {"metadata": {"annotations": {L.PLACED_BY: key}}})
-            fleet.book(best.nodes, key)
-            set_nested(cr, PHASE_PLACED, "status", "phase")
-            set_nested(cr, sorted(best.nodes), "status", "nodes")
-            set_nested(cr, best.pool, "status", "pool")
-            set_nested(cr, best.slice_id, "status", "sliceId")
-            set_nested(cr, f"{best.score:.6f}", "status", "score")
-            set_nested(cr, spec.chips_needed(), "status", "chips")
-            pop_nested(cr, "status", "reason")
-            update_status_with_retry(self.client, cr, live=live)
-            self._unsched_attempts.pop(key, None)
+                    {"metadata": {"annotations": {L.PLACED_BY: None}}})
+                engine.release([n])
+        for n in best.nodes:
+            self.client.patch(
+                "v1", "Node", n,
+                {"metadata": {"annotations": {L.PLACED_BY: key}}})
+        engine.book(best.nodes, key)
+        set_nested(cr, PHASE_PLACED, "status", "phase")
+        set_nested(cr, sorted(best.nodes), "status", "nodes")
+        set_nested(cr, best.pool, "status", "pool")
+        set_nested(cr, best.slice_id, "status", "sliceId")
+        set_nested(cr, f"{best.score:.6f}", "status", "score")
+        set_nested(cr, spec.chips_needed(), "status", "chips")
+        pop_nested(cr, "status", "reason")
+        update_status_with_retry(self.client, cr, live=live)
+        self._unsched_attempts.pop(key, None)
         OPERATOR_METRICS.placement_decisions.labels(outcome="placed").inc()
         OPERATOR_METRICS.placement_latency.observe(
             _time.perf_counter() - t0)
@@ -357,7 +458,6 @@ class PlacementReconciler(Reconciler):
                             {"controller": self.name, "pool": best.pool,
                              "score": f"{best.score:.6f}",
                              "nodes": sorted(best.nodes)})
-        self._export_gauges(None)
         log.info("request %s placed on %s (%d nodes, score %s)",
                  key, best.pool, len(best.nodes), f"{best.score:.6f}")
         return Result()
@@ -481,7 +581,18 @@ class PlacementReconciler(Reconciler):
                         f"pin {spec.accelerator!r}")
         return None
 
-    def _release_leases(self, key: str) -> int:
+    def _release_leases(self, key: str, engine=None) -> int:
+        if isinstance(engine, FleetIndex):
+            # the index's owner ledger covers every annotated node
+            # (including ineligible ones), so this is O(owned), not a
+            # fleet scan
+            names = engine.owned_nodes(key)
+            for n in names:
+                self.client.patch(
+                    "v1", "Node", n,
+                    {"metadata": {"annotations": {L.PLACED_BY: None}}})
+            engine.release(owner=key)
+            return len(names)
         released = 0
         for node in self.client.list("v1", "Node"):
             if annotations_of(node).get(L.PLACED_BY) == key:
@@ -489,11 +600,15 @@ class PlacementReconciler(Reconciler):
                     "v1", "Node", name_of(node),
                     {"metadata": {"annotations": {L.PLACED_BY: None}}})
                 released += 1
+        if engine is not None:
+            engine.release(owner=key)
         return released
 
-    def _preempt(self, spec: SliceRequestSpec, key: str) -> bool:
+    def _preempt(self, spec: SliceRequestSpec, key: str, engine) -> bool:
         """Drain lower-priority Placed requests, lowest first, until the
-        request fits. Returns True when at least one victim was drained."""
+        request fits. Returns True when at least one victim was drained.
+        Feasibility is probed on a cloned trial board; actual drains are
+        folded back into the pass's shared snapshot."""
         my_prio = int(spec.priority or 0)
         victims = []
         for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
@@ -512,8 +627,10 @@ class PlacementReconciler(Reconciler):
         # drained? A request that can never fit (too big for any ICI
         # domain) must not thrash the fleet evicting workloads it cannot
         # use — without this the infeasible request re-preempts the whole
-        # lower-priority tier on every requeue, forever
-        trial = FleetState(self.client.list("v1", "Node"))
+        # lower-priority tier on every requeue, forever. The trial board
+        # shares the pass snapshot's structure instead of relisting.
+        trial = engine.snapshot_state() if isinstance(engine, FleetIndex) \
+            else engine.clone()
         for _, okey, _ in victims:
             trial.release(owner=okey)
         if not rank_candidates(spec, trial, reclaim=key):
@@ -521,7 +638,7 @@ class PlacementReconciler(Reconciler):
         drained = 0
         for _, okey, other in victims:
             ocr = thaw_obj(other)
-            self._release_leases(okey)
+            self._release_leases(okey, engine=engine)
             set_nested(ocr, PHASE_PENDING, "status", "phase")
             set_nested(ocr, [], "status", "nodes")
             set_nested(ocr, int(get_nested(ocr, "status", "evictions",
@@ -533,15 +650,17 @@ class PlacementReconciler(Reconciler):
             OPERATOR_METRICS.placement_decisions.labels(
                 outcome="evicted").inc()
             drained += 1
-            fleet = FleetState(self.client.list("v1", "Node"))
-            if rank_candidates(spec, fleet, reclaim=key):
+            if self._best_for(spec, key, engine) is not None:
                 break
         return drained > 0
 
-    def _export_gauges(self, nodes: Optional[list]) -> None:
-        if nodes is None:
-            nodes = self.client.list("v1", "Node")
-        for gen, bucket in sorted(FleetState(nodes).chip_totals().items()):
+    def _export_gauges(self, nodes: Optional[list],
+                       fleet=None) -> None:
+        if fleet is None:
+            if nodes is None:
+                nodes = self.client.list("v1", "Node")
+            fleet = FleetState(nodes)
+        for gen, bucket in sorted(fleet.chip_totals().items()):
             for state in ("free", "placed"):
                 OPERATOR_METRICS.fleet_chips.labels(
                     accelerator=gen, state=state).set(bucket[state])

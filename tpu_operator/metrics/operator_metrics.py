@@ -184,6 +184,19 @@ class OperatorMetrics:
             "tpu_operator_fleet_chips",
             "TPU chips by generation and placement state",
             labelnames=("accelerator", "state"))
+        # incremental placement index (topology/index.py): deltas folded
+        # into the long-lived fleet view, by event
+        # (added|modified|deleted|replace|resync), and how many Pending
+        # requests the last batched gang-placement pass drained
+        self.placement_index_updates = c(
+            "tpu_operator_placement_index_updates_total",
+            "Node deltas folded into the incremental placement index, "
+            "by event (added|modified|deleted|replace|resync)",
+            labelnames=("event",))
+        self.placement_batch_size = g(
+            "tpu_operator_placement_batch_size",
+            "Pending SliceRequests drained by the last batched "
+            "gang-placement pass")
         # elastic slices (slice-intent protocol): migration/resize
         # attempt outcomes, intent→rebound handshake latency, how stale
         # each workload's last durable checkpoint is, and the two

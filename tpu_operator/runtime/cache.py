@@ -404,6 +404,7 @@ class CachedClient(Client):
         self._meta = threading.Lock()
         self._cancels: list[Callable[[], None]] = []
         self._extra = dict(extra_indexes or {})
+        self._delta_listeners: dict[tuple, list] = {}
         self._closed = False
         self.relist_chunk = (env_relist_chunk() if relist_chunk is None
                              else max(0, relist_chunk))
@@ -447,11 +448,42 @@ class CachedClient(Client):
             store.started.wait(timeout=30.0)
         return store
 
+    def add_delta_listener(self, api_version: str, kind: str,
+                           listener: Callable[[str, dict], None]):
+        """Register ``listener(event_type, obj)`` for every store change
+        of the given kind: watch ingests (ADDED/MODIFIED/DELETED), write
+        echoes (MODIFIED), and local deletes (DELETED, metadata-only
+        stub). Fired *after* the store reflects the change, so a listener
+        reading the cache never sees a view older than its delta.
+        Listener exceptions are swallowed — the cache must stay healthy
+        regardless of consumer bugs. Returns a zero-arg cancel."""
+        gvk = (api_version, kind)
+        with self._meta:
+            self._delta_listeners.setdefault(gvk, []).append(listener)
+
+        def cancel():
+            with self._meta:
+                try:
+                    self._delta_listeners.get(gvk, []).remove(listener)
+                except ValueError:
+                    pass
+        return cancel
+
+    def _notify_delta(self, gvk: tuple, event_type: str, obj: dict) -> None:
+        for fn in tuple(self._delta_listeners.get(gvk, ())):
+            try:
+                fn(event_type, obj)
+            except Exception:  # pragma: no cover - consumer bug firewall
+                pass
+
     def _ingest_handler(self, store: _Store):
+        gvk = (store.api_version, store.kind)
+
         def handler(event: WatchEvent):
             if event.type == "DELETED":
                 store.remove(event.obj)
                 self._publish_bytes(store)
+                self._notify_delta(gvk, "DELETED", event.obj)
                 return
             # freeze-on-ingest: a fake/cached inner already publishes
             # frozen views (shared zero-copy); a mutable event object is
@@ -467,6 +499,8 @@ class CachedClient(Client):
                 full_b = None
             outcome = store.upsert(obj, full_bytes=full_b)
             self._publish_bytes(store)
+            if outcome in ("new", "replaced"):
+                self._notify_delta(gvk, event.type, obj)
             if event.type == "ADDED" and outcome in ("same", "stale"):
                 key = store.key_of(obj)
                 rv = get_nested(obj, "metadata", "resourceVersion")
@@ -712,11 +746,13 @@ class CachedClient(Client):
             key = store.key_of(frozen)
             rv = get_nested(frozen, "metadata", "resourceVersion")
             with store.lock:
-                if store.upsert(frozen,
-                                full_bytes=full_b) in ("new", "replaced") \
-                        and rv:
+                outcome = store.upsert(frozen, full_bytes=full_b)
+                if outcome in ("new", "replaced") and rv:
                     store.written_rvs[key] = rv
             self._publish_bytes(store)
+            if outcome in ("new", "replaced"):
+                self._notify_delta((store.api_version, store.kind),
+                                   "MODIFIED", frozen)
         return obj
 
     def create(self, obj):
@@ -740,6 +776,11 @@ class CachedClient(Client):
             ns = namespace or "" if is_namespaced(kind) else ""
             store.remove((ns, name))
             self._publish_bytes(store)
+            # no full object at hand here; a metadata stub is enough for
+            # listeners to forget the key
+            self._notify_delta((api_version, kind), "DELETED", {
+                "apiVersion": api_version, "kind": kind,
+                "metadata": {"name": name, "namespace": ns}})
 
     # -- watch / lifecycle ----------------------------------------------------
 

@@ -231,6 +231,7 @@ class FleetState:
     def __init__(self, nodes: List[dict]):
         self.slices: List[SliceGroup] = []
         self.owner_of: Dict[str, str] = {}     # node -> lease key
+        self._owner_nodes: Dict[str, set] = {}  # lease key -> node names
         self._chips: Dict[str, int] = {}       # node -> chips
         self._gen: Dict[str, str] = {}         # node -> generation
         nodes_by_name = {name_of(n): n for n in nodes}
@@ -264,6 +265,7 @@ class FleetState:
                 lease = annotations_of(node).get(L.PLACED_BY)
                 if lease:
                     self.owner_of[node_name] = lease
+                    self._owner_nodes.setdefault(lease, set()).add(node_name)
             expected = _hosts_per_slice(
                 chip_dims, hosts[0].chips if hosts else 0)
             for sub_id, sub_hosts in _partition_slice(
@@ -278,15 +280,46 @@ class FleetState:
 
     def book(self, node_names, owner: str) -> None:
         for n in node_names:
+            prev = self.owner_of.get(n)
+            if prev is not None and prev != owner:
+                self._drop_owned(prev, n)
             self.owner_of[n] = owner
+            self._owner_nodes.setdefault(owner, set()).add(n)
 
     def release(self, node_names=None, owner: Optional[str] = None) -> None:
         if node_names is not None:
             for n in node_names:
-                self.owner_of.pop(n, None)
+                prev = self.owner_of.pop(n, None)
+                if prev is not None:
+                    self._drop_owned(prev, n)
         if owner is not None:
-            for n in [n for n, o in self.owner_of.items() if o == owner]:
+            # reverse index: O(nodes this owner holds), not O(all leases)
+            for n in self._owner_nodes.pop(owner, ()):
                 self.owner_of.pop(n, None)
+
+    def _drop_owned(self, owner: str, node_name: str) -> None:
+        held = self._owner_nodes.get(owner)
+        if held is not None:
+            held.discard(node_name)
+            if not held:
+                self._owner_nodes.pop(owner, None)
+
+    def owned_nodes(self, owner: str) -> Tuple[str, ...]:
+        """Nodes currently leased to ``owner``, name-sorted."""
+        return tuple(sorted(self._owner_nodes.get(owner, ())))
+
+    def clone(self) -> "FleetState":
+        """Cheap trial copy: the immutable slice structure is shared, only
+        the lease ledger is copied — what a preemption feasibility gate
+        needs without re-ingesting the fleet."""
+        twin = FleetState.__new__(FleetState)
+        twin.slices = self.slices
+        twin.owner_of = dict(self.owner_of)
+        twin._owner_nodes = {o: set(ns)
+                             for o, ns in self._owner_nodes.items()}
+        twin._chips = self._chips
+        twin._gen = self._gen
+        return twin
 
     def free_runs(self, group: SliceGroup,
                   reclaim: Optional[str] = None) -> List[List[Host]]:
@@ -412,6 +445,60 @@ def _windows(run_len: int, h: int, row: int) -> List[int]:
     return sorted(s for s in starts if 0 <= s <= run_len - h)
 
 
+def _admitted_hosts(spec: SliceRequestSpec, group: SliceGroup,
+                    chips_needed: int) -> int:
+    """Hosts ``spec`` needs inside ``group``, or 0 when the domain cannot
+    admit the request at all (pin mismatch, grid misfit, capacity). Pure
+    function of spec and group structure — independent of occupancy, so
+    the incremental index caches it per (spec, domain)."""
+    if spec.accelerator and group.accelerator != spec.accelerator:
+        return 0
+    if not _topology_fits(spec, group):
+        return 0
+    if chips_needed > _slice_capacity(group):
+        return 0  # a request never spans ICI domains
+    h = _hosts_needed(chips_needed, group.chips_per_host)
+    if h > len(group.hosts):
+        return 0
+    return h
+
+
+def _group_candidates(spec: SliceRequestSpec, group: SliceGroup,
+                      runs: List[List[Host]], h: int) -> List[Candidate]:
+    """Every scored window for ``spec`` inside one ICI domain, given the
+    domain's free runs and the admitted host count ``h``. The single
+    shared scoring path: rank_candidates and the incremental FleetIndex
+    both call this, so index-served candidates are the rescan candidates
+    by construction."""
+    out: List[Candidate] = []
+    throughput = CHIPS[group.generation].peak_bf16_tflops / _MAX_PEAK
+    pref = _preference(spec, group.generation)
+    row = group.host_grid[-1] if group.host_grid else 1
+    for run in runs:
+        if len(run) < h:
+            continue
+        for s in _windows(len(run), h, row):
+            window = run[s:s + h]
+            adj = _adjacency(window, group)
+            frag = _fragmentation(len(group.hosts), h)
+            score = (W_THROUGHPUT * throughput + W_ADJACENCY * adj
+                     + W_FRAGMENTATION * frag + pref)
+            out.append(Candidate(
+                pool=group.pool, slice_id=group.slice_id,
+                accelerator=group.accelerator,
+                generation=group.generation,
+                nodes=tuple(host.name for host in window),
+                chips=sum(host.chips for host in window),
+                score=round(score, 6),
+                breakdown={
+                    "throughput": round(throughput, 6),
+                    "adjacency": round(adj, 6),
+                    "fragmentation": round(frag, 6),
+                    "preference": round(pref, 6),
+                }))
+    return out
+
+
 def rank_candidates(spec: SliceRequestSpec, fleet: FleetState,
                     reclaim: Optional[str] = None) -> List[Candidate]:
     """All valid placements for ``spec``, best first, with per-term score
@@ -421,43 +508,13 @@ def rank_candidates(spec: SliceRequestSpec, fleet: FleetState,
         return []
     out: List[Candidate] = []
     for group in fleet.slices:
-        if spec.accelerator and group.accelerator != spec.accelerator:
-            continue
-        if not _topology_fits(spec, group):
-            continue
-        if chips_needed > _slice_capacity(group):
-            continue  # a request never spans ICI domains
-        h = _hosts_needed(chips_needed, group.chips_per_host)
-        if h > len(group.hosts):
+        h = _admitted_hosts(spec, group, chips_needed)
+        if not h:
             continue
         runs = fleet.free_runs(group, reclaim=reclaim)
         if not runs:
             continue
-        throughput = CHIPS[group.generation].peak_bf16_tflops / _MAX_PEAK
-        pref = _preference(spec, group.generation)
-        row = group.host_grid[-1] if group.host_grid else 1
-        for run in runs:
-            if len(run) < h:
-                continue
-            for s in _windows(len(run), h, row):
-                window = run[s:s + h]
-                adj = _adjacency(window, group)
-                frag = _fragmentation(len(group.hosts), h)
-                score = (W_THROUGHPUT * throughput + W_ADJACENCY * adj
-                         + W_FRAGMENTATION * frag + pref)
-                out.append(Candidate(
-                    pool=group.pool, slice_id=group.slice_id,
-                    accelerator=group.accelerator,
-                    generation=group.generation,
-                    nodes=tuple(host.name for host in window),
-                    chips=sum(host.chips for host in window),
-                    score=round(score, 6),
-                    breakdown={
-                        "throughput": round(throughput, 6),
-                        "adjacency": round(adj, 6),
-                        "fragmentation": round(frag, 6),
-                        "preference": round(pref, 6),
-                    }))
+        out.extend(_group_candidates(spec, group, runs, h))
     out.sort(key=Candidate.sort_key)
     return out
 
