@@ -632,6 +632,38 @@ def _controlplane_doc() -> dict | None:
                 lb["lineage_overhead_ratio"], 4)
         except Exception as e:
             doc["lineage"] = {"error": f"{type(e).__name__}: {e}"}
+        # crash-safe restart: snapshot-warm vs cold relist, wall time to
+        # the first placement decision (its own try for the same reason
+        # as rollout's). warm_over_cold / restart_to_first_decision_warm_s
+        # at top level are the figures tests/test_bench_guard.py gates
+        # (warm <= 0.25x cold). TPUOP_BENCH_RESTART_NODES scales it down
+        # for smoke runs; TPUOP_BENCH_SKIP_RESTART skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_RESTART"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_restart_bench,
+                )
+
+                rs_n = int(os.environ.get(
+                    "TPUOP_BENCH_RESTART_NODES", "10000"))
+                rs = run_restart_bench(rs_n)
+                doc["restart"] = {
+                    "n_tpu_nodes": rs["n_tpu_nodes"],
+                    "delta_nodes": rs["delta_nodes"],
+                    "snapshot_mb": round(rs["snapshot_bytes"] / 1e6, 1),
+                    "snapshot_write_s": round(rs["snapshot_write_s"], 2),
+                    "restored_objects": rs["restored_objects"],
+                    "restored_kinds": rs["restored_kinds"],
+                    "watch_resumes": rs["watch_resumes"],
+                    "decisions_agree": rs["decisions_agree"],
+                    "cold_s": round(
+                        rs["restart_to_first_decision_cold_s"], 2),
+                }
+                doc["restart_to_first_decision_warm_s"] = round(
+                    rs["restart_to_first_decision_warm_s"], 2)
+                doc["warm_over_cold"] = round(rs["warm_over_cold"], 4)
+            except Exception as e:
+                doc["restart"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

@@ -258,6 +258,51 @@ def test_placement_storm_rps_not_regressed():
         f"{latest:.1f} regressed >25% vs best on record ({best:.1f})")
 
 
+def test_restart_warm_over_cold_bounded():
+    """Absolute acceptance bar, not a relative-regression guard: the
+    latest round carrying ``warm_over_cold`` (benchmarks.controlplane.
+    run_restart_bench — snapshot-warm restart vs cold relist, wall time
+    to the first placement decision at 10k nodes) must show warm <=
+    0.25x cold. A snapshot restore that quietly decays toward relist
+    cost fails here, not at the next incident. Skips until a round
+    carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "warm_over_cold")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records warm_over_cold yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    assert latest <= 0.25, (
+        f"BENCH_LOCAL_r{latest_round:02d} warm_over_cold={latest:.3f} "
+        f"breaks the warm <= 0.25x cold restart acceptance bar")
+
+
+def test_restart_warm_not_regressed():
+    """And the relative guard on the same figure's absolute wall time:
+    the latest round's restart_to_first_decision_warm_s may be at most
+    25% above the best on record. Skips until a round carrying the key
+    is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "restart_to_first_decision_warm_s")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip(
+            "no committed round records restart_to_first_decision_warm_s yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} restart_to_first_decision_warm_s="
+        f"{latest:.2f}s regressed >25% vs best on record ({best:.2f}s)")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

@@ -33,12 +33,17 @@ class TestDeterminism:
         b = FaultPlan.build("node-churn", 2, nodes, 12)
         assert a.schedule_json() != b.schedule_json()
 
-    @pytest.mark.parametrize("scenario", ["conflict-storm", "operand-drift"])
+    @pytest.mark.parametrize("scenario", ["conflict-storm", "operand-drift",
+                                          "operator-crash",
+                                          "apiserver-brownout"])
     def test_same_seed_byte_identical_verdict(self, scenario):
         """The acceptance bar: two full runs emit byte-identical JSON —
         a red verdict is its own reproducer. operand-drift rides along
         because its repair path (spec-hash mismatch -> rewrite) must be
-        as deterministic as the fault schedule itself."""
+        as deterministic as the fault schedule itself; operator-crash
+        and apiserver-brownout because the restart plane (snapshot
+        capture/restore, watch resume, degraded-mode breaker) must not
+        introduce a single nondeterministic byte into the verdict."""
         runs = [run_scenario(scenario, nodes=32, seed=7)
                 for _ in range(2)]
         payloads = [json.dumps(v, indent=2, sort_keys=True) for v in runs]

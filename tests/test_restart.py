@@ -1,0 +1,556 @@
+"""Crash-safe instant restart (runtime/snapshot.py + the warm-restore
+paths in runtime/cache.py and runtime/manager.py).
+
+Five layers:
+
+1. Durable snapshots: atomic write-tmp-then-rename, retention, and the
+   discard-never-trust loader (corrupt / wrong-schema / stale / torn
+   files cost a cold start, never a wrong cache).
+2. O(delta) warm restore: a snapshot-seeded store resumes the watch
+   from the snapshot RV (no relist of the world, downtime deletions
+   arrive as tombstones) and falls back to the classic full replay +
+   prune when the resume point has left the server's watch window.
+3. Degraded mode: the relist breaker — failures below the threshold
+   propagate, past it the cache serves stale reads with a staleness
+   gauge and capped-backoff reconnect, and heals cleanly.
+4. Manager lifecycle: restore outcomes (missing/discarded/restored),
+   the clean-shutdown snapshot, and requeue-state re-derivation from
+   ``status.requeueAttempts``.
+5. Leader-election handoff: two managers over one FakeClient — a
+   mid-pass leadership loss (lease reassigned) must not let both
+   drive the same migration attempt; the annotation-deadline attempt
+   identity keeps a single driver.
+"""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.slicerequest import (
+    INTENT_MIGRATE,
+    KIND_SLICE_REQUEST,
+    MIG_MIGRATING,
+    MIG_REBOUND,
+    PHASE_PLACED,
+    V1ALPHA1,
+    SliceRequestSpec,
+    new_slice_request,
+)
+from tpu_operator.controllers.placement_controller import PlacementReconciler
+from tpu_operator.controllers.slices import SliceMigrator
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.runtime import snapshot as snapshot_mod
+from tpu_operator.runtime.cache import (
+    DEGRADED_THRESHOLD,
+    LISTENER_DETACH_AFTER,
+    CachedClient,
+)
+from tpu_operator.runtime.client import ApiError, ServerUnavailableError
+from tpu_operator.runtime.leaderelection import LeaderElector, _now
+from tpu_operator.runtime.manager import Manager
+from tpu_operator.runtime.objects import annotations_of, get_nested, thaw_obj
+from tpu_operator.workloads.elastic import ElasticWorkload
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def small_fleet(n=5):
+    c = FakeClient()
+    for i in range(n):
+        c.add_node(f"n{i}", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+            L.GKE_TPU_TOPOLOGY: "2x4"},
+            allocatable={"google.com/tpu": "4"})
+    return c
+
+
+def node_names(cached):
+    return {get_nested(o, "metadata", "name")
+            for o in cached.list("v1", "Node")}
+
+
+# --- 1. durable snapshots -------------------------------------------------
+
+
+class TestSnapshotDurability:
+    def _snap(self, wall):
+        c = small_fleet(2)
+        cc = CachedClient(c)
+        cc.list("v1", "Node")
+        snap = snapshot_mod.capture(cc, wall=wall)
+        cc.close()
+        return snap
+
+    def test_atomic_write_and_retention(self, tmp_path):
+        d = str(tmp_path)
+        paths = [snapshot_mod.write_snapshot(d, self._snap(1000.0 + i))
+                 for i in range(5)]
+        assert all(os.path.basename(p).startswith("snapshot-")
+                   for p in paths)
+        # retention keeps the newest 3; the commit is the rename, so no
+        # torn .tmp files survive a full write either
+        files = snapshot_mod.snapshot_files(d)
+        assert len(files) == 3
+        assert files[0] == paths[-1]  # newest first
+        assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+        loaded = snapshot_mod.load_latest(d, now_wall=1010.0)
+        assert loaded["written_at"] == 1004.0
+
+    def test_corrupt_newest_is_discarded_for_older_valid(self, tmp_path):
+        d = str(tmp_path)
+        good = snapshot_mod.write_snapshot(d, self._snap(1000.0))
+        # a torn/corrupt file sorting newest must be skipped, not trusted
+        (tmp_path / "snapshot-9999999999999999.json").write_text("{not json")
+        loaded = snapshot_mod.load_latest(d, now_wall=1001.0)
+        assert loaded is not None
+        assert loaded["_path"] == good
+
+    def test_wrong_schema_is_discarded(self, tmp_path):
+        d = str(tmp_path)
+        good = snapshot_mod.write_snapshot(d, self._snap(1000.0))
+        bad = self._snap(2000.0)
+        bad["schema"] = 99
+        snapshot_mod.write_snapshot(d, bad)
+        loaded = snapshot_mod.load_latest(d, now_wall=2001.0)
+        assert loaded["_path"] == good
+
+    def test_missing_section_is_discarded(self, tmp_path):
+        d = str(tmp_path)
+        bad = self._snap(1000.0)
+        del bad["max_rvs"]
+        snapshot_mod.write_snapshot(d, bad)
+        assert snapshot_mod.load_latest(d, now_wall=1001.0) is None
+
+    def test_stale_snapshot_is_discarded(self, tmp_path):
+        d = str(tmp_path)
+        snapshot_mod.write_snapshot(d, self._snap(1000.0))
+        assert snapshot_mod.load_latest(
+            d, now_wall=1000.0 + 5, max_age_s=10) is not None
+        assert snapshot_mod.load_latest(
+            d, now_wall=1000.0 + 11, max_age_s=10) is None
+        # 0 disables the age check entirely
+        assert snapshot_mod.load_latest(
+            d, now_wall=1000.0 + 1e9, max_age_s=0) is not None
+
+
+# --- 2. O(delta) warm restore ---------------------------------------------
+
+
+class TestWarmRestoreResume:
+    def _snapshot_then_downtime(self, tmp_path=None):
+        """Subscribe, snapshot, close; then mutate the fleet while the
+        'operator' is down: touch n1, delete n2, add n5."""
+        fake = small_fleet(5)
+        cc1 = CachedClient(fake)
+        cc1.list("v1", "Node")
+        snap = snapshot_mod.capture(cc1)
+        if tmp_path is not None:
+            d = str(tmp_path)
+            snapshot_mod.write_snapshot(d, snap)
+            snap = snapshot_mod.load_latest(
+                d, now_wall=snap["written_at"] + 1)
+        cc1.close()
+        fake.patch("v1", "Node", "n1",
+                   {"metadata": {"labels": {"touched": "yes"}}})
+        fake.delete("v1", "Node", "n2")
+        fake.add_node("n5", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+            L.GKE_TPU_TOPOLOGY: "2x4"},
+            allocatable={"google.com/tpu": "4"})
+        return fake, snap
+
+    def test_resume_folds_delta_without_relist(self):
+        fake, snap = self._snapshot_then_downtime()
+        cc2 = CachedClient(fake)
+        snapshot_mod.restore(cc2, snap)
+        before = dict(fake.verb_counts)
+        assert node_names(cc2) == {"n0", "n1", "n3", "n4", "n5"}
+        # the heal was a resumed watch, not a relist of the world: no
+        # LIST verb hit the apiserver, one resumed WATCH did
+        assert fake.verb_counts.get("list", 0) == before.get("list", 0)
+        assert (fake.verb_counts.get("watch", 0)
+                == before.get("watch", 0) + 1)
+        assert cc2.watch_resumes == 1
+        assert cc2.watch_resume_fallbacks == 0
+        # the downtime delta is all there: the touch is visible, the
+        # delete arrived as a tombstone
+        touched = cc2.get("v1", "Node", "n1")
+        assert get_nested(touched, "metadata", "labels",
+                          "touched") == "yes"
+        stats = cc2.cache_stats()
+        assert stats["kinds"]["v1/Node"]["resumed"] is True
+        cc2.close()
+
+    def test_resume_survives_the_disk_round_trip(self, tmp_path):
+        # same heal, but through write_snapshot/load_latest (the v2
+        # wrapped-array format and the frozen parse hook)
+        fake, snap = self._snapshot_then_downtime(tmp_path)
+        cc2 = CachedClient(fake)
+        out = snapshot_mod.restore(cc2, snap)
+        assert out == {"kinds": 1, "objects": 5}
+        assert node_names(cc2) == {"n0", "n1", "n3", "n4", "n5"}
+        assert cc2.watch_resumes == 1
+        cc2.close()
+
+    def test_window_expiry_falls_back_to_full_replay(self):
+        fake, snap = self._snapshot_then_downtime()
+        fake.watch_window = 1  # resume point is long out of the window
+        cc2 = CachedClient(fake)
+        snapshot_mod.restore(cc2, snap)
+        assert node_names(cc2) == {"n0", "n1", "n3", "n4", "n5"}
+        # 410 Gone: the classic full replay ran instead, and the prune
+        # pass still removed the key deleted during the downtime
+        assert cc2.watch_resumes == 0
+        assert cc2.watch_resume_fallbacks == 1
+        assert cc2.cache_stats()["kinds"]["v1/Node"]["resumed"] is False
+        cc2.close()
+
+
+# --- 3. degraded mode under apiserver brownout ----------------------------
+
+
+class _FlakyInner:
+    """Wraps FakeClient; LIST fails while ``fail`` is set (the relist
+    path), watches stay untouched."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.fail = False
+        self.list_calls = 0
+
+    def list(self, *args, **kwargs):
+        self.list_calls += 1
+        if self.fail:
+            raise ServerUnavailableError("apiserver browned out")
+        return self.inner.list(*args, **kwargs)
+
+    def watch(self, *args, **kwargs):
+        return self.inner.watch(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TestDegradedMode:
+    def test_breaker_enters_serves_stale_and_heals(self):
+        clock = Clock(100.0)
+        fake = small_fleet(1)
+        shim = _FlakyInner(fake)
+        cc = CachedClient(shim, now=clock, relist_chunk=0)
+        assert len(cc.list("v1", "Node")) == 1
+
+        shim.fail = True
+        cc.mark_stale()
+        clock.t = 105.0
+        # below the threshold the failure propagates to the reader
+        for _ in range(DEGRADED_THRESHOLD - 1):
+            with pytest.raises(ApiError):
+                cc.list("v1", "Node")
+        assert not cc.degraded
+        # at the threshold: absorbed, the stale view is served
+        assert len(cc.list("v1", "Node")) == 1
+        assert cc.degraded
+        assert cc.staleness_s() == pytest.approx(5.0)
+
+        # within the reconnect backoff, reads never touch the apiserver
+        calls = shim.list_calls
+        clock.t = 105.5
+        assert len(cc.list("v1", "Node")) == 1
+        assert shim.list_calls == calls
+        # past it, one retry fires (and fails, doubling the backoff)
+        clock.t = 107.0
+        assert len(cc.list("v1", "Node")) == 1
+        assert shim.list_calls == calls + 1
+        assert cc.degraded
+
+        # the apiserver heals: next retry relists, breaker resets
+        shim.fail = False
+        clock.t = 120.0
+        assert len(cc.list("v1", "Node")) == 1
+        assert not cc.degraded
+        assert cc.sync_failures == 0
+        assert cc.staleness_s() == 0.0
+        stats = cc.cache_stats()
+        assert stats["degraded"] is False
+        assert stats["sync_failures_total"] == DEGRADED_THRESHOLD + 1
+        cc.close()
+
+    def test_listener_detached_after_consecutive_failures(self):
+        fake = FakeClient()
+        cc = CachedClient(fake)
+        cc.list("v1", "Node")  # subscribe the informer
+        calls = []
+
+        def bad_listener(event_type, obj):
+            calls.append(event_type)
+            raise RuntimeError("consumer bug")
+
+        cc.add_delta_listener("v1", "Node", bad_listener)
+        for i in range(LISTENER_DETACH_AFTER + 3):
+            fake.add_node(f"d{i}", labels={"k": "v"},
+                          allocatable={"google.com/tpu": "4"})
+        # fired exactly N times, then detached — the cache stayed healthy
+        assert len(calls) == LISTENER_DETACH_AFTER
+        assert cc.listener_errors == LISTENER_DETACH_AFTER
+        assert len(cc.list("v1", "Node")) == LISTENER_DETACH_AFTER + 3
+        cc.close()
+
+
+# --- 4. Manager lifecycle -------------------------------------------------
+
+
+class TestManagerSnapshotLifecycle:
+    def test_restore_outcomes(self, tmp_path):
+        d = str(tmp_path)
+        fake = small_fleet(3)
+        cc = CachedClient(fake)
+        m = Manager(cc, snapshot_dir=d, snapshot_interval=0)
+        assert m.restore_from_snapshot()["outcome"] == "missing"
+
+        # only a corrupt file on disk: discarded, cold start
+        (tmp_path / "snapshot-0000000000000001.json").write_text("{")
+        m2 = Manager(CachedClient(fake), snapshot_dir=d,
+                     snapshot_interval=0)
+        assert m2.restore_from_snapshot()["outcome"] == "discarded"
+
+        cc.list("v1", "Node")
+        path = m.write_snapshot_now()
+        assert path is not None and os.path.exists(path)
+        cc.close()
+
+        cc2 = CachedClient(fake)
+        m3 = Manager(cc2, snapshot_dir=d, snapshot_interval=0)
+        out = m3.restore_from_snapshot()
+        assert out["outcome"] == "restored"
+        assert out["objects"] == 3
+        assert m3.last_restore is out
+        # the outcome is durable next to the snapshots
+        marker = json.loads((tmp_path / "last_restore.json").read_text())
+        assert marker["outcome"] == "restored"
+        # and the seeded store heals via watch resume on first read
+        assert len(cc2.list("v1", "Node")) == 3
+        assert cc2.watch_resumes == 1
+        cc2.close()
+
+    def test_snapshot_plane_off_without_dir(self, tmp_path):
+        cc = CachedClient(small_fleet(1))
+        m = Manager(cc, snapshot_dir="", snapshot_interval=0)
+        assert m.snapshot_dir is None
+        assert m.restore_from_snapshot() is None
+        assert m.write_snapshot_now() is None
+        cc.close()
+
+    def test_stop_writes_clean_shutdown_snapshot(self, tmp_path):
+        d = str(tmp_path)
+        cc = CachedClient(small_fleet(2))
+        cc.list("v1", "Node")
+        m = Manager(cc, snapshot_dir=d, snapshot_interval=0)
+        m.start()
+        assert not snapshot_mod.snapshot_files(d)
+        m.stop()
+        files = snapshot_mod.snapshot_files(d)
+        assert len(files) == 1
+        snap = snapshot_mod.load_latest(d, now_wall=time.time())
+        assert len(snap["stores"]["v1/Node"]["objects"]) == 2
+
+    def test_requeue_state_rederived_through_manager(self, tmp_path):
+        d = str(tmp_path)
+        fake = FakeClient()
+        fake.create(new_slice_request(
+            "job", spec=SliceRequestSpec(chips=4).to_obj(),
+            namespace="default"))
+        fake.patch(V1ALPHA1, KIND_SLICE_REQUEST, "job",
+                   {"status": {"requeueAttempts": 4}}, namespace="default")
+        cc1 = CachedClient(fake)
+        cc1.list(V1ALPHA1, KIND_SLICE_REQUEST)
+        Manager(cc1, snapshot_dir=d,
+                snapshot_interval=0).write_snapshot_now()
+        cc1.close()
+
+        cc2 = CachedClient(fake)
+        m = Manager(cc2, snapshot_dir=d, snapshot_interval=0)
+        rec = PlacementReconciler(client=cc2, namespace="default")
+        m.controllers.append(SimpleNamespace(reconciler=rec))
+        out = m.restore_from_snapshot()
+        assert out["outcome"] == "restored"
+        assert out["requeue_state_seeded"] == 1
+        # the 5s->240s backoff schedule resumes mid-ladder, no retry storm
+        assert rec._unsched_attempts == {"default/job": 4}
+        cc2.close()
+
+    def test_derive_requeue_state_ignores_unset_and_garbage(self):
+        crs = [
+            {"metadata": {"name": "a", "namespace": "default"},
+             "status": {"requeueAttempts": 4}},
+            {"metadata": {"name": "b", "namespace": "default"},
+             "status": {"requeueAttempts": 0}},
+            {"metadata": {"name": "c", "namespace": "default"},
+             "status": {"requeueAttempts": "soon"}},
+            {"metadata": {"name": "d", "namespace": "default"}},
+        ]
+        assert snapshot_mod.derive_requeue_state(crs) == {
+            ("default", "a"): 4}
+        rec = PlacementReconciler(client=FakeClient(), namespace="default")
+        # in-memory counters (fresher than the snapshot) are never
+        # overwritten by the seed
+        rec._unsched_attempts["default/a"] = 2
+        assert rec.seed_requeue_state(crs) == 0
+        assert rec._unsched_attempts == {"default/a": 2}
+
+
+# --- 5. leader-election handoff (single migration driver) -----------------
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestLeaderHandoffSingleDriver:
+    def _two_pool_fleet(self):
+        c = FakeClient()
+        for pool, names in (("pool-a", ("a0", "a1")),
+                            ("pool-b", ("b0", "b1"))):
+            for i, name in enumerate(names):
+                c.add_node(name, labels={
+                    L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+                    L.GKE_TPU_TOPOLOGY: "2x4",
+                    L.GKE_NODEPOOL: pool,
+                    L.GKE_TPU_WORKER_ID: str(i),
+                    L.GKE_ACCELERATOR_COUNT: "4"},
+                    allocatable={"google.com/tpu": "4"})
+        return c
+
+    def _steal_lease(self, c, new_holder):
+        """The apiserver reassigns the lease out from under the current
+        leader (the mid-pass leadership-loss injection): CAS-retry until
+        the write lands against the old holder's concurrent renews."""
+        from tpu_operator.runtime.client import ConflictError
+
+        for _ in range(100):
+            lease = thaw_obj(c.get("coordination.k8s.io/v1", "Lease",
+                                   "tpu-operator", "default"))
+            lease["spec"]["holderIdentity"] = new_holder
+            lease["spec"]["renewTime"] = _now()
+            try:
+                c.update(lease)
+                return
+            except ConflictError:
+                continue
+        raise AssertionError("could not steal the lease")
+
+    def test_mid_pass_handoff_does_not_double_drive_migration(self):
+        c = self._two_pool_fleet()
+        clock = Clock()
+
+        # a placed request on pool-a with an elastic workload attached
+        rec = PlacementReconciler(client=c, namespace="default", now=clock)
+        c.create(new_slice_request(
+            "job", spec=SliceRequestSpec(chips=8).to_obj(),
+            namespace="default"))
+        rec.reconcile(Request(name="job", namespace="default"))
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        assert get_nested(cr, "status", "phase") == PHASE_PLACED
+        unit = list(get_nested(cr, "status", "nodes"))
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        wl.tick()
+        deadline = clock.t + 60
+
+        # two managers, one lease: each drives migration passes only
+        # while its elector holds leadership (the Manager.start wiring,
+        # with test-speed lease timings and a recording stand-down
+        # instead of the production process exit)
+        stood_down = []
+        mgr_a = Manager(c, namespace="default", leader_elect=True,
+                        on_lost_leadership=lambda: stood_down.append("a"),
+                        snapshot_dir="", snapshot_interval=0)
+        mgr_b = Manager(c, namespace="default", leader_elect=True,
+                        on_lost_leadership=lambda: stood_down.append("b"),
+                        snapshot_dir="", snapshot_interval=0)
+        el_a = LeaderElector(
+            c, namespace="default", identity="op-a",
+            lease_duration_s=0.5, renew_interval_s=0.05,
+            on_started_leading=mgr_a._start_controllers,
+            on_stopped_leading=mgr_a._on_lost)
+        el_b = LeaderElector(
+            c, namespace="default", identity="op-b",
+            lease_duration_s=0.5, renew_interval_s=0.05,
+            on_started_leading=mgr_b._start_controllers,
+            on_stopped_leading=mgr_b._on_lost)
+        mgr_a.elector, mgr_b.elector = el_a, el_b
+
+        def drive(elector):
+            if not elector.is_leader:
+                return None
+            return SliceMigrator(c, now=clock).ready_to_drain(
+                unit, deadline)
+
+        try:
+            el_a.start()
+            assert _wait_for(lambda: el_a.is_leader)
+            el_b.start()
+            time.sleep(0.2)
+            assert not el_b.is_leader  # lease held: exactly one driver
+
+            # leader A opens the migration attempt
+            assert drive(el_a) is False
+            cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+            anns = annotations_of(cr)
+            assert anns.get(L.SLICE_INTENT) == INTENT_MIGRATE
+            attempt_deadline = anns.get(L.SLICE_INTENT_DEADLINE)
+            assert get_nested(cr, "status", "migration",
+                              "phase") == MIG_MIGRATING
+
+            # mid-pass leadership loss: the lease lands on B while A
+            # hasn't noticed yet — for up to a renewDeadline BOTH
+            # believe they lead. Both drive a pass in that window.
+            self._steal_lease(c, "op-b")
+            assert _wait_for(lambda: el_b.is_leader)
+            drive(el_a)
+            drive(el_b)
+            cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+            # the annotation-deadline attempt identity held: neither
+            # manager re-posted a fresh attempt or moved the binding
+            assert (annotations_of(cr).get(L.SLICE_INTENT_DEADLINE)
+                    == attempt_deadline)
+            assert get_nested(cr, "status", "migration",
+                              "phase") == MIG_MIGRATING
+            assert not get_nested(cr, "status", "migrations", default=0)
+
+            # A notices within the renew deadline and stands down
+            assert _wait_for(lambda: not el_a.is_leader)
+            assert stood_down == ["a"]
+
+            # the workload acks its checkpoint; only B drives now, and
+            # the rebind happens exactly once
+            wl.tick()
+            assert drive(el_a) is None
+            assert drive(el_b) is True
+            cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+            assert get_nested(cr, "status", "migration",
+                              "phase") == MIG_REBOUND
+            assert get_nested(cr, "status", "migrations") == 1
+            new_nodes = list(get_nested(cr, "status", "nodes"))
+            assert not set(new_nodes) & set(unit)
+            assert L.SLICE_INTENT not in annotations_of(cr)
+            # idempotent: a repeated pass changes nothing
+            assert drive(el_b) is True
+            cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+            assert get_nested(cr, "status", "migrations") == 1
+        finally:
+            el_a.stop(release=False)
+            el_b.stop(release=False)

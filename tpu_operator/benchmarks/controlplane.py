@@ -981,6 +981,26 @@ def _lane_churn(churn_items: int) -> Dict:
     }
 
 
+def fatten_nodes(c) -> None:
+    """Give every node the kubelet-reported status payload a real
+    fleet carries — image records and attached-volume lists are the
+    bulk of a production Node object, and exactly what the index-only
+    projection drops. Without them the synthetic fleet would make the
+    projection look free AND worthless at once."""
+    from ..runtime.objects import name_of, thaw_obj
+
+    for n in c.list("v1", "Node"):
+        node = thaw_obj(n)
+        status = node.setdefault("status", {})
+        status["images"] = [
+            {"names": [f"registry.example/layer-{i}@sha256:{i:064x}"],
+             "sizeBytes": 10_000_000 + i} for i in range(40)]
+        status["volumesInUse"] = [
+            f"kubernetes.io/csi/pd-{name_of(node)}-{i}"
+            for i in range(8)]
+        c.update_status(node)
+
+
 def run_fleet_bench(n_tpu: int = 10000, baseline_tpu: int = 500,
                     churn_items: int = 20000) -> Dict:
     """The 10k-node survivability datapoint: cache bytes per node must be
@@ -999,24 +1019,6 @@ def run_fleet_bench(n_tpu: int = 10000, baseline_tpu: int = 500,
     operator never holds)."""
     from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
     from ..runtime import CachedClient
-    from ..runtime.objects import name_of, thaw_obj
-
-    def fatten_nodes(c) -> None:
-        """Give every node the kubelet-reported status payload a real
-        fleet carries — image records and attached-volume lists are the
-        bulk of a production Node object, and exactly what the index-only
-        projection drops. Without them the synthetic fleet would make the
-        projection look free AND worthless at once."""
-        for n in c.list("v1", "Node"):
-            node = thaw_obj(n)
-            status = node.setdefault("status", {})
-            status["images"] = [
-                {"names": [f"registry.example/layer-{i}@sha256:{i:064x}"],
-                 "sizeBytes": 10_000_000 + i} for i in range(40)]
-            status["volumesInUse"] = [
-                f"kubernetes.io/csi/pd-{name_of(node)}-{i}"
-                for i in range(8)]
-            c.update_status(node)
 
     def converged_stats(n: int):
         """Converge an n-node cluster, warm a CachedClient over it, and
@@ -1175,4 +1177,185 @@ def run_lineage_bench(items: int = 20000, rounds: int = 5) -> Dict:
         "bare_ns_per_op": off_best / items * 1e9,
         # the bench-guard figure: median paired causes-on/causes-off
         "lineage_overhead_ratio": statistics.median(ratios),
+    }
+
+
+class _WireClient:
+    """Bench-only wire-fidelity shim over the in-memory fake: every
+    object crossing ``list()`` or ``watch()`` is JSON round-tripped,
+    charging the serialize+parse cost a real apiserver connection
+    charges per object read. The fake's zero-copy reads otherwise make
+    a cold relist unrealistically free — while the warm path's whole
+    point is that it parses one snapshot file instead of re-reading the
+    fleet per kind, and its ``since_rv`` resume pays the round-trip
+    only on the downtime delta. Writes pass through unwired (both
+    restart paths issue the same writes)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    @staticmethod
+    def _wire(obj):
+        import json
+
+        return json.loads(json.dumps(obj, separators=(",", ":")))
+
+    def list(self, api_version, kind, opts=None):
+        from ..runtime.client import PagedList
+
+        out = self.inner.list(api_version, kind, opts)
+        wired = [self._wire(o) for o in out]
+        cont = getattr(out, "continue_", None)
+        if cont is not None:
+            paged = PagedList(wired)
+            paged.continue_ = cont
+            return paged
+        return wired
+
+    def watch(self, api_version, kind, handler, since_rv=None):
+        from ..runtime.client import WatchEvent
+
+        def wire_handler(event):
+            handler(WatchEvent(event.type, self._wire(event.obj)))
+
+        if since_rv is None:
+            return self.inner.watch(api_version, kind, wire_handler)
+        return self.inner.watch(api_version, kind, wire_handler,
+                                since_rv=since_rv)
+
+    def __getattr__(self, attr):
+        return getattr(self.inner, attr)
+
+
+def run_restart_bench(n_tpu: int = 10000, delta_nodes: int = 100,
+                      seed: int = 0,
+                      snapshot_dir: Optional[str] = None) -> Dict:
+    """Restart-to-first-placement-decision at fleet scale: cold (full
+    paged LIST of a fattened fleet, projection + freeze + byte-measure
+    per object, from-scratch ``FleetIndex``) vs warm (load the newest
+    durable snapshot from disk, seed the cache stores pre-watch, let the
+    subscribe-time replay short-circuit on resourceVersion for every
+    unchanged object, rebuild the index from the snapshot's already
+    projected node set, and ``resync()`` only the downtime delta).
+
+    The downtime delta is ``delta_nodes`` label-touched Nodes (new RVs
+    the replay cannot skip) applied after the snapshot is written and
+    the old cache is closed — the O(delta) the warm path actually pays.
+
+    Guard keys: ``restart_to_first_decision_cold_s`` and
+    ``restart_to_first_decision_warm_s``; tests/test_bench_guard.py
+    pins warm <= 0.25x cold."""
+    import os
+    import random
+    import shutil
+    import tempfile
+
+    from ..api.slicerequest import SliceRequestSpec
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..runtime import CachedClient
+    from ..runtime.objects import name_of, thaw_obj
+    from ..runtime.snapshot import (capture, load_latest, restore,
+                                    restore_index, write_snapshot)
+    from ..topology.index import FleetIndex
+
+    rng = random.Random(seed)
+    c = build_cluster(n_tpu)
+    fatten_nodes(c)
+    c.create(new_cluster_policy())
+    rec = ClusterPolicyReconciler(client=c, namespace="tpu-operator")
+    req = Request(name="tpu-cluster-policy")
+    rec.reconcile(req)
+    c.simulate_kubelet(ready=True)
+    rec.reconcile(req)
+
+    # the running operator whose crash we simulate: warm cache over every
+    # operand kind, a live index that has paid its fragment builds
+    cached = CachedClient(c)
+    crec = ClusterPolicyReconciler(client=cached, namespace="tpu-operator")
+    crec.reconcile(req)
+    index = FleetIndex(cached.list("v1", "Node"))
+    spec = SliceRequestSpec(chips=8)
+    index.best(spec)
+
+    owns_dir = snapshot_dir is None
+    directory = snapshot_dir or tempfile.mkdtemp(prefix="tpuop-bench-snap-")
+    try:
+        t0 = time.perf_counter()
+        path = write_snapshot(directory, capture(cached, index=index))
+        snapshot_write_s = time.perf_counter() - t0
+        snapshot_bytes = os.path.getsize(path)
+        cached.close()  # the operator goes down
+
+        # downtime churn: label touches bump RVs without moving topology,
+        # so the index folds them as cheap fingerprint-equal MODIFIEDs —
+        # but the cache replay must still re-ingest every one
+        names = [name_of(n) for n in c.list("v1", "Node")]
+        for i, name in enumerate(rng.sample(names,
+                                            min(delta_nodes, len(names)))):
+            node = thaw_obj(c.get("v1", "Node", name))
+            labels = node.setdefault("metadata", {}).setdefault("labels", {})
+            labels["bench.tpu-operator/restart-touch"] = str(i)
+            c.update(node)
+
+        # both restarts warm the full cache (every kind the controllers
+        # read — a restarting operator's first pass) before the first
+        # placement decision; only the route to "warm stores" differs.
+        # Both run over the wire shim: cold re-reads the fleet per kind,
+        # warm parses the snapshot once and resumes each watch from the
+        # snapshot RV, paying the wire only for the downtime delta.
+        # A gc fence before each timed block keeps one path's garbage
+        # out of the other path's wall clock.
+        import gc
+
+        wire = _WireClient(c)
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            cold = CachedClient(wire)
+            ClusterPolicyReconciler(
+                client=cold, namespace="tpu-operator").reconcile(req)
+            cold_index = FleetIndex(cold.list("v1", "Node"))
+            cold_best = cold_index.best(spec)
+            cold_s = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        cold.close()
+        del cold, cold_index
+        gc.collect()
+
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            snap = load_latest(directory)
+            warm = CachedClient(wire)
+            restored = restore(warm, snap)
+            ClusterPolicyReconciler(
+                client=warm, namespace="tpu-operator").reconcile(req)
+            warm_index = restore_index(snap)
+            warm_index.resync(warm.list("v1", "Node"))
+            warm_best = warm_index.best(spec)
+            warm_s = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        warm_resumes = warm.watch_resumes
+        warm.close()
+    finally:
+        if owns_dir:
+            shutil.rmtree(directory, ignore_errors=True)
+
+    return {
+        "n_tpu_nodes": n_tpu,
+        "delta_nodes": min(delta_nodes, len(names)),
+        "snapshot_bytes": snapshot_bytes,
+        "snapshot_write_s": snapshot_write_s,
+        "restored_objects": restored["objects"],
+        "restored_kinds": restored["kinds"],
+        "watch_resumes": warm_resumes,
+        "decisions_agree": (cold_best is None) == (warm_best is None),
+        # guard figures: wall time from process start to the first
+        # index.best() answer, cold vs snapshot-warm
+        "restart_to_first_decision_cold_s": cold_s,
+        "restart_to_first_decision_warm_s": warm_s,
+        "warm_over_cold": (warm_s / cold_s) if cold_s > 0 else None,
     }

@@ -63,6 +63,11 @@ WORKLOAD_CRASH = "workload-crash"  # elastic shim dies mid-save (torn ckpt)
 SHARD_KILL = "shard-kill"          # a reconcile shard's workers die;
 #                                    queued keys must rehash losslessly
 #                                    onto the survivors (count = shard id)
+OPERATOR_CRASH = "operator-crash"  # the process dies mid-pass; the runner
+#                                    rebuilds it from the latest snapshot
+BROWNOUT_START = "brownout-start"  # apiserver brownout: lists fail and
+#                                    watch streams die until the matching
+BROWNOUT_END = "brownout-end"      # heal — controllers must serve stale
 
 
 @dataclass(frozen=True)
@@ -134,6 +139,8 @@ class FaultPlan:
             "placement-storm": cls._placement_storm,
             "slice-migrate": cls._slice_migrate,
             "shard-failover": cls._shard_failover,
+            "operator-crash": cls._operator_crash,
+            "apiserver-brownout": cls._apiserver_brownout,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -418,6 +425,98 @@ class FaultPlan:
         return out
 
     @classmethod
+    def _operator_crash(cls, rng, nodes, steps) -> List[Fault]:
+        """Crash-safe instant restart: the slice-migrate opening (elastic
+        and rigid requests, then a fleet rollout forcing every placed
+        slice through the migrate stage), with the operator process
+        killed at seeded points — once right after the rollout posts
+        migrate intents (mid-migration) and once with a same-step gang
+        wave half-drained (mid-gang-batch). Each crash discards every
+        queue, in-memory index and backoff counter; the successor warms
+        from the last snapshot and must converge to the same settled
+        state as a run that never crashed (restart-coherent), with no
+        acked work lost."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8, 8, 16)
+        n_elastic = n_rigid = 0
+        for step in range(min(3, steps)):
+            for _ in range(rng.randrange(2, 4)):
+                if rng.random() < 0.7:
+                    n_elastic += 1
+                    name = f"ereq-{n_elastic:03d}"
+                else:
+                    n_rigid += 1
+                    name = f"rreq-{n_rigid:03d}"
+                out.append(Fault(step, SLICE_REQUEST, arg=name,
+                                 count=rng.choice(sizes),
+                                 seconds=float(rng.randrange(0, 3))))
+        if n_elastic == 0:
+            # a crash mid-migration of an *elastic* slice is the
+            # hardest path (checkpoint handshake in flight); pin one
+            n_elastic = 1
+            out.append(Fault(0, SLICE_REQUEST, arg="ereq-001",
+                             count=rng.choice(sizes)))
+        rollout_step = min(3, steps - 1)
+        out.append(Fault(rollout_step, TRIGGER_ROLLOUT,
+                         arg=cls._marker(rng, "/opt/crash-libtpu")))
+        # crash #1: right after the rollout posts migrate intents
+        crash1 = min(rollout_step + 1, steps - 1)
+        out.append(Fault(crash1, OPERATOR_CRASH))
+        # crash #2: a seeded later step, with a same-step request wave
+        # so the gang batch is half-drained when the process dies
+        if steps > crash1 + 2:
+            crash2 = rng.randrange(crash1 + 2, steps - 1)
+            for _ in range(3):
+                n_elastic += 1
+                out.append(Fault(crash2, SLICE_REQUEST,
+                                 arg=f"ereq-{n_elastic:03d}",
+                                 count=rng.choice(sizes)))
+            out.append(Fault(crash2, OPERATOR_CRASH))
+        for step in range(rollout_step + 1, steps):
+            if step % 3 == 2:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 5 == 4:
+                out.append(Fault(step, WATCH_DROP))
+        return out
+
+    @classmethod
+    def _apiserver_brownout(cls, rng, nodes, steps) -> List[Fault]:
+        """The apiserver browns out for a seeded window: every list
+        fails and every watch stream dies, while the world keeps moving
+        (spec mutations, node flaps the operator cannot see). The
+        controllers must degrade to stale cached reads — no crash-loop,
+        bounded staleness — and fully converge on the backlog once the
+        window heals."""
+        out: List[Fault] = [
+            Fault(0, MUTATE_POLICY, arg=cls._marker(rng, "pre"))]
+        start = min(2, steps - 1)
+        end = min(start + max(2, steps // 3), steps - 1)
+        out.append(Fault(start, BROWNOUT_START,
+                         seconds=float(max(0, end - start))))
+        out.append(Fault(end, BROWNOUT_END))
+        for step in range(start, end):
+            if (step - start) % 2 == 0:
+                # a mutation the operator is blind to until the heal
+                out.append(Fault(step, MUTATE_POLICY,
+                                 arg=cls._marker(rng, "dark")))
+            if (step - start) % 3 == 1 and nodes:
+                victim = rng.choice(nodes)
+                out.append(Fault(step, NODE_FLAP, arg=victim))
+                out.append(Fault(min(end + 1, steps - 1), NODE_HEAL,
+                                 arg=victim))
+        for step in range(end, steps):
+            # catch-up happens under mild conflict pressure, like a real
+            # post-outage thundering herd
+            if step % 3 == 0:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(1, 3)))
+            if step == end + 1:
+                out.append(Fault(step, MUTATE_POLICY,
+                                 arg=cls._marker(rng, "post")))
+        return out
+
+    @classmethod
     def _shard_failover(cls, rng, nodes, steps) -> List[Fault]:
         """A fleet rollout keeps every reconcile shard churning bulk
         work, node flaps keep the health lane hot, and two of the four
@@ -502,6 +601,18 @@ class ChaosClient(Client):
         self.injected: dict = {}            # kind -> count, for the verdict
         self._armed: List[Fault] = []
         self._watches: List[dict] = []
+        self.brownout = False               # lists fail while set
+
+    def set_brownout(self, on: bool) -> None:
+        """Enter/exit apiserver brownout: while on, every ``list()``
+        raises 503 — the informer cache's relists fail until its breaker
+        trips into degraded mode. The runner pairs this with
+        ``suspend_watch_streams()`` so reads AND streams are dark."""
+        if on and not self.brownout:
+            self.record(BROWNOUT_START)
+        elif not on and self.brownout:
+            self.record(BROWNOUT_END)
+        self.brownout = on
 
     @property
     def supports_chunked_list(self) -> bool:
@@ -562,6 +673,9 @@ class ChaosClient(Client):
                               metadata_only=metadata_only)
 
     def list(self, api_version, kind, opts: Optional[ListOptions] = None):
+        if self.brownout:
+            raise ServerUnavailableError(
+                "chaos: apiserver brownout — list unavailable")
         self._intercept("list")
         return self.inner.list(api_version, kind, opts)
 
@@ -585,9 +699,16 @@ class ChaosClient(Client):
         self._intercept("delete")
         return self.inner.delete(api_version, kind, name, namespace)
 
-    def watch(self, api_version, kind, handler: Callable) -> Callable:
+    @property
+    def supports_watch_resume(self):
+        return getattr(self.inner, "supports_watch_resume", False)
+
+    def watch(self, api_version, kind, handler: Callable,
+              since_rv=None) -> Callable:
+        kw = {} if since_rv is None else {"since_rv": since_rv}
         entry = {"av": api_version, "kind": kind, "handler": handler,
-                 "cancel": self.inner.watch(api_version, kind, handler)}
+                 "cancel": self.inner.watch(api_version, kind, handler,
+                                            **kw)}
         self._watches.append(entry)
 
         def cancel():

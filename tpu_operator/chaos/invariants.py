@@ -133,6 +133,20 @@ class InvariantChecker:
         # run (index-coherence); built lazily on the first observation
         self._fleet_index = None
 
+    def on_operator_restart(self, step: int, cache=None,
+                            journal=None) -> None:
+        """The operator process died and a successor took over: audit
+        and release the dead process's sync journal, then follow the
+        successor's cache and journal. Cluster-state history (RVs,
+        placements, acked work, FSM units) survives untouched — the
+        cluster didn't restart, the operator did."""
+        self._check_dag(step)
+        # the successor's journal restarts pass ids and sequence
+        # numbers; stale done-seqs would false-positive dag-order
+        self._dag_done.clear()
+        self.cache = cache
+        self.journal = journal
+
     def record(self, invariant: str, step: int, detail: str) -> None:
         self.violations.append(Violation(invariant, step, detail))
         OPERATOR_METRICS.chaos_invariant_violations.labels(
@@ -571,6 +585,13 @@ class InvariantChecker:
                             f"({len(cr_rows)} rows) disagrees with a fresh "
                             f"slice_status ({len(rows)} rows)")
         self._check_cache(step, settled=True)
+        if self.cache is not None and getattr(self.cache, "degraded",
+                                              False):
+            # a healed apiserver must let the breaker close again —
+            # settling while still serving stale reads is a stuck exit
+            self.record("cache-staleness", step,
+                        "cache still in degraded mode after settling "
+                        f"(staleness {self.cache.staleness_s():.1f}s)")
         self._check_dag(step)
         nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
         self._check_placement(step, nodes, settled=True)
@@ -580,3 +601,89 @@ class InvariantChecker:
 
 def namespace_key(obj: dict) -> str:
     return get_nested(obj, "metadata", "namespace", default="") or ""
+
+
+def canonical_settled_state(client: Client, namespace: str) -> dict:
+    """The restart-coherent invariant's comparison object: a canonical,
+    clock-free projection of everything the operator owes the user at
+    settle — which requests run, at what size, with sound leases, on a
+    converged fleet. A crashed-and-restored run must produce this dict
+    byte-for-byte equal (via its sorted-JSON digest) to a never-crashed
+    run of the same seed.
+
+    Deliberately excluded: resourceVersions and write counts (a restart
+    legally re-writes), eviction/migration tallies and exact node
+    assignments (a crash may legally shift WHICH equivalent nodes serve
+    a slice — placement-sound and no-lost-work hold those paths to
+    account), and requeue/backoff bookkeeping."""
+    from ..api.slicerequest import (
+        KIND_SLICE_REQUEST,
+        MIG_TERMINAL,
+        V1ALPHA1,
+        SliceRequestSpec,
+    )
+
+    nodes = {name_of(n): n for n in client.list("v1", "Node")}
+    requests = sorted(client.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                      key=lambda r: (namespace_key(r), name_of(r)))
+    rows = []
+    owners = set()
+    for req in requests:
+        key = f"{namespace_key(req) or 'default'}/{name_of(req)}"
+        owners.add(key)
+        bound = sorted(get_nested(req, "status", "nodes",
+                                  default=[]) or [])
+        sound = True
+        for node_name in bound:
+            node = nodes.get(node_name)
+            lease = (get_nested(node, "metadata", "annotations",
+                                default={}) or {}).get(L.PLACED_BY) \
+                if node is not None else None
+            if lease != key:
+                sound = False
+        rows.append({
+            "name": f"{namespace_key(req)}/{name_of(req)}",
+            "phase": get_nested(req, "status", "phase") or "",
+            "chips": SliceRequestSpec.from_obj(req).chips_needed(),
+            "nodes_bound": len(bound),
+            "leases_sound": sound,
+            "migration_terminal":
+                (get_nested(req, "status", "migration", "phase") or "")
+                in MIG_TERMINAL,
+        })
+    orphan_leases = 0
+    tpu_nodes = ready = rolled = 0
+    for node_name in sorted(nodes):
+        node = nodes[node_name]
+        lease = (get_nested(node, "metadata", "annotations",
+                            default={}) or {}).get(L.PLACED_BY)
+        if lease and lease not in owners:
+            orphan_leases += 1
+        if not labels_of(node).get(L.GKE_TPU_ACCELERATOR):
+            continue
+        tpu_nodes += 1
+        conds = get_nested(node, "status", "conditions",
+                           default=[]) or []
+        if any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in conds):
+            ready += 1
+        if labels_of(node).get(L.UPGRADE_STATE) in (None, STATE_DONE):
+            rolled += 1
+    crs = client.list(V1, KIND_CLUSTER_POLICY)
+    return {
+        "requests": rows,
+        "fleet": {"tpu_nodes": tpu_nodes, "ready": ready,
+                  "rolled": rolled, "orphan_leases": orphan_leases},
+        "policy_ready": bool(crs) and all(
+            get_nested(cr, "status", "state") == "ready" for cr in crs),
+    }
+
+
+def settled_state_digest(state: dict) -> str:
+    """sha256 over the canonical sorted-JSON serialization — the byte
+    identity the restart-coherent invariant compares."""
+    import hashlib
+    import json
+
+    blob = json.dumps(state, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()

@@ -72,12 +72,15 @@ from ..runtime.objects import (
     thaw_obj,
 )
 from ..workloads.elastic import ElasticWorkload
+from ..runtime import snapshot as snapshot_mod
 from .faults import (
     ANNOTATION_CLEAR,
     API_CONFLICT,
     API_LATENCY,
     API_THROTTLE,
     API_UNAVAILABLE,
+    BROWNOUT_END,
+    BROWNOUT_START,
     CHIP_LOSS,
     CHIP_RESTORE,
     MUTATE_POLICY,
@@ -86,6 +89,7 @@ from .faults import (
     NODE_HEAL,
     NODE_REMOVE,
     OPERAND_DRIFT,
+    OPERATOR_CRASH,
     POD_CRASH,
     SHARD_KILL,
     SLICE_REQUEST,
@@ -98,12 +102,24 @@ from .faults import (
     FaultPlan,
     VirtualClock,
 )
-from .invariants import InvariantChecker
+from .invariants import (
+    InvariantChecker,
+    canonical_settled_state,
+    settled_state_digest,
+)
 
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
              "dag-race", "placement-contention", "placement-storm",
-             "slice-migrate", "shard-failover")
+             "slice-migrate", "shard-failover", "operator-crash",
+             "apiserver-brownout")
+
+# scenarios that run the placement controller (they create SliceRequests)
+PLACEMENT_SCENARIOS = ("placement-contention", "placement-storm",
+                       "slice-migrate", "operator-crash")
+# scenarios whose elastic requests get workload shims (the training
+# jobs' half of the slice-intent protocol)
+SHIM_SCENARIOS = ("slice-migrate", "operator-crash")
 
 # virtual deadlines for the slice-migrate scenario, sized in runner steps
 # (STEP_DT each): long enough for the elastic handshake (~3 passes),
@@ -119,6 +135,9 @@ DEFAULT_STEPS = 12
 SETUP_PASS_BUDGET = 30   # fault-free passes to reach the baseline Ready
 SOAK_PASS_BUDGET = 150   # post-fault passes before convergence fails
 DRAIN_BUDGET = 500       # reconciles per drain — a backstop, not a knob
+# reconciles each controller gets before an OPERATOR_CRASH fires: the
+# process dies mid-pass with queues half-drained, not at a tick boundary
+CRASH_PARTIAL_DRAIN = 6
 RETRY_DELAY_S = 1.0      # virtual requeue delay after an injected failure
 MAX_PARALLEL_UPGRADES = 8
 FAILOVER_SHARDS = 4      # shard count for the shard-failover scenario
@@ -165,6 +184,7 @@ class _SyncController:
         self._health_marks: Dict[Request, int] = {}
         self.max_health_behind_bulk = 0
         self.keys_moved_on_failover = 0
+        self._cancels: List[Callable] = []
 
     def watch(self, api_version: str, kind: str,
               predicate: Callable = any_event,
@@ -198,7 +218,15 @@ class _SyncController:
                 # resync (and any relist) re-enqueues what this loses
                 pass
 
-        self.client.watch(api_version, kind, handler)
+        self._cancels.append(self.client.watch(api_version, kind, handler))
+
+    def stop(self) -> None:
+        """The process dies: watch subscriptions are torn down (the
+        OPERATOR_CRASH teardown — queued keys, delayed requeues and lane
+        state simply stop existing with this object)."""
+        for cancel in self._cancels:
+            cancel()
+        self._cancels.clear()
 
     def _shard_for(self, request: Request) -> int:
         return shard_of(str(request), self._live)
@@ -802,45 +830,81 @@ def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
         op_log.setLevel(prev_level)
 
 
-def _run_scenario(scenario: str, nodes: int, seed: int,
-                  steps: Optional[int], cached: bool) -> dict:
+def _chaos_globals(scenario: str, seed: int):
+    """Context manager owning the process-wide recorders for one run.
+
+    Span timestamps come from the yielded virtual clock and sequence ids
+    restart at 0, so traces/timelines embedded in the verdict are part
+    of the deterministic output (byte-identical per seed). The DAG
+    scheduler runs in VIRTUAL mode: waves execute sequentially in a
+    seeded shuffle, so branch interleaving is adversarial yet the run
+    stays single-threaded. A fresh RNG per run makes back-to-back runs
+    of the same seed identical too."""
     import random
+    from contextlib import contextmanager
 
     from ..runtime.tracing import TRACER
     from ..state.scheduler import DAG_GATE
 
-    # the scenario owns the process-wide flight recorder for its
-    # duration: span timestamps come from the virtual clock and sequence
-    # ids restart at 0, so the traces embedded in the verdict are part of
-    # the deterministic output (byte-identical per seed)
-    clock = VirtualClock()
-    prev_clock, prev_enabled = TRACER.clock, TRACER.enabled
-    TRACER.reset(clock=clock, enabled=True)
-    # the timeline recorder follows the tracer onto the virtual clock so
-    # per-object timelines embedded in the verdict are part of the same
-    # byte-identical-per-seed output
-    prev_tl_clock, prev_tl_enabled = TIMELINE.clock, TIMELINE.enabled
-    TIMELINE.reset(clock=clock, enabled=True)
-    # the DAG scheduler runs in VIRTUAL mode: waves execute sequentially
-    # in a seeded shuffle, so branch interleaving is adversarial (a fault
-    # lands on a different parallel branch per seed) yet the run stays
-    # single-threaded and byte-identical per seed. A fresh RNG per run
-    # makes back-to-back runs of the same seed identical too.
-    prev_dag, prev_rng = DAG_GATE.enabled, DAG_GATE.virtual_rng
-    DAG_GATE.enabled = True
-    DAG_GATE.virtual_rng = random.Random(f"dag:{scenario}:{seed}")
-    try:
-        return _run_scenario_impl(scenario, nodes, seed, steps, cached,
-                                  clock)
-    finally:
-        DAG_GATE.enabled, DAG_GATE.virtual_rng = prev_dag, prev_rng
-        TRACER.reset(clock=prev_clock, enabled=prev_enabled)
-        TIMELINE.reset(clock=prev_tl_clock, enabled=prev_tl_enabled)
+    @contextmanager
+    def _ctx():
+        clock = VirtualClock()
+        prev_clock, prev_enabled = TRACER.clock, TRACER.enabled
+        TRACER.reset(clock=clock, enabled=True)
+        prev_tl_clock, prev_tl_enabled = TIMELINE.clock, TIMELINE.enabled
+        TIMELINE.reset(clock=clock, enabled=True)
+        prev_dag, prev_rng = DAG_GATE.enabled, DAG_GATE.virtual_rng
+        DAG_GATE.enabled = True
+        DAG_GATE.virtual_rng = random.Random(f"dag:{scenario}:{seed}")
+        try:
+            yield clock
+        finally:
+            DAG_GATE.enabled, DAG_GATE.virtual_rng = prev_dag, prev_rng
+            TRACER.reset(clock=prev_clock, enabled=prev_enabled)
+            TIMELINE.reset(clock=prev_tl_clock, enabled=prev_tl_enabled)
+
+    return _ctx()
+
+
+def _run_scenario(scenario: str, nodes: int, seed: int,
+                  steps: Optional[int], cached: bool) -> dict:
+    with _chaos_globals(scenario, seed) as clock:
+        out = _run_scenario_impl(scenario, nodes, seed, steps, cached,
+                                 clock)
+    if scenario == "operator-crash":
+        # restart-coherent: re-run the same seed with ONLY the crash
+        # faults stripped — every other fault, request and clock tick
+        # identical — and demand the byte-identical canonical settled
+        # state. A crash changing what settled state the fleet reaches
+        # is exactly the bug class this scenario exists to catch.
+        with _chaos_globals(scenario, seed) as base_clock:
+            base = _run_scenario_impl(scenario, nodes, seed, steps,
+                                      cached, base_clock,
+                                      strip_crashes=True)
+        coherent = (base["converged"]
+                    and base["settled_digest"] == out["settled_digest"])
+        out["restart_coherent"] = {
+            "ok": bool(out["converged"] and coherent),
+            "digest": out["settled_digest"],
+            "baseline_digest": base["settled_digest"],
+            "baseline_converged": base["converged"],
+        }
+        if out["converged"] and not coherent:
+            out["violations"].append({
+                "invariant": "restart-coherent", "step": out["steps"],
+                "detail": "settled state after crash+restore diverged "
+                          "from the never-crashed baseline "
+                          f"({out['settled_digest'][:12]} != "
+                          f"{base['settled_digest'][:12]}, baseline "
+                          f"converged={base['converged']})"})
+            out["ok"] = False
+    return out
 
 
 def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                        steps: Optional[int], cached: bool,
-                       clock: VirtualClock) -> dict:
+                       clock: VirtualClock,
+                       strip_crashes: bool = False) -> dict:
     from ..runtime.tracing import TRACER, TracingClient
 
     n_steps = steps or DEFAULT_STEPS
@@ -848,14 +912,16 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     chaos = ChaosClient(fake, clock)
     # controllers read through the cache (which reads through the chaos
     # client, so informer relists still eat armed faults); the adversary
-    # and the checker keep talking to the unwrapped fake
-    client = CachedClient(chaos) if cached else chaos
+    # and the checker keep talking to the unwrapped fake. The cache runs
+    # on the virtual clock so degraded-mode reconnect backoff and
+    # staleness age are part of the deterministic schedule.
+    client = CachedClient(chaos, now=clock) if cached else chaos
     # the reconcilers' client verbs get trace spans; the checker and the
     # verdict's relist counter keep the bare client
     traced = TracingClient(client)
     upgrade_spec = {"autoUpgrade": True,
                     "maxParallelUpgrades": MAX_PARALLEL_UPGRADES}
-    if scenario == "slice-migrate":
+    if scenario in SHIM_SCENARIOS:
         # a short virtual migrate window (3 ticks): the elastic requests
         # complete the handshake inside it, the rigid ones demonstrably
         # time out into the hard-drain degradation path
@@ -883,8 +949,7 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     # same-age Pending, so the interesting machinery is the batched gang
     # pass and the index's churn survival, not the eviction path
     place_ctrl = None
-    if scenario in ("placement-contention", "placement-storm",
-                    "slice-migrate"):
+    if scenario in PLACEMENT_SCENARIOS:
         lrec = PlacementReconciler(
             client=traced, namespace=NAMESPACE,
             preemption=(scenario == "placement-contention"),
@@ -905,28 +970,34 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     checker = InvariantChecker(fake, NAMESPACE,
                                cache=client if cached else None,
                                journal=prec.state_manager.journal)
+    relists_lost = 0  # relists crashed processes performed, for the verdict
 
-    def tick() -> None:
+    def _enqueue_resync(c: _SyncController) -> None:
         # the resync add is the informer-resync analog: the liveness
         # backstop that keeps a scenario about SAFETY invariants — one
         # event lost to an armed fault inside a watch handler must not
         # deadlock the whole run. The placement controller's resync is
         # per-request: its primary kind is the SliceRequest, not the CR.
+        if c is place_ctrl:
+            for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                c.add(Request(name=name_of(cr),
+                              namespace=namespace_of(cr)))
+        else:
+            c.add(resync)
+
+    def tick() -> None:
         for c in ctrls:
-            if c is place_ctrl:
-                for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
-                    c.add(Request(name=name_of(cr),
-                                  namespace=namespace_of(cr)))
-            else:
-                c.add(resync)
+            _enqueue_resync(c)
             c.drain()
         simulate_kubelet(fake, ready=True)
-        if scenario == "slice-migrate":
+        if scenario in SHIM_SCENARIOS:
             # the training jobs run their quantum: elastic requests get a
             # shim the first time they appear, rigid (rreq-*) never do.
             # Shims talk to the unwrapped fake like any out-of-cluster
             # client — their writes still raise watch events for the
             # controllers, but armed faults stay aimed at the operator.
+            # The shims themselves survive an OPERATOR_CRASH: the
+            # training jobs don't die when the operator does.
             for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
                 nm = name_of(cr)
                 if nm.startswith("ereq-") and nm not in shims:
@@ -939,6 +1010,69 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         clock.advance(STEP_DT)
         for c in ctrls:
             c.drain()
+
+    def _crash_restart(step: int) -> None:
+        """OPERATOR_CRASH: the process dies and a successor boots.
+
+        Everything in process memory — work queues, delayed requeues,
+        the FleetIndex, Unschedulable backoff counters, the informer
+        stores — is gone. The successor warm-restores from the last
+        periodic snapshot (``state["snapshot"]``, captured at the end of
+        the previous tick like the production writer thread would have),
+        seeds its cache stores pre-watch, adopts the restored index, and
+        re-derives the requeue state — so recovery work is O(changes
+        since snapshot), and every invariant must hold across the gap.
+        """
+        nonlocal client, traced, prec, urec, place_ctrl, relists_lost
+        for c in ctrls:
+            c.stop()
+        if cached:
+            relists_lost += client.relists
+            client.close()
+        snap = state.get("snapshot") if cached else None
+        client = CachedClient(chaos, now=clock) if cached else chaos
+        restored = None
+        if snap is not None:
+            restored = snapshot_mod.restore(client, snap)
+        traced = TracingClient(client)
+        prec = ClusterPolicyReconciler(client=traced, namespace=NAMESPACE)
+        urec = UpgradeReconciler(client=traced, namespace=NAMESPACE,
+                                 now=clock)
+        ctrls[:] = [_SyncController(prec, traced, clock, shards=shards,
+                                    name="policy"),
+                    _SyncController(urec, traced, clock, shards=shards,
+                                    name="upgrade")]
+        lrec = PlacementReconciler(
+            client=traced, namespace=NAMESPACE, preemption=False,
+            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S)
+        if snap is not None:
+            idx = snapshot_mod.restore_index(snap)
+            if idx is not None:
+                # before any watch subscribes: the adopted index's delta
+                # listener then folds exactly the replayed delta
+                lrec.adopt_index(idx)
+            for skey, payload in snap.get("stores", {}).items():
+                if skey.endswith("/" + KIND_SLICE_REQUEST):
+                    lrec.seed_requeue_state(payload.get("objects") or [])
+        place_ctrl = _SyncController(lrec, traced, clock, shards=shards,
+                                     name="placement")
+        ctrls.append(place_ctrl)
+        # watches subscribe here — seeded stores replay O(delta)
+        prec.setup_controller(ctrls[0], None)
+        urec.setup_controller(ctrls[1], None)
+        lrec.setup_controller(place_ctrl, None)
+        state["ctrls"] = ctrls
+        state["crashes"] = state.get("crashes", 0) + 1
+        state.setdefault("restores", []).append({
+            "step": step,
+            "outcome": ("restored" if restored is not None
+                        else ("cold" if cached else "uncached")),
+            "objects": (restored or {}).get("objects", 0),
+            "kinds": (restored or {}).get("kinds", 0),
+        })
+        checker.on_operator_restart(step,
+                                    cache=client if cached else None,
+                                    journal=prec.state_manager.journal)
 
     def verdict(plan: FaultPlan, converged: bool, soak: int,
                 conv_s: Optional[float]) -> dict:
@@ -963,7 +1097,10 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             "faults_injected": {k: chaos.injected[k]
                                 for k in sorted(chaos.injected)},
             "cached": cached,
-            "cache_relists": client.relists if cached else 0,
+            # accumulated across operator restarts: crashed processes'
+            # relists plus the live one's
+            "cache_relists": (client.relists + relists_lost) if cached
+            else 0,
             "converged": converged,
             "soak_passes": soak,
             "convergence_virtual_s": conv_s,
@@ -996,8 +1133,9 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         }
         if place_ctrl is not None:
             out["placement"] = _placement_summary(fake)
-        if scenario == "slice-migrate":
+        if scenario in SHIM_SCENARIOS:
             out["migrations"] = _migration_summary(fake)
+        if scenario == "slice-migrate":
             # the per-object causal story (enqueue causes, migration
             # phases, placement decisions) rides the verdict for the
             # migrate scenario — the `tpuop-cfg why` golden chain. Only
@@ -1008,6 +1146,33 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                 if k.split("/", 1)[0] in ("SliceRequest",
                                           "TPUClusterPolicy",
                                           "UpgradeUnit")}
+        if scenario == "operator-crash":
+            out["restarts"] = {
+                "crashes": state.get("crashes", 0),
+                "restores": state.get("restores", []),
+            }
+            settled = canonical_settled_state(fake, NAMESPACE)
+            out["settled_state"] = settled
+            out["settled_digest"] = settled_state_digest(settled)
+        if scenario == "apiserver-brownout":
+            out["brownout"] = {
+                "degraded_entered": bool(state.get("degraded_seen")),
+                "max_staleness_virtual_s": round(
+                    state.get("max_staleness", 0.0), 1),
+                "healed": (not getattr(client, "degraded", False))
+                if cached else True,
+            }
+            if cached and converged and not state.get("degraded_seen"):
+                # the scenario exists to prove the degradation path; a
+                # breaker that never tripped during a full brownout
+                # window means the mode is unreachable, not that the
+                # run got lucky
+                checker.record(
+                    "degraded-mode", plan.steps,
+                    "cache never entered degraded mode during the "
+                    "brownout window")
+                out["violations"] = checker.to_list()
+                out["ok"] = bool(converged and not out["violations"])
         out["slo"] = _slo_verdict(scenario, out, conv_s)
         return out
 
@@ -1028,20 +1193,72 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         name_of(n) for n in fake.list("v1", "Node")
         if labels_of(n).get(L.GKE_TPU_ACCELERATOR))
     plan = FaultPlan.build(scenario, seed, tpu_names, n_steps)
+    if strip_crashes:
+        # the restart-coherent baseline: identical schedule minus the
+        # crashes themselves (the RNG already ran, so every other fault
+        # is byte-identical to the crashed run's)
+        plan = FaultPlan(scenario=plan.scenario, seed=plan.seed,
+                         steps=plan.steps,
+                         faults=[f for f in plan.faults
+                                 if f.kind != OPERATOR_CRASH])
+    # periodic-snapshot analog: capture at the end of every tick while
+    # crash faults remain, so a crash restores from the PREVIOUS tick's
+    # state and the successor's recovery is genuinely O(delta)
+    take_snapshots = cached and any(f.kind == OPERATOR_CRASH
+                                    for f in plan.faults)
 
     for step in range(plan.steps):
         step_faults = plan.for_step(step)
         dropping = any(f.kind == WATCH_DROP for f in step_faults)
+        crashing = any(f.kind == OPERATOR_CRASH for f in step_faults)
+        if any(f.kind == BROWNOUT_START for f in step_faults):
+            # the apiserver goes dark: every stream dies AND every list
+            # fails, while the world below keeps moving
+            chaos.suspend_watch_streams()
+            chaos.set_brownout(True)
+            if cached:
+                client.mark_stale()
         if dropping:
             # streams die BEFORE this step's mutations land, so the
             # events are genuinely lost; the resume's relist must heal
             chaos.suspend_watch_streams()
         for fault in step_faults:
-            if fault.kind != WATCH_DROP:
+            if fault.kind not in (WATCH_DROP, OPERATOR_CRASH,
+                                  BROWNOUT_START, BROWNOUT_END):
                 _apply_fault(fault, fake, chaos, state)
         if dropping:
             chaos.resume_watch_streams()
+        if any(f.kind == BROWNOUT_END for f in step_faults):
+            # capture the breaker state at the worst moment — the
+            # instant before heal — then let the streams replay
+            if cached:
+                state["degraded_seen"] = (state.get("degraded_seen")
+                                          or client.degraded)
+            chaos.set_brownout(False)
+            chaos.resume_watch_streams()
+        if crashing:
+            # the process dies MID-PASS: resync enqueued, a handful of
+            # reconciles in, then every queue is abandoned half-drained
+            for c in ctrls:
+                _enqueue_resync(c)
+                c.drain(budget=CRASH_PARTIAL_DRAIN)
+            chaos.record(OPERATOR_CRASH)
+            _crash_restart(step)
         tick()
+        if cached and chaos.brownout:
+            state["degraded_seen"] = (state.get("degraded_seen")
+                                      or client.degraded)
+            state["max_staleness"] = max(state.get("max_staleness", 0.0),
+                                         client.staleness_s())
+        if take_snapshots:
+            import json
+
+            state["snapshot"] = json.loads(json.dumps(
+                snapshot_mod.capture(client, index=getattr(
+                    place_ctrl.reconciler, "fleet_index", None)
+                    if place_ctrl is not None else None,
+                    wall=clock()),
+                sort_keys=True))
         checker.observe(step)
 
     faults_stopped_at = clock.t

@@ -177,6 +177,20 @@ def gather(client, out_dir: pathlib.Path) -> dict:
             summary["cache_rendered"] = True
     except Exception as e:
         summary["errors"].append(f"cache: {e}")
+    try:
+        # the durable-snapshot plane (/debug/snapshot equivalent, the
+        # `tpuop-cfg snapshot -f` input): metadata only — object
+        # payloads stay on the operator's disk
+        from ..runtime.snapshot import env_snapshot_dir, snapshot_metadata
+
+        d = out_dir / "snapshot"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "snapshot.json").write_text(
+            json.dumps(snapshot_metadata(env_snapshot_dir()),
+                       indent=2, sort_keys=True))
+        summary["snapshot_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"snapshot: {e}")
 
     (out_dir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary

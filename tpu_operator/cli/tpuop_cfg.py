@@ -178,6 +178,15 @@ def _print_status_text(report: dict) -> None:
     upgrade = nodes.get("upgradeStates") or {}
     print(f"nodes: {nodes.get('tpu', 0)} TPU"
           + (f", upgrade states {upgrade}" if upgrade else ""))
+    cache = report.get("operatorCache")
+    if cache:
+        if cache.get("degraded"):
+            print(f"operator cache: DEGRADED — serving reads "
+                  f"{cache.get('staleness_s', 0):.0f}s stale "
+                  f"({cache.get('sync_failures', 0)} consecutive "
+                  f"apiserver sync failures)")
+        else:
+            print("operator cache: healthy")
     print("READY" if report["ready"] else "NOT READY")
 
 
@@ -212,6 +221,25 @@ def _status(args) -> int:
 
     try:
         report = _status_report(client, args.namespace)
+        # best-effort degraded-mode probe against the manager's debug
+        # port: an apiserver brownout is exactly when an operator runs
+        # `status`, so the breaker state belongs in this picture — but
+        # status must keep working with no manager reachable
+        if getattr(args, "operator_url", None):
+            import urllib.request
+
+            url = args.operator_url.rstrip("/") + "/debug/cache"
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    cs = json.load(resp)
+                report["operatorCache"] = {
+                    "degraded": bool(cs.get("degraded")),
+                    "staleness_s": cs.get("staleness_s", 0),
+                    "sync_failures": cs.get("sync_failures", 0),
+                }
+            except Exception as e:
+                print(f"warning: cannot probe operator cache at {url}: "
+                      f"{e}", file=sys.stderr)
         if as_json:
             print(json.dumps(report, indent=2, sort_keys=True))
             return 0 if report["ready"] else 1
@@ -589,6 +617,78 @@ def _cache(args) -> int:
         print(json.dumps(stats, indent=2, sort_keys=True))
         return 0
     print(render_cache_stats(stats))
+    return 0
+
+
+def render_snapshot_meta(meta: dict) -> str:
+    """The /debug/snapshot body (snapshot.snapshot_metadata) as a
+    human-readable report: plane on/off, files on disk, the newest
+    valid snapshot's stamps and per-kind counts, last restore
+    outcome."""
+    if not meta.get("enabled"):
+        return "snapshot plane: disabled (OPERATOR_SNAPSHOT_DIR unset)"
+    lines = [f"snapshot dir: {meta.get('dir')}"]
+    files = meta.get("snapshots") or []
+    lines.append(f"files on disk: {len(files)}")
+    for row in files:
+        lines.append(f"  {row.get('path')} ({row.get('bytes', 0)}B)")
+    latest = meta.get("latest")
+    if latest:
+        lines.append(
+            f"latest valid: schema {latest.get('schema')}, "
+            f"age {latest.get('age_s', 0):.0f}s"
+            + (", has index" if latest.get("has_index") else ""))
+        objs = latest.get("objects") or {}
+        total = sum(objs.values())
+        lines.append(f"  {total} objects across {len(objs)} kinds:")
+        for gvk, n in sorted(objs.items()):
+            lines.append(f"    {gvk}: {n}")
+    else:
+        lines.append("latest valid: none (no trustworthy snapshot "
+                     "on disk — next start is cold)")
+    restore = (meta.get("last_restore")
+               or meta.get("last_restore_in_memory"))
+    if restore:
+        lines.append("last restore: " + ", ".join(
+            f"{k}={restore[k]}" for k in sorted(restore)))
+    return "\n".join(lines)
+
+
+def _snapshot(args) -> int:
+    """Report the durable-snapshot plane: newest valid snapshot on
+    disk, its age/schema/per-kind object counts, and the last warm
+    restore's outcome — from the manager's /debug/snapshot, a local
+    snapshot directory (--dir, no manager needed), or a must-gather
+    snapshot.json."""
+    import pathlib
+    import urllib.request
+
+    if args.file:
+        try:
+            meta = json.loads(pathlib.Path(args.file).read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot metadata from {args.file}: {e}",
+                  file=sys.stderr)
+            return 1
+    elif args.dir:
+        from ..runtime.snapshot import snapshot_metadata
+
+        meta = snapshot_metadata(args.dir)
+    else:
+        url = args.url.rstrip("/") + "/debug/snapshot"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                meta = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if not isinstance(meta, dict):
+        print("snapshot metadata payload is not an object", file=sys.stderr)
+        return 1
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(meta, indent=2, sort_keys=True))
+        return 0
+    print(render_snapshot_meta(meta))
     return 0
 
 
@@ -971,6 +1071,11 @@ def main(argv=None) -> int:
                     default="text",
                     help="json: the same health picture as one "
                          "machine-readable object (same exit code)")
+    st.add_argument("--operator-url", default=None, dest="operator_url",
+                    help="also probe the manager's /debug/cache at this "
+                         "base URL and report Degraded-mode breaker "
+                         "state (stale reads under apiserver brownout); "
+                         "unreachable = warning, not failure")
 
     sl = sub.add_parser(
         "slices", help="SliceRequest fleet view: placement phase, chips, "
@@ -1029,6 +1134,23 @@ def main(argv=None) -> int:
     ca.add_argument("-o", "--output", choices=("text", "json"),
                     default="text")
     ca.add_argument("--timeout", type=float, default=10.0)
+
+    sn = sub.add_parser(
+        "snapshot", help="durable-snapshot plane report from "
+                         "/debug/snapshot (or --dir locally, or a "
+                         "must-gather snapshot.json): newest valid "
+                         "snapshot, age/schema/per-kind counts, last "
+                         "warm-restore outcome")
+    sn.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    sn.add_argument("--dir", default=None,
+                    help="read a snapshot directory directly instead "
+                         "of fetching (works with the manager down)")
+    sn.add_argument("-f", "--file", default=None,
+                    help="read a snapshot.json dump instead of fetching")
+    sn.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    sn.add_argument("--timeout", type=float, default=10.0)
 
     wy = sub.add_parser(
         "why", help="per-object causal timeline from /debug/timeline "
@@ -1118,6 +1240,8 @@ def main(argv=None) -> int:
         return _trace(args)
     if args.cmd == "cache":
         return _cache(args)
+    if args.cmd == "snapshot":
+        return _snapshot(args)
     if args.cmd == "why":
         return _why(args)
     if args.cmd == "slo":

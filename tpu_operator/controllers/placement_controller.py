@@ -172,10 +172,48 @@ class PlacementReconciler(Reconciler):
         self._index_live = False
         self._index_mu = threading.RLock()
         # Unschedulable backoff attempt per request key; reset on any
-        # successful placement or deletion. In-memory by design: a
-        # controller restart restarting the schedule from the fast end
-        # is the safe direction.
+        # successful placement or deletion. The count is persisted in
+        # ``status.requeueAttempts`` (riding the Unschedulable status
+        # write, no extra apiserver call) and re-derived lazily after a
+        # process restart — a restart must not collapse a fleet of 240s
+        # backoffs into an immediate-retry storm right when the
+        # apiserver is weakest.
         self._unsched_attempts = {}
+
+    @property
+    def fleet_index(self) -> Optional[FleetIndex]:
+        """The long-lived placement index, if built — the Manager's
+        snapshot writer captures it alongside the cache stores."""
+        return self._index
+
+    def adopt_index(self, index: FleetIndex) -> None:
+        """Warm-restore: adopt a snapshot-restored FleetIndex instead of
+        paying a full rebuild. Called after the cache stores are seeded
+        but BEFORE any watch subscribes, so the delta listener registered
+        here sees the subscribe replay — which the cache reduces to the
+        changes since the snapshot — and folds exactly that delta."""
+        with self._index_mu:
+            reg = getattr(self.client, "add_delta_listener", None)
+            if callable(reg):
+                reg("v1", "Node", self._on_node_delta)
+                self._index_live = True
+            self._index = index
+        OPERATOR_METRICS.placement_index_updates.labels(
+            event="adopt").inc()
+
+    def seed_requeue_state(self, requests: Iterable[dict]) -> int:
+        """Warm-restore hook: pre-seed the in-memory backoff counters
+        from the ``status.requeueAttempts`` a previous process
+        persisted, so requeues after a restart resume mid-schedule."""
+        from ..runtime.snapshot import derive_requeue_state
+
+        seeded = 0
+        for (ns, name), attempts in derive_requeue_state(requests).items():
+            key = f"{ns or 'default'}/{name}"
+            if key not in self._unsched_attempts:
+                self._unsched_attempts[key] = attempts
+                seeded += 1
+        return seeded
 
     # -- wiring ------------------------------------------------------------
 
@@ -410,6 +448,18 @@ class PlacementReconciler(Reconciler):
             set_nested(cr, PHASE_UNSCHEDULABLE, "status", "phase")
             set_nested(cr, [], "status", "nodes")
             set_nested(cr, reason, "status", "reason")
+            attempt = self._unsched_attempts.get(key)
+            if attempt is None:
+                # restart re-derivation: resume the backoff schedule a
+                # previous process persisted instead of restarting it
+                # from the fast end
+                try:
+                    attempt = int(get_nested(
+                        cr, "status", "requeueAttempts", default=0) or 0)
+                except (TypeError, ValueError):
+                    attempt = 0
+            self._unsched_attempts[key] = attempt + 1
+            set_nested(cr, attempt + 1, "status", "requeueAttempts")
             update_status_with_retry(self.client, cr, live=live)
             OPERATOR_METRICS.placement_decisions.labels(
                 outcome="unschedulable").inc()
@@ -419,8 +469,6 @@ class PlacementReconciler(Reconciler):
                                  "reason": reason})
             OPERATOR_METRICS.placement_latency.observe(
                 _time.perf_counter() - t0)
-            attempt = self._unsched_attempts.get(key, 0)
-            self._unsched_attempts[key] = attempt + 1
             OPERATOR_METRICS.placement_requeues.inc()
             return Result(
                 requeue_after=unschedulable_backoff(key, attempt))
@@ -448,6 +496,7 @@ class PlacementReconciler(Reconciler):
         set_nested(cr, f"{best.score:.6f}", "status", "score")
         set_nested(cr, spec.chips_needed(), "status", "chips")
         pop_nested(cr, "status", "reason")
+        pop_nested(cr, "status", "requeueAttempts")
         update_status_with_retry(self.client, cr, live=live)
         self._unsched_attempts.pop(key, None)
         OPERATOR_METRICS.placement_decisions.labels(outcome="placed").inc()

@@ -267,6 +267,36 @@ class OperatorMetrics:
             "1 when every evaluation window of the SLO burns past its "
             "threshold (the multi-window page condition)",
             labelnames=("slo",))
+        # crash-safe restart plane (runtime/snapshot.py + cache degraded
+        # mode): durable snapshot lifecycle, warm-restore outcomes, and
+        # the brownout breaker's externally visible state
+        self.cache_listener_errors = c(
+            "tpu_operator_cache_listener_errors_total",
+            "Exceptions raised by cache delta listeners (a listener is "
+            "detached after repeated consecutive failures)",
+            labelnames=("kind",))
+        self.cache_degraded = g(
+            "tpu_operator_cache_degraded",
+            "1 while the informer cache is in Degraded mode: apiserver "
+            "syncs failing past the breaker threshold, reads served "
+            "from the stale cache, reconnects capped-backoff")
+        self.cache_staleness_seconds = g(
+            "tpu_operator_cache_staleness_seconds",
+            "Age of the cached view: seconds since the last successful "
+            "apiserver sync once syncs start failing (0 while healthy)")
+        self.snapshot_writes = c(
+            "tpu_operator_snapshot_writes_total",
+            "Durable cache/index snapshot write attempts by outcome "
+            "(written|failed)",
+            labelnames=("outcome",))
+        self.snapshot_restores = c(
+            "tpu_operator_snapshot_restores_total",
+            "Warm-restore attempts at manager start by outcome "
+            "(restored|missing|discarded|failed)",
+            labelnames=("outcome",))
+        self.snapshot_age_seconds = g(
+            "tpu_operator_snapshot_age_seconds",
+            "Age of the newest valid durable snapshot on disk")
 
 
 OPERATOR_METRICS = OperatorMetrics()

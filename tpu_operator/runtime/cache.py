@@ -61,13 +61,21 @@ runner it is also fully deterministic.
 
 from __future__ import annotations
 
+import logging
 import sys
 import threading
+import time
 from collections.abc import Mapping as _Mapping
 from typing import Callable, Iterable, Optional
 
 from ..api import labels as L
-from .client import Client, ListOptions, NotFoundError, WatchEvent
+from .client import (
+    Client,
+    ListOptions,
+    NotFoundError,
+    WatchEvent,
+    WatchGoneError,
+)
 from .objects import (
     FrozenDict,
     deepcopy_obj,
@@ -237,6 +245,24 @@ PROJECTIONS: dict[tuple, Callable[[dict], dict]] = {
     ("v1", "Pod"): _project_pod,
 }
 
+logger = logging.getLogger("tpu_operator.cache")
+
+#: Consecutive failures after which a delta listener is detached — a
+#: listener that throws on every delta is a dead consumer, and paying
+#: an exception per store change forever is a slow leak.
+LISTENER_DETACH_AFTER = 5
+
+#: Consecutive relist/list failures after which the cache enters
+#: Degraded mode: reads keep serving the (RV-monotonic, gap-stale)
+#: cached view instead of surfacing apiserver errors to every
+#: controller, and reconnects back off instead of hammering a browned-
+#: out apiserver on every read.
+DEGRADED_THRESHOLD = 3
+
+#: Capped exponential backoff for degraded-mode reconnect attempts.
+DEGRADED_BACKOFF_BASE_S = 1.0
+DEGRADED_BACKOFF_CAP_S = 60.0
+
 
 def measure_bytes(obj) -> int:
     """Approximate resident footprint of one stored object tree:
@@ -280,6 +306,20 @@ class _Store:
         # ingest path tell "echo of our own write" from "resumed-stream
         # replay" when an ADDED arrives at an RV we already hold
         self.written_rvs: dict[tuple, str] = {}
+        # warm-restore ledger: key -> resourceVersion seeded from a
+        # durable snapshot *before* the informer subscribed. The
+        # subscribe-time replay consumes entries (an ADDED at a seeded
+        # key is expected, not a resumed-stream signature); keys left
+        # unconsumed after the replay were deleted while the operator
+        # was down and are pruned — O(delta) healing, no relist.
+        self.seeded_rvs: dict[tuple, str] = {}
+        # highest RV seeded from the snapshot — the resume point a
+        # watch(since_rv=) subscribe heals forward from
+        self.seed_floor = 0
+        # True when the subscribe resumed from seed_floor (delta replay)
+        # instead of a full-state replay
+        self.resumed = False
+        self.subscribed = False
         self.needs_relist = False
         self.relist_lock = threading.Lock()
         self.relist_total = 0
@@ -337,8 +377,67 @@ class _Store:
             if self.objects.pop(key, None) is not None:
                 self._unindex(key)
             self.written_rvs.pop(key, None)
+            self.seeded_rvs.pop(key, None)
             self.bytes_total -= self.obj_bytes.pop(key, 0)
             self.full_bytes_total -= self.full_obj_bytes.pop(key, 0)
+
+    def seed_many(self, objects: Iterable[dict],
+                  obj_bytes: Optional[dict] = None,
+                  full_obj_bytes: Optional[dict] = None) -> int:
+        """Pre-watch bulk insert from a durable snapshot: one lock
+        acquisition for the whole store, and byte counts carried in the
+        snapshot skip the per-object ``measure_bytes`` walk — the
+        dominant per-object cost — so seeding a 10k-object store is a
+        deserialize + index, not a re-measure of the fleet. Byte ledgers
+        may be keyed dicts or sequences aligned with ``objects`` (the
+        snapshot's compact form). Objects must already be frozen;
+        returns the count seeded."""
+        by_pos_o = isinstance(obj_bytes, (list, tuple))
+        by_pos_f = isinstance(full_obj_bytes, (list, tuple))
+        if not by_pos_o:
+            obj_bytes = obj_bytes or {}
+        if not by_pos_f:
+            full_obj_bytes = full_obj_bytes or {}
+        namespaced = is_namespaced(self.kind)
+        count = 0
+        floor = 0
+        with self.lock:
+            store_objs = self.objects
+            o_ledger, f_ledger = self.obj_bytes, self.full_obj_bytes
+            for pos, obj in enumerate(objects):
+                md = obj.get("metadata") or {}
+                # exact key_of() semantics: missing -> "" (get_nested
+                # default), so seeded keys match the replay's lookups
+                key = (md.get("namespace", "") if namespaced else "",
+                       md.get("name", ""))
+                self._unindex(key)
+                store_objs[key] = obj
+                self._index(key, obj)
+                stored_b = (obj_bytes[pos] if by_pos_o
+                            else obj_bytes.get(key))
+                if stored_b is None:
+                    stored_b = measure_bytes(obj)
+                full_b = (full_obj_bytes[pos] if by_pos_f
+                          else full_obj_bytes.get(key))
+                if full_b is None:
+                    full_b = stored_b
+                self.bytes_total += stored_b - o_ledger.get(key, 0)
+                o_ledger[key] = stored_b
+                self.full_bytes_total += full_b - f_ledger.get(key, 0)
+                f_ledger[key] = full_b
+                rv = md.get("resourceVersion")
+                if rv:
+                    self.seeded_rvs[key] = rv
+                    try:
+                        irv = int(rv)
+                    except (TypeError, ValueError):
+                        irv = 0
+                    if irv > floor:
+                        floor = irv
+                count += 1
+            if floor > self.seed_floor:
+                self.seed_floor = floor
+        return count
 
     def _index(self, key: tuple, obj: dict) -> None:
         filed = {}
@@ -398,13 +497,15 @@ class CachedClient(Client):
 
     def __init__(self, inner: Client,
                  extra_indexes: Optional[dict] = None,
-                 relist_chunk: Optional[int] = None):
+                 relist_chunk: Optional[int] = None,
+                 now: Optional[Callable[[], float]] = None):
         self.inner = inner
         self._stores: dict[tuple, _Store] = {}
         self._meta = threading.Lock()
         self._cancels: list[Callable[[], None]] = []
         self._extra = dict(extra_indexes or {})
         self._delta_listeners: dict[tuple, list] = {}
+        self._listener_failures: dict[int, int] = {}  # id(fn) -> consecutive
         self._closed = False
         self.relist_chunk = (env_relist_chunk() if relist_chunk is None
                              else max(0, relist_chunk))
@@ -412,6 +513,22 @@ class CachedClient(Client):
         # the apiserver, and heals performed
         self.cache_reads = 0
         self.relists = 0
+        self.listener_errors = 0
+        # warm-restore healing: subscribes that resumed from the snapshot
+        # RV (O(delta) replay) vs. fell back to a full replay (410 / no
+        # server support)
+        self.watch_resumes = 0
+        self.watch_resume_fallbacks = 0
+        # Degraded-mode breaker state. ``now`` is injectable so the
+        # chaos plane can drive staleness/backoff off the virtual clock.
+        self.now = now or time.monotonic
+        self.degraded = False
+        self.degraded_since: Optional[float] = None
+        self.sync_failures = 0          # consecutive; resets on success
+        self.sync_failures_total = 0
+        self.last_synced = self.now()   # last successful relist/subscribe
+        self._next_reconnect = 0.0
+        self._reconnect_delay = DEGRADED_BACKOFF_BASE_S
 
     @property
     def serves_cached_reads(self) -> bool:
@@ -421,8 +538,10 @@ class CachedClient(Client):
 
     # -- informer lifecycle -------------------------------------------------
 
-    def _ensure(self, api_version: str, kind: str) -> _Store:
-        gvk = (api_version, kind)
+    def _new_store(self, gvk: tuple) -> _Store:
+        """Create (or return) the store for ``gvk`` without subscribing.
+        Caller holds no lock."""
+        api_version, kind = gvk
         with self._meta:
             store = self._stores.get(gvk)
             if store is None:
@@ -432,21 +551,103 @@ class CachedClient(Client):
                 if PROJECTION_GATE.enabled:
                     store.projection = PROJECTIONS.get(gvk)
                 self._stores[gvk] = store
-                creator = True
-            else:
-                creator = False
+        return store
+
+    def _ensure(self, api_version: str, kind: str) -> _Store:
+        gvk = (api_version, kind)
+        store = self._new_store(gvk)
+        with self._meta:
+            creator = not store.subscribed
+            store.subscribed = True
         if creator:
             # subscribe outside the meta lock: the inner watch replays
             # ADDED for every live object synchronously, feeding the store
-            # its initial state (the informer's initial LIST)
-            cancel = self.inner.watch(api_version, kind,
-                                      self._ingest_handler(store))
+            # its initial state (the informer's initial LIST). A snapshot-
+            # seeded store pays only the delta: replays at an already-held
+            # RV short-circuit before projection/freeze/measure.
+            handler = self._ingest_handler(store)
+            cancel = None
+            with store.lock:
+                since = store.seed_floor if store.seeded_rvs else 0
+            if since and getattr(self.inner, "supports_watch_resume",
+                                 False):
+                # snapshot-seeded store against a server that can resume:
+                # replay only the events after the snapshot's RV — the
+                # RV-diff heal, no relist of the world.
+                try:
+                    cancel = self.inner.watch(api_version, kind, handler,
+                                              since_rv=since)
+                except WatchGoneError:
+                    # resume point fell out of the watch window: pay the
+                    # classic full replay below instead
+                    with self._meta:
+                        self.watch_resume_fallbacks += 1
+                else:
+                    # the delta replay carried downtime deletions as
+                    # explicit DELETED tombstones; seeded keys it never
+                    # mentioned are simply unchanged — keep them, no
+                    # prune pass
+                    with store.lock:
+                        store.seeded_rvs.clear()
+                    store.resumed = True
+                    with self._meta:
+                        self.watch_resumes += 1
+            if cancel is None:
+                cancel = self.inner.watch(api_version, kind, handler)
+                self._finish_seed(store)
             with self._meta:
                 self._cancels.append(cancel)
+            self._mark_synced()
             store.started.set()
         else:
             store.started.wait(timeout=30.0)
         return store
+
+    def seed_store(self, api_version: str, kind: str,
+                   objects: Iterable[dict],
+                   obj_bytes=None, full_obj_bytes=None) -> int:
+        """Warm-restore entry point: pre-load a store from a durable
+        snapshot *before* its informer subscribes. Objects are stored as
+        given (snapshots hold already-projected views); ``obj_bytes`` /
+        ``full_obj_bytes`` carry the footprints measured at snapshot
+        time — (ns, name)-keyed dicts or sequences aligned with
+        ``objects`` — skipping the re-measure walk. The
+        first read of the kind subscribes the informer; its replay then
+        folds only the changes since the snapshot (O(delta)) and prunes
+        keys deleted during the downtime. Raises if the informer already
+        subscribed — seeding an active store would race the stream."""
+        gvk = (api_version, kind)
+        store = self._new_store(gvk)
+        with self._meta:
+            if store.subscribed:
+                raise RuntimeError(
+                    f"cannot seed {api_version}/{kind}: informer already "
+                    "subscribed")
+        count = store.seed_many(
+            (o if type(o) is FrozenDict else freeze_obj(o)
+             for o in objects),
+            obj_bytes=obj_bytes, full_obj_bytes=full_obj_bytes)
+        self._publish_bytes(store)
+        return count
+
+    def _finish_seed(self, store: _Store) -> None:
+        """After the subscribe-time replay: seeded keys the replay never
+        confirmed were deleted while the operator was down — prune them
+        (the O(delta) analog of the relist's prune pass)."""
+        with store.lock:
+            leftover = list(store.seeded_rvs)
+            store.seeded_rvs.clear()
+        if not leftover:
+            return
+        gvk = (store.api_version, store.kind)
+        for key in leftover:
+            with store.lock:
+                obj = store.objects.get(key)
+            if obj is None:
+                continue
+            store.remove(key)
+            self._notify_delta(gvk, "DELETED", obj)
+        self._publish_bytes(store)
 
     def add_delta_listener(self, api_version: str, kind: str,
                            listener: Callable[[str, dict], None]):
@@ -455,8 +656,11 @@ class CachedClient(Client):
         echoes (MODIFIED), and local deletes (DELETED, metadata-only
         stub). Fired *after* the store reflects the change, so a listener
         reading the cache never sees a view older than its delta.
-        Listener exceptions are swallowed — the cache must stay healthy
-        regardless of consumer bugs. Returns a zero-arg cancel."""
+        Listener exceptions are absorbed (the cache must stay healthy
+        regardless of consumer bugs) but counted on
+        ``cache_listener_errors`` and logged; a listener that fails
+        ``LISTENER_DETACH_AFTER`` consecutive times is detached with an
+        ERROR naming it. Returns a zero-arg cancel."""
         gvk = (api_version, kind)
         with self._meta:
             self._delta_listeners.setdefault(gvk, []).append(listener)
@@ -473,24 +677,87 @@ class CachedClient(Client):
         for fn in tuple(self._delta_listeners.get(gvk, ())):
             try:
                 fn(event_type, obj)
-            except Exception:  # pragma: no cover - consumer bug firewall
-                pass
+            except Exception:
+                # consumer bug firewall: the cache must stay healthy, but
+                # a silently-swallowed listener error is an invisible
+                # index drifting out of sync — count it, and detach the
+                # listener once it proves itself dead.
+                self.listener_errors += 1
+                fails = self._listener_failures.get(id(fn), 0) + 1
+                self._listener_failures[id(fn)] = fails
+                from ..metrics.operator_metrics import OPERATOR_METRICS
+
+                OPERATOR_METRICS.cache_listener_errors.labels(
+                    kind=gvk[1]).inc()
+                name = getattr(fn, "__qualname__",
+                               getattr(fn, "__name__", repr(fn)))
+                if fails >= LISTENER_DETACH_AFTER:
+                    logger.error(
+                        "cache: detaching delta listener %s for %s/%s "
+                        "after %d consecutive failures", name, gvk[0],
+                        gvk[1], fails, exc_info=True)
+                    with self._meta:
+                        try:
+                            self._delta_listeners.get(gvk, []).remove(fn)
+                        except ValueError:
+                            pass
+                    self._listener_failures.pop(id(fn), None)
+                else:
+                    logger.warning(
+                        "cache: delta listener %s for %s/%s raised "
+                        "(%d/%d consecutive)", name, gvk[0], gvk[1],
+                        fails, LISTENER_DETACH_AFTER, exc_info=True)
+            else:
+                self._listener_failures.pop(id(fn), None)
 
     def _ingest_handler(self, store: _Store):
         gvk = (store.api_version, store.kind)
 
         def handler(event: WatchEvent):
+            key = store.key_of(event.obj)
             if event.type == "DELETED":
+                # remove() consumes the seeded-ledger entry for the key
                 store.remove(event.obj)
                 self._publish_bytes(store)
                 self._notify_delta(gvk, "DELETED", event.obj)
                 return
+            # no-op fast path: an event at an RV we already hold cannot
+            # change the store (upsert would return same/stale), so skip
+            # projection/freeze/measure entirely. This is what makes a
+            # snapshot-seeded warm start O(delta) in CPU too — the
+            # subscribe replay of 10k unchanged objects is 10k integer
+            # compares under one lock hold each, not 10k
+            # projection+measure walks.
+            rv = get_nested(event.obj, "metadata", "resourceVersion")
+            try:
+                new_rv = int(rv)
+            except (TypeError, ValueError):
+                new_rv = None
+            with store.lock:
+                # any event for a seeded key confirms it survived the
+                # downtime — consume the warm-restore ledger entry
+                seeded = store.seeded_rvs.pop(key, None) is not None
+                cur_rv = _rv_int(store.objects.get(key))
+                fast = (new_rv is not None and cur_rv is not None
+                        and new_rv <= cur_rv)
+                own_echo = False
+                if fast and event.type == "ADDED":
+                    own_echo = store.written_rvs.get(key) == rv
+                    if own_echo:
+                        store.written_rvs.pop(key, None)
+            if fast:
+                if event.type == "ADDED" and not own_echo and not seeded:
+                    # replayed state from a resumed stream: deletions
+                    # that happened during the gap are invisible to the
+                    # replay, so schedule a relist to prune them
+                    store.needs_relist = True
+                return
             # freeze-on-ingest: a fake/cached inner already publishes
-            # frozen views (shared zero-copy); a mutable event object is
-            # converted once here — leaves are immutable scalars, so
-            # structural sharing with other subscribers is safe. With a
-            # projection installed, the slimmed view is frozen instead
-            # (new top-level dicts, leaves still structurally shared).
+            # frozen views (shared zero-copy); a mutable event object
+            # is converted once here — leaves are immutable scalars,
+            # so structural sharing with other subscribers is safe.
+            # With a projection installed, the slimmed view is frozen
+            # instead (new top-level dicts, leaves shared).
             if store.projection is not None:
                 obj = freeze_obj(store.projection(event.obj))
                 full_b = measure_bytes(event.obj)
@@ -502,13 +769,13 @@ class CachedClient(Client):
             if outcome in ("new", "replaced"):
                 self._notify_delta(gvk, event.type, obj)
             if event.type == "ADDED" and outcome in ("same", "stale"):
-                key = store.key_of(obj)
-                rv = get_nested(obj, "metadata", "resourceVersion")
+                # raced with a concurrent ingest for the same key: fall
+                # back to the original echo/prune bookkeeping
                 with store.lock:
                     own_echo = store.written_rvs.get(key) == rv
                     if own_echo:
                         store.written_rvs.pop(key, None)
-                if not own_echo:
+                if not own_echo and not seeded:
                     # replayed state from a resumed stream: deletions that
                     # happened during the gap are invisible to the replay,
                     # so schedule a relist to prune them
@@ -518,6 +785,8 @@ class CachedClient(Client):
     def _maybe_relist(self, store: _Store) -> None:
         if not store.needs_relist:
             return
+        if self.degraded and self.now() < self._next_reconnect:
+            return  # reconnect is backed off: serve the stale view
         # non-blocking per-store guard: one heal per store at a time, and
         # readers that lose the race serve the current (RV-monotonic, so
         # never-corrupt, at worst gap-stale) view instead of convoying
@@ -527,9 +796,81 @@ class CachedClient(Client):
             return
         try:
             if store.needs_relist:
-                self._relist(store)
+                try:
+                    self._relist(store)
+                except Exception:
+                    # the dirty flag stays set so a later read retries
+                    if not self._record_sync_failure():
+                        raise
+                else:
+                    self._mark_synced()
         finally:
             store.relist_lock.release()
+
+    # -- degraded-mode breaker ----------------------------------------------
+
+    def _record_sync_failure(self) -> bool:
+        """One failed relist against the apiserver. Returns True when the
+        failure is absorbed (cache is — or just became — Degraded and
+        keeps serving stale reads) and False when it should propagate to
+        the reader (healthy cache, breaker below threshold)."""
+        self.sync_failures += 1
+        self.sync_failures_total += 1
+        from ..metrics.operator_metrics import OPERATOR_METRICS
+
+        if not self.degraded and self.sync_failures < DEGRADED_THRESHOLD:
+            return False
+        if not self.degraded:
+            self.degraded = True
+            self.degraded_since = self.now()
+            self._reconnect_delay = DEGRADED_BACKOFF_BASE_S
+            logger.error(
+                "cache: entering Degraded mode after %d consecutive sync "
+                "failures; serving stale reads, reconnecting with capped "
+                "backoff", self.sync_failures)
+        else:
+            self._reconnect_delay = min(DEGRADED_BACKOFF_CAP_S,
+                                        self._reconnect_delay * 2.0)
+        self._next_reconnect = self.now() + self._reconnect_delay
+        OPERATOR_METRICS.cache_degraded.set(1)
+        OPERATOR_METRICS.cache_staleness_seconds.set(self.staleness_s())
+        return True
+
+    def _mark_synced(self) -> None:
+        """A successful sync (subscribe replay or relist): reset the
+        breaker and, if degraded, exit cleanly."""
+        self.last_synced = self.now()
+        self.sync_failures = 0
+        self._reconnect_delay = DEGRADED_BACKOFF_BASE_S
+        self._next_reconnect = 0.0
+        if self.degraded:
+            since = self.degraded_since or self.last_synced
+            logger.warning(
+                "cache: apiserver healed; exiting Degraded mode after "
+                "%.1fs", self.last_synced - since)
+            self.degraded = False
+            self.degraded_since = None
+        from ..metrics.operator_metrics import OPERATOR_METRICS
+
+        OPERATOR_METRICS.cache_degraded.set(0)
+        OPERATOR_METRICS.cache_staleness_seconds.set(0)
+
+    def staleness_s(self) -> float:
+        """Age of the cached view: 0 while watches are healthy, seconds
+        since the last successful sync once syncs start failing."""
+        if not self.degraded and self.sync_failures == 0:
+            return 0.0
+        return max(0.0, self.now() - self.last_synced)
+
+    def mark_stale(self) -> None:
+        """Flag every store dirty — the informer-side signal that the
+        watch stream died (410 Gone / timeout). The next read of each
+        kind attempts the relist heal; if the apiserver is browned out
+        those attempts trip the Degraded breaker."""
+        with self._meta:
+            stores = list(self._stores.values())
+        for store in stores:
+            store.needs_relist = True
 
     def _list_inner_chunked(self, store: _Store) -> Iterable[dict]:
         """Page through the inner client's list when it supports
@@ -589,6 +930,7 @@ class CachedClient(Client):
         for store in list(self._stores.values()):
             with store.relist_lock:
                 self._relist(store)
+        self._mark_synced()
 
     # -- reads: served from the store ---------------------------------------
 
@@ -705,14 +1047,45 @@ class CachedClient(Client):
                     "full_bytes": store.full_bytes_total,
                     "projected": store.projection is not None,
                     "relists": store.relist_total,
+                    "resumed": store.resumed,
                 }
         return {
             "projection_enabled": PROJECTION_GATE.enabled,
             "relist_chunk": self.relist_chunk,
             "cache_reads": self.cache_reads,
             "relists": self.relists,
+            "degraded": self.degraded,
+            "staleness_s": round(self.staleness_s(), 3),
+            "sync_failures": self.sync_failures,
+            "sync_failures_total": self.sync_failures_total,
+            "listener_errors": self.listener_errors,
+            "watch_resumes": self.watch_resumes,
+            "watch_resume_fallbacks": self.watch_resume_fallbacks,
             "kinds": kinds,
         }
+
+    def dump_stores(self) -> dict:
+        """Snapshot source: per-kind stored objects (the projected views,
+        exactly as served) plus their measured byte ledgers, so a warm
+        restore re-seeds without re-projecting or re-measuring. Returns
+        ``{(api_version, kind): {"objects": [...], "obj_bytes": [...],
+        "full_obj_bytes": [...]}}`` — the byte ledgers are lists aligned
+        with ``objects`` (no per-object key strings in the snapshot) and
+        the frozen views are shared zero-copy: callers serialize, they
+        don't mutate."""
+        with self._meta:
+            stores = dict(self._stores)
+        out = {}
+        for gvk, store in sorted(stores.items()):
+            with store.lock:
+                out[gvk] = {
+                    "objects": list(store.objects.values()),
+                    "obj_bytes": [store.obj_bytes.get(k, 0)
+                                  for k in store.objects],
+                    "full_obj_bytes": [store.full_obj_bytes.get(k, 0)
+                                       for k in store.objects],
+                }
+        return out
 
     def store_snapshot(self, api_version: str, kind: str) -> dict:
         """(ns, name) -> resourceVersion for every cached object of the

@@ -81,6 +81,15 @@ class ServerUnavailableError(ApiError):
     code = 503
 
 
+class WatchGoneError(ApiError):
+    """HTTP 410 Gone: the requested watch start resourceVersion has
+    fallen out of the server's watch window and cannot be resumed from.
+    Callers fall back to a full list/replay — the informer's classic
+    relist — so a too-old resume point costs a cold sync, never a gap."""
+
+    code = 410
+
+
 @dataclass(frozen=True)
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
@@ -138,6 +147,14 @@ class Client(abc.ABC):
     #: returns :class:`PagedList` pages — lets the informer relist a 10k
     #: node fleet in chunks instead of materializing it all at once.
     supports_chunked_list = False
+
+    #: True when ``watch`` accepts ``since_rv`` and can replay only the
+    #: events after that resourceVersion (the apiserver watch-cache
+    #: resume). A snapshot-seeded informer uses this to heal O(delta)
+    #: on the wire instead of re-receiving the whole fleet; servers that
+    #: cannot serve the resume point raise :class:`WatchGoneError` and
+    #: the caller falls back to the full replay + prune path.
+    supports_watch_resume = False
 
     @abc.abstractmethod
     def get(self, api_version: str, kind: str, name: str,

@@ -22,6 +22,7 @@ from .client import (
     NotFoundError,
     PagedList,
     WatchEvent,
+    WatchGoneError,
     WatchHub,
     merge_patch,
 )
@@ -44,10 +45,21 @@ from ..utils.hash import object_hash
 
 class FakeClient(Client):
     supports_chunked_list = True
+    supports_watch_resume = True
 
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
+        # deletion tombstones: key -> RV assigned at delete time. This is
+        # the fake's watch cache — watch(since_rv=) replays them so a
+        # resuming watcher learns about objects deleted while it was down
+        # without a full relist. Latest delete wins per key; a re-create
+        # clears the tombstone (the ADDED event supersedes it).
+        self._tombstones: dict[tuple, int] = {}
+        # when set, since_rv more than this many RVs behind the head is
+        # answered with WatchGoneError (the apiserver's bounded watch
+        # cache / HTTP 410). None = unlimited, the default for tests.
+        self.watch_window: Optional[int] = None
         # live-object uid -> refcount, maintained on create/delete so the
         # orphaned-ownerRef check in create() is O(#refs), not a scan of
         # the whole store (which made bulk creates O(n^2) at scale). A
@@ -162,6 +174,7 @@ class FakeClient(Client):
             meta.setdefault("creationTimestamp", "1970-01-01T00:00:00Z")
             obj = freeze_obj(obj)
             self._store[key] = obj
+            self._tombstones.pop(key, None)
             # creating with an ownerReference to an already-deleted owner:
             # the real apiserver accepts this and the GC controller collects
             # it shortly after; the fake compresses that to "immediately",
@@ -262,6 +275,9 @@ class FakeClient(Client):
         with self._lock:
             obj = self._store.pop(key, None)
             if obj is not None:
+                # deletion gets its own RV (real apiserver semantics) so a
+                # since_rv resume positioned before it replays the DELETED
+                self._tombstones[key] = int(self._next_rv())
                 gone = get_nested(obj, "metadata", "uid")
                 left = self._live_uids.get(gone, 0) - 1
                 if left > 0:
@@ -287,15 +303,47 @@ class FakeClient(Client):
                 except NotFoundError:
                     pass
 
-    def watch(self, api_version, kind, handler):
+    def watch(self, api_version, kind, handler, since_rv=None):
         # Hold the store lock across replay + subscribe so a concurrent
         # create can't land between them and lose its ADDED event. (A
         # duplicate ADDED is possible and harmless — the workqueue dedups.)
+        if since_rv is None:
+            with self._lock:
+                existing = self.list(api_version, kind)
+                cancel = self.hub.subscribe(api_version, kind, handler)
+            for obj in existing:
+                handler(WatchEvent("ADDED", obj))
+            return cancel
+        # resume: replay only what moved after since_rv — changed objects
+        # as MODIFIED plus tombstoned deletions as metadata-only DELETED
+        # stubs — in RV order, so the subscriber heals O(delta) instead of
+        # relisting the world.
+        since = int(since_rv)
         with self._lock:
-            existing = self.list(api_version, kind)
+            self._count("watch")
+            if (self.watch_window is not None
+                    and self._rv - since > self.watch_window):
+                raise WatchGoneError(
+                    f"resourceVersion {since} is too old "
+                    f"(head {self._rv}, window {self.watch_window})")
+            replay = []
+            for (av, k, ns, name), obj in self._store.items():
+                if av != api_version or k != kind:
+                    continue
+                rv = int(get_nested(obj, "metadata", "resourceVersion"))
+                if rv > since:
+                    replay.append((rv, WatchEvent("MODIFIED", obj)))
+            for (av, k, ns, name), trv in self._tombstones.items():
+                if av != api_version or k != kind or trv <= since:
+                    continue
+                meta = {"name": name, "resourceVersion": str(trv)}
+                if ns:
+                    meta["namespace"] = ns
+                replay.append((trv, WatchEvent("DELETED", freeze_obj(
+                    {"apiVersion": av, "kind": k, "metadata": meta}))))
             cancel = self.hub.subscribe(api_version, kind, handler)
-        for obj in existing:
-            handler(WatchEvent("ADDED", obj))
+        for _, event in sorted(replay, key=lambda e: e[0]):
+            handler(event)
         return cancel
 
     # -- cluster simulation ------------------------------------------------

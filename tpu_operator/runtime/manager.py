@@ -537,6 +537,16 @@ class _HealthHandler(BaseHTTPRequestHandler):
                                   sort_keys=True).encode()
             code = 200
             ctype = "application/json"
+        elif url.path == "/debug/snapshot":
+            import json
+
+            from . import snapshot as snapshot_mod
+
+            meta = snapshot_mod.snapshot_metadata(self.manager.snapshot_dir)
+            meta["last_restore_in_memory"] = self.manager.last_restore
+            body = json.dumps(meta, sort_keys=True).encode()
+            code = 200
+            ctype = "application/json"
         elif url.path == "/debug/traces":
             import json
 
@@ -638,7 +648,11 @@ class Manager:
                  health_port: Optional[int] = None,
                  leader_elect: bool = False,
                  on_lost_leadership: Optional[Callable[[], None]] = None,
-                 write_qps: Optional[float] = None):
+                 write_qps: Optional[float] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_interval: Optional[float] = None):
+        from . import snapshot as snapshot_mod
+
         self.client = client
         self.namespace = namespace
         self.controllers: list[Controller] = []
@@ -652,6 +666,18 @@ class Manager:
         # <=0 = unlimited, the pre-budget behavior)
         qps = env_write_qps() if write_qps is None else write_qps
         self.write_budget = WriteBudget(qps)
+        # durable snapshot plane (OPERATOR_SNAPSHOT_DIR unset = off):
+        # warm-restore at start, jittered periodic writes, a final write
+        # on clean shutdown
+        self.snapshot_dir = (snapshot_mod.env_snapshot_dir()
+                             if snapshot_dir is None else
+                             (snapshot_dir or None))
+        self.snapshot_interval = (snapshot_mod.env_snapshot_interval()
+                                  if snapshot_interval is None
+                                  else max(0.0, snapshot_interval))
+        self.last_restore: Optional[dict] = None
+        self._snapshot_stop = threading.Event()
+        self._snapshot_thread: Optional[threading.Thread] = None
 
     def find_cache(self):
         """The CachedClient in this manager's client chain, if any —
@@ -694,7 +720,107 @@ class Manager:
         reconciler.setup_controller(ctrl, self)  # type: ignore[attr-defined]
         return ctrl
 
+    # -- durable snapshots (runtime/snapshot.py) -----------------------------
+
+    def _snapshot_index(self):
+        """The FleetIndex a registered placement reconciler maintains,
+        if any — captured alongside the cache stores."""
+        for ctrl in self.controllers:
+            idx = getattr(ctrl.reconciler, "fleet_index", None)
+            if idx is not None:
+                return idx
+        return None
+
+    def restore_from_snapshot(self) -> Optional[dict]:
+        """Warm-restore: load the newest valid snapshot and seed the
+        cache stores pre-watch, so the informers' subscribe replays fold
+        only the delta. Returns the restore outcome (also recorded next
+        to the snapshots and on ``snapshot_restores_total``)."""
+        import time
+
+        from . import snapshot as snapshot_mod
+
+        cache = self.find_cache()
+        if self.snapshot_dir is None or cache is None:
+            return None
+        outcome: dict = {"at": time.time(), "outcome": "missing"}
+        try:
+            snap = snapshot_mod.load_latest(self.snapshot_dir,
+                                            now_wall=time.time())
+            if snap is None:
+                # nothing usable on disk: cold start (corrupt/stale files
+                # were logged and skipped by load_latest)
+                outcome["outcome"] = (
+                    "discarded" if snapshot_mod.snapshot_files(
+                        self.snapshot_dir) else "missing")
+            else:
+                summary = snapshot_mod.restore(cache, snap)
+                outcome.update(summary)
+                outcome["outcome"] = "restored"
+                outcome["path"] = snap.get("_path", "")
+                outcome["snapshot_written_at"] = snap["written_at"]
+                # requeue state lived only in process memory: re-derive
+                # the Unschedulable backoff positions from the persisted
+                # status so a restart doesn't unleash a retry storm
+                seeded = 0
+                for skey, payload in snap.get("stores", {}).items():
+                    if not skey.endswith("/SliceRequest"):
+                        continue
+                    for ctrl in self.controllers:
+                        hook = getattr(ctrl.reconciler,
+                                       "seed_requeue_state", None)
+                        if callable(hook):
+                            seeded += hook(payload.get("objects") or [])
+                if seeded:
+                    outcome["requeue_state_seeded"] = seeded
+        except Exception as exc:  # a bad restore must not block startup
+            log.exception("snapshot restore failed; cold start")
+            outcome["outcome"] = "failed"
+            outcome["error"] = str(exc)
+        OPERATOR_METRICS.snapshot_restores.labels(
+            outcome=outcome["outcome"]).inc()
+        snapshot_mod.record_restore(self.snapshot_dir, outcome)
+        self.last_restore = outcome
+        return outcome
+
+    def write_snapshot_now(self) -> Optional[str]:
+        """Capture cache + index and persist atomically. Returns the
+        written path, or None when the plane is off / capture failed."""
+        from . import snapshot as snapshot_mod
+
+        cache = self.find_cache()
+        if self.snapshot_dir is None or cache is None:
+            return None
+        try:
+            snap = snapshot_mod.capture(cache, index=self._snapshot_index())
+            path = snapshot_mod.write_snapshot(self.snapshot_dir, snap)
+        except Exception:  # pragma: no cover - disk trouble is non-fatal
+            log.exception("snapshot write failed")
+            OPERATOR_METRICS.snapshot_writes.labels(outcome="failed").inc()
+            return None
+        OPERATOR_METRICS.snapshot_writes.labels(outcome="written").inc()
+        OPERATOR_METRICS.snapshot_age_seconds.set(0)
+        return path
+
+    def _snapshot_loop(self):
+        # jittered interval: a fleet of operators must not snapshot in
+        # lockstep (same reasoning as the requeue jitter)
+        import random
+
+        while not self._snapshot_stop.is_set():
+            delay = self.snapshot_interval * random.uniform(0.8, 1.2)
+            if self._snapshot_stop.wait(timeout=delay):
+                return
+            self.write_snapshot_now()
+
     def start(self):
+        self.restore_from_snapshot()
+        if (self.snapshot_dir is not None and self.snapshot_interval > 0
+                and self.find_cache() is not None):
+            self._snapshot_thread = threading.Thread(
+                target=self._snapshot_loop, name="snapshot-writer",
+                daemon=True)
+            self._snapshot_thread.start()
         if self.health_port is not None:
             handler = type("H", (_HealthHandler,), {"manager": self})
             self._http = ThreadingHTTPServer(("0.0.0.0", self.health_port), handler)
@@ -715,6 +841,12 @@ class Manager:
             ctrl.start()
 
     def stop(self):
+        # clean-shutdown snapshot first, while the cache is still live —
+        # the next start's warm restore resumes from *this* state
+        self._snapshot_stop.set()
+        if self._snapshot_thread is not None:
+            self._snapshot_thread.join(timeout=5.0)
+        self.write_snapshot_now()
         # signal the client FIRST: a worker sleeping in the HTTP client's
         # 429 throttle-retry wait is interruptible only by client.close(),
         # and ctrl.stop() below joins that worker — closing after the

@@ -476,9 +476,12 @@ class TracingClient(Client):
             lambda: self.inner.evict(name, namespace),
             target=name)
 
-    def watch(self, api_version, kind, handler):
+    def watch(self, api_version, kind, handler, since_rv=None):
         # long-lived subscription, not a timed verb
-        return self.inner.watch(api_version, kind, handler)
+        if since_rv is None:
+            return self.inner.watch(api_version, kind, handler)
+        return self.inner.watch(api_version, kind, handler,
+                                since_rv=since_rv)
 
     def __getattr__(self, attr):
         # everything that is not a verb (index/index_keys/has_index,

@@ -212,6 +212,14 @@ class FleetIndex:
                 self.apply("DELETED",
                            {"metadata": {"name": name}})
 
+    def export_nodes(self) -> List[dict]:
+        """Snapshot source: the held node objects (frozen cache views,
+        shared zero-copy), sorted by name. ``FleetIndex(export_nodes())``
+        rebuilds an equivalent index offline, and ``resync()`` then
+        folds whatever changed since — the crash-restart warm path."""
+        with self._lock:
+            return [self._nodes[n] for n in sorted(self._nodes)]
+
     def apply(self, event_type: str, node: dict) -> None:
         """Fold one watch delta (ADDED/MODIFIED/DELETED) into the index."""
         with self._lock:
