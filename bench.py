@@ -632,6 +632,41 @@ def _controlplane_doc() -> dict | None:
                 lb["lineage_overhead_ratio"], 4)
         except Exception as e:
             doc["lineage"] = {"error": f"{type(e).__name__}: {e}"}
+        # fleet telemetry plane: digest-ingest overhead at 800 nodes,
+        # digest bytes/node flatness at 10k, and the seeded goodput-SLO
+        # breach demo (its own try for the same reason as rollout's).
+        # telemetry_overhead_ratio at top level is the figure
+        # tests/test_bench_guard.py gates — paired-median fold-on/off,
+        # so machine speed cancels. TPUOP_BENCH_TELEMETRY_NODES scales
+        # it down for smoke runs; TPUOP_BENCH_SKIP_TELEMETRY skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_TELEMETRY"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_telemetry_bench,
+                )
+
+                tn = int(os.environ.get(
+                    "TPUOP_BENCH_TELEMETRY_NODES", "800"))
+                tb = run_telemetry_bench(tn)
+                doc["telemetry"] = {
+                    "n_tpu_nodes": tb["n_tpu_nodes"],
+                    "publishes_per_round": tb["publishes_per_round"],
+                    "ingest_us_per_publish": round(
+                        tb["ingest_us_per_publish"], 1),
+                    "overhead_ratio": round(
+                        tb["telemetry_overhead_ratio"], 4),
+                    "digest_bytes_per_node": round(
+                        tb["digest_bytes_per_node"], 1),
+                    "digest_bytes_vs_baseline": round(
+                        tb["digest_bytes_vs_baseline"], 4),
+                    "rollup_bytes": tb["rollup_bytes"],
+                    "goodput_slo_breached":
+                        tb["goodput_slo"]["breached"],
+                }
+                doc["telemetry_overhead_ratio"] = round(
+                    tb["telemetry_overhead_ratio"], 4)
+            except Exception as e:
+                doc["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
         # crash-safe restart: snapshot-warm vs cold relist, wall time to
         # the first placement decision (its own try for the same reason
         # as rollout's). warm_over_cold / restart_to_first_decision_warm_s

@@ -214,6 +214,30 @@ def test_lineage_overhead_not_regressed():
         f"{latest:.4f} regressed >25% vs best on record ({best:.4f})")
 
 
+def test_telemetry_overhead_not_regressed():
+    """Same contract again, for the fleet-telemetry digest fold on the
+    watch-delta hot path (benchmarks.controlplane.run_telemetry_bench):
+    the latest round's telemetry_overhead_ratio (paired-median
+    fold-on/fold-off over a fleet-wide publish storm, so machine speed
+    cancels out) may be at most 25% above the best on record. Skips
+    until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "telemetry_overhead_ratio")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip(
+            "no committed round records telemetry_overhead_ratio yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} telemetry_overhead_ratio="
+        f"{latest:.4f} regressed >25% vs best on record ({best:.4f})")
+
+
 def test_placement_fleet_p99_not_regressed():
     """Same contract again, for the incremental placement index's
     per-decision p99 at 10k nodes (benchmarks.controlplane.

@@ -1,0 +1,454 @@
+"""Fleet telemetry plane: digest fold, hysteresis scorer, goodput,
+condition publishing, and the chip-degrade chaos scenario.
+
+The load-bearing property is the hysteresis contract: a node is
+condemned only by CONDEMN_AFTER *consecutive* FAIL digest publishes and
+absolved only by ABSOLVE_AFTER consecutive OKs — so a flapping chip
+(FAIL/FAIL/OK forever) never condemns, never gains the condition, and
+never causes an eviction. Everything runs on a deterministic clock.
+"""
+
+import json
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from tpu_operator.api import labels as L
+from tpu_operator.metrics.fleet import (
+    ABSOLVE_AFTER,
+    CONDEMN_AFTER,
+    GOODPUT_DEGRADED_RATIO,
+    FleetTelemetry,
+    rollup_nodes,
+)
+from tpu_operator.metrics.health_engine import (
+    DIGEST_SCHEMA_VERSION,
+    HealthEngine,
+    digest_annotation,
+    parse_digest,
+)
+from tpu_operator.metrics.libtpu_exporter import ChipSample
+from tpu_operator.metrics.operator_metrics import OperatorMetrics
+
+
+def _digest(status="ok", seq=1, **over):
+    d = {"v": DIGEST_SCHEMA_VERSION, "status": status,
+         "grades": {"chip0": "fail" if status == "fail" else "ok",
+                    "chip1": "ok"},
+         "duty_pct": 95.0 if status == "fail" else 40.0,
+         "hbm_free_frac": 0.3, "temp_max_c": 92.0 if status == "fail"
+         else 55.0, "gen": "v5e", "seq": seq}
+    d.update(over)
+    return d
+
+
+def _node(name, digest=None, pool="pool-a", gen="v5e", condition=None):
+    node = {"metadata": {"name": name, "labels": {
+        L.GKE_TPU_ACCELERATOR: f"tpu-{gen}-slice",
+        L.GKE_TPU_TOPOLOGY: "2x4",
+        L.GKE_NODEPOOL: pool,
+        L.GKE_ACCELERATOR_COUNT: "4"},
+        "annotations": {}}}
+    if digest is not None:
+        node["metadata"]["annotations"][L.HEALTH_DIGEST] = \
+            digest_annotation(digest)
+    if condition is not None:
+        node["status"] = {"conditions": [
+            {"type": L.TELEMETRY_CONDITION, "status": condition}]}
+    return node
+
+
+def _fleet():
+    """A FleetTelemetry on its own registry and a settable clock."""
+    clock = [0.0]
+    reg = CollectorRegistry()
+    ft = FleetTelemetry(metrics=OperatorMetrics(registry=reg),
+                        now=lambda: clock[0])
+    return ft, clock, reg
+
+
+class TestDigestWire:
+    def test_round_trips_through_annotation(self):
+        d = _digest("warn", seq=9)
+        assert parse_digest(digest_annotation(d)) == d
+
+    def test_rejects_absent_garbage_and_wrong_version(self):
+        assert parse_digest(None) is None
+        assert parse_digest("") is None
+        assert parse_digest("{not json") is None
+        assert parse_digest(json.dumps([1, 2])) is None
+        assert parse_digest(digest_annotation(
+            _digest(v=DIGEST_SCHEMA_VERSION + 1))) is None
+
+
+class TestHysteresis:
+    def _publish(self, ft, name, status, seq):
+        ft.on_node_delta("MODIFIED", _node(name, _digest(status, seq)))
+
+    def test_condemns_only_after_consecutive_fails(self):
+        ft, _, _ = _fleet()
+        for seq in range(1, CONDEMN_AFTER):
+            self._publish(ft, "n0", "fail", seq)
+            assert not ft.is_condemned("n0")
+        self._publish(ft, "n0", "fail", CONDEMN_AFTER)
+        assert ft.is_condemned("n0")
+
+    def test_flapping_never_condemns(self):
+        """FAIL/FAIL/OK forever: max streak 2 < 3 — the no-flap-evict
+        contract starts here."""
+        ft, _, _ = _fleet()
+        seq = 0
+        for _round in range(20):
+            for status in ("fail", "fail", "ok"):
+                seq += 1
+                self._publish(ft, "n0", status, seq)
+                assert not ft.is_condemned("n0")
+
+    def test_absolve_needs_consecutive_oks(self):
+        ft, _, _ = _fleet()
+        seq = 0
+        for _ in range(CONDEMN_AFTER):
+            seq += 1
+            self._publish(ft, "n0", "fail", seq)
+        assert ft.is_condemned("n0")
+        for i in range(1, ABSOLVE_AFTER):
+            seq += 1
+            self._publish(ft, "n0", "ok", seq)
+            assert ft.is_condemned("n0"), \
+                f"absolved after only {i} OK digests"
+        seq += 1
+        self._publish(ft, "n0", "ok", seq)
+        assert not ft.is_condemned("n0")
+
+    def test_warn_resets_both_streaks(self):
+        ft, _, _ = _fleet()
+        self._publish(ft, "n0", "fail", 1)
+        self._publish(ft, "n0", "fail", 2)
+        self._publish(ft, "n0", "warn", 3)   # streak gone
+        self._publish(ft, "n0", "fail", 4)
+        self._publish(ft, "n0", "fail", 5)
+        assert not ft.is_condemned("n0")
+        assert ft.fail_streak("n0") == 2
+
+    def test_watch_echo_does_not_double_count(self):
+        """Streaks advance per digest seq, not per watch delivery: a
+        lease echo re-delivers the same annotation."""
+        ft, _, _ = _fleet()
+        node = _node("n0", _digest("fail", seq=1))
+        for _ in range(CONDEMN_AFTER + 2):
+            ft.on_node_delta("MODIFIED", node)
+        assert ft.fail_streak("n0") == 1
+        assert not ft.is_condemned("n0")
+
+    def test_node_deletion_forgets_everything(self):
+        ft, _, _ = _fleet()
+        for seq in range(1, CONDEMN_AFTER + 1):
+            self._publish(ft, "n0", "fail", seq)
+        assert ft.is_condemned("n0")
+        ft.on_node_delta("DELETED", _node("n0"))
+        assert not ft.is_condemned("n0")
+        assert ft.fail_streak("n0") == 0
+
+    def test_digest_disappearing_keeps_scorer_state(self):
+        """A publish gap (engine restart) is silence, not absolution:
+        the condemned verdict stands until OK digests re-earn it."""
+        ft, _, _ = _fleet()
+        for seq in range(1, CONDEMN_AFTER + 1):
+            self._publish(ft, "n0", "fail", seq)
+        ft.on_node_delta("MODIFIED", _node("n0"))  # annotation gone
+        assert ft.is_condemned("n0")
+        snap = ft.snapshot()
+        assert snap["totals"]["silent"] == 1
+        assert snap["totals"]["condemned"] == 1
+
+
+class TestRollup:
+    def test_aggregates_per_domain_and_picks_worst(self):
+        nodes = [
+            _node("a0", _digest("ok", 1), pool="p0"),
+            _node("a1", None, pool="p0"),                      # silent
+            _node("b0", _digest("fail", 1, temp_max_c=104.0),
+                  pool="p1", condition="False"),
+        ]
+        roll = rollup_nodes(nodes)
+        assert roll["totals"] == {
+            "nodes": 3, "reporting": 2, "silent": 1, "condemned": 1,
+            "chips": 12, "degraded_chips": 1}
+        assert set(roll["domains"]) == {"p0", "p1"}
+        assert roll["worst_domain"] == "p1"
+        assert roll["domains"]["p1"]["temp_max_c"] == 104.0
+        assert roll["domains"]["p0"]["reporting"] == 1
+
+    def test_condemned_override_beats_condition_read(self):
+        nodes = [_node("a0", _digest("ok", 1), condition="False")]
+        assert rollup_nodes(nodes)["totals"]["condemned"] == 1
+        assert rollup_nodes(
+            nodes, condemned=set())["totals"]["condemned"] == 0
+
+    def test_non_tpu_nodes_ignored(self):
+        plain = {"metadata": {"name": "cpu-0", "labels": {}}}
+        assert rollup_nodes([plain])["totals"]["nodes"] == 0
+
+
+class TestGoodput:
+    def _cr(self, step, name="ereq-1", pool="v5p-2x2x1-0"):
+        return {"metadata": {"name": name, "namespace": "tpu-operator"},
+                "status": {"progress": {"checkpointedStep": step},
+                           "pool": pool}}
+
+    def test_full_speed_slice_rates_good(self):
+        ft, clock, reg = _fleet()
+        ft.on_request_delta("ADDED", self._cr(0))
+        clock[0] = 100.0
+        ft.on_request_delta("MODIFIED", self._cr(15))  # 0.15/s = ideal
+        assert reg.get_sample_value(
+            "tpu_operator_slice_goodput_steps_total",
+            {"quality": "good"}) == 15
+        key = "tpu-operator/ereq-1"
+        assert reg.get_sample_value(
+            "tpu_operator_fleet_slice_goodput_ratio",
+            {"request": key}) == pytest.approx(1.0)
+
+    def test_degraded_below_half_ideal(self):
+        ft, clock, reg = _fleet()
+        ft.on_request_delta("ADDED", self._cr(0))
+        clock[0] = 100.0
+        ft.on_request_delta("MODIFIED", self._cr(5))  # 0.05/s = 0.33x
+        assert reg.get_sample_value(
+            "tpu_operator_slice_goodput_steps_total",
+            {"quality": "degraded"}) == 5
+        ratio = reg.get_sample_value(
+            "tpu_operator_fleet_slice_goodput_ratio",
+            {"request": "tpu-operator/ereq-1"})
+        assert ratio < GOODPUT_DEGRADED_RATIO
+
+    def test_stalled_counter_counts_nothing(self):
+        ft, clock, reg = _fleet()
+        ft.on_request_delta("ADDED", self._cr(10))
+        clock[0] = 100.0
+        ft.on_request_delta("MODIFIED", self._cr(10))
+        for q in ("good", "degraded"):
+            assert not reg.get_sample_value(
+                "tpu_operator_slice_goodput_steps_total", {"quality": q})
+
+    def test_snapshot_ranks_worst_slices(self):
+        ft, clock, _ = _fleet()
+        ft.on_request_delta("ADDED", self._cr(0, name="fast"))
+        ft.on_request_delta("ADDED", self._cr(0, name="slow"))
+        clock[0] = 100.0
+        ft.on_request_delta("MODIFIED", self._cr(15, name="fast"))
+        ft.on_request_delta("MODIFIED", self._cr(3, name="slow"))
+        snap = ft.snapshot()
+        assert snap["worst_slices"][0] == "tpu-operator/slow"
+        assert snap["slices"]["tpu-operator/fast"]["acked_steps"] == 15
+
+
+class TestTelemetryCondition:
+    """The reconciler publishes the scorer's verdict as the
+    TPUTelemetryHealthy condition — and writes nothing in steady
+    state."""
+
+    def _setup(self):
+        from tpu_operator.controllers.telemetry_controller import (
+            TelemetryReconciler,
+        )
+        from tpu_operator.runtime import FakeClient, Request
+
+        client = FakeClient()
+        client.add_node("n0", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+            L.GKE_TPU_TOPOLOGY: "2x4"},
+            allocatable={"google.com/tpu": "4"})
+        ft, clock, _ = _fleet()
+        rec = TelemetryReconciler(client=client, telemetry=ft)
+        return client, ft, rec, Request(name="n0")
+
+    def _condition(self, client):
+        node = client.get("v1", "Node", "n0")
+        for c in (node.get("status") or {}).get("conditions") or []:
+            if c.get("type") == L.TELEMETRY_CONDITION:
+                return c
+        return None
+
+    def test_condemn_then_absolve_round_trip(self):
+        client, ft, rec, req = self._setup()
+        for seq in range(1, CONDEMN_AFTER + 1):
+            ft.on_node_delta("MODIFIED", _node("n0", _digest("fail", seq)))
+        rec.reconcile(req)
+        cond = self._condition(client)
+        assert cond["status"] == "False"
+        assert cond["reason"] == "TelemetryCondemned"
+        for seq in range(10, 10 + ABSOLVE_AFTER):
+            ft.on_node_delta("MODIFIED", _node("n0", _digest("ok", seq)))
+        rec.reconcile(req)
+        cond = self._condition(client)
+        assert cond["status"] == "True"
+        assert cond["reason"] == "TelemetryHealthy"
+
+    def test_steady_state_writes_nothing(self):
+        client, ft, rec, req = self._setup()
+        # healthy node that never condemned: no condition, no write
+        rec.reconcile(req)
+        assert self._condition(client) is None
+        client.reset_verb_counts()
+        rec.reconcile(req)
+        counts = client.reset_verb_counts()
+        assert not any(counts.get(v) for v in
+                       ("update", "update_status", "patch")), counts
+        # condemned and already stamped: still no write
+        for seq in range(1, CONDEMN_AFTER + 1):
+            ft.on_node_delta("MODIFIED", _node("n0", _digest("fail", seq)))
+        rec.reconcile(req)
+        client.reset_verb_counts()
+        rec.reconcile(req)
+        counts = client.reset_verb_counts()
+        assert not any(counts.get(v) for v in
+                       ("update", "update_status", "patch")), counts
+
+
+class TestEngineDigest:
+    def _prime(self, monkeypatch, samples):
+        import tpu_operator.metrics.health_engine as he
+
+        monkeypatch.setattr(he, "collect_local", lambda: samples)
+
+    def test_chip_disappearance_is_a_fail_digest(self, monkeypatch):
+        """A chip falling off the bus after first enumeration must
+        surface as status=fail even though every surviving chip grades
+        ok — the failure no per-chip rule can see."""
+        engine = HealthEngine()
+        four = [ChipSample(f"chip{i}", duty_cycle_pct=50.0,
+                           hbm_used=1, hbm_total=16,
+                           temperature_c=50.0) for i in range(4)]
+        self._prime(monkeypatch, four)
+        engine.collect_once()
+        assert engine.digest("v5e", 1)["status"] == "ok"
+        self._prime(monkeypatch, four[:3])
+        engine.collect_once()
+        d = engine.digest("v5e", 2)
+        assert d["status"] == "fail"
+        assert len(d["grades"]) == 3
+        assert all(g == "ok" for g in d["grades"].values())
+
+    def test_unknown_hbm_usage_reports_full_headroom(self, monkeypatch):
+        """hbm_usage_known=False chips are excluded from the headroom
+        minimum instead of reading as a confident 0.0-used."""
+        engine = HealthEngine()
+        self._prime(monkeypatch, [
+            ChipSample("chip0", hbm_used=0, hbm_total=16,
+                       temperature_c=50.0, hbm_usage_known=False)])
+        engine.collect_once()
+        assert engine.digest("v5e", 1)["hbm_free_frac"] == 1.0
+
+
+class TestChipDegradeScenario:
+    """The chaos acceptance bar: the genuinely degraded node condemns
+    and its slice migrates off exactly once; the flapping decoy causes
+    zero evictions; the whole verdict is byte-identical per seed."""
+
+    @pytest.fixture(scope="class")
+    def verdicts(self):
+        from tpu_operator.chaos.runner import run_scenario
+
+        return [run_scenario("chip-degrade", nodes=32, seed=7)
+                for _ in range(2)]
+
+    def test_byte_identical_per_seed(self, verdicts):
+        a, b = [json.dumps(v, indent=2, sort_keys=True)
+                for v in verdicts]
+        assert a == b
+        assert verdicts[0]["ok"] is True
+
+    def test_ramped_node_condemns_and_evicts_once(self, verdicts):
+        v = verdicts[0]
+        tel = v["telemetry"]
+        ramp = tel["targets"]["@placed:0"]
+        assert tel["condemned"] == [ramp]
+        evs = tel["telemetry_evictions"]
+        assert len(evs) == 1 and evs[0]["evictions"] == 1
+        assert evs[0]["reason"] == \
+            f"node {ramp} condemned by telemetry"
+        # and the rollup saw it: the ramp node's domain is worst
+        dom = tel["rollup"]["worst_domain"]
+        assert tel["rollup"]["domains"][dom]["condemned"] == 1
+
+    def test_flapping_node_causes_no_eviction(self, verdicts):
+        tel = verdicts[0]["telemetry"]
+        flap = tel["targets"]["@placed:1"]
+        assert flap != tel["targets"]["@placed:0"]
+        assert flap not in tel["condemned"]
+        assert all(flap not in e["reason"]
+                   for e in tel["telemetry_evictions"])
+        # the decoy genuinely flapped: it published as often as the ramp
+        assert tel["digest_publishes"][flap] > 1
+
+    def test_goodput_and_slo_ride_the_verdict(self, verdicts):
+        v = verdicts[0]
+        assert v["goodput"]["rows"], "no per-slice goodput series"
+        for row in v["goodput"]["rows"]:
+            assert row["quality"] in ("good", "degraded")
+        assert "slice-goodput" in v["slo"]["slos"]
+
+
+class TestCLISurfaces:
+    def test_top_renders_from_must_gather(self, tmp_path, capsys):
+        from tpu_operator.cli.must_gather import gather
+        from tpu_operator.cli.tpuop_cfg import main as cfg_main
+        from tpu_operator.runtime import FakeClient
+
+        client = FakeClient()
+        client.add_node("tpu-0", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+            L.GKE_TPU_TOPOLOGY: "2x4"},
+            allocatable={"google.com/tpu": "4"})
+        node = json.loads(json.dumps(client.get("v1", "Node", "tpu-0")))
+        node["metadata"].setdefault("annotations", {})[
+            L.HEALTH_DIGEST] = digest_annotation(_digest("fail", 3))
+        client.update(node)
+
+        out = tmp_path / "bundle"
+        summary = gather(client, out)
+        assert summary.get("fleet_digests") == 1
+        assert (out / "fleet" / "digests" / "tpu-0.json").is_file()
+        roll = json.loads((out / "fleet" / "fleet.json").read_text())
+        assert roll["totals"]["degraded_chips"] == 1
+
+        assert cfg_main(["top", "-f", str(out)]) == 0
+        text = capsys.readouterr().out
+        assert "1 degraded" in text
+        assert cfg_main(["top", "-f", str(out), "-o", "json"]) == 0
+        assert json.loads(capsys.readouterr().out) == roll
+
+    def test_top_exit_2_when_condemned(self, tmp_path, capsys):
+        from tpu_operator.cli.tpuop_cfg import main as cfg_main
+
+        snap = rollup_nodes([_node("n0", _digest("fail", 1),
+                                   condition="False")])
+        f = tmp_path / "fleet.json"
+        f.write_text(json.dumps(snap))
+        assert cfg_main(["top", "-f", str(f)]) == 2
+        assert "1 condemned" in capsys.readouterr().out
+
+    def test_status_report_carries_fleet_line(self, capsys):
+        from tpu_operator.cli.tpuop_cfg import (
+            _print_status_text,
+            _status_report,
+        )
+        from tpu_operator.runtime import FakeClient
+
+        client = FakeClient()
+        client.add_node("tpu-0", labels={
+            L.GKE_TPU_ACCELERATOR: "tpu-v5e-slice",
+            L.GKE_TPU_TOPOLOGY: "2x4",
+            L.TPU_PRESENT: "true"},
+            allocatable={"google.com/tpu": "4"})
+        node = json.loads(json.dumps(client.get("v1", "Node", "tpu-0")))
+        node["metadata"].setdefault("annotations", {})[
+            L.HEALTH_DIGEST] = digest_annotation(_digest("fail", 3))
+        client.update(node)
+        report = _status_report(client, "tpu-operator")
+        assert report["fleet"]["degradedChips"] == 1
+        assert report["fleet"]["chips"] == 4
+        _print_status_text(report)
+        assert "fleet health: 1/4 chips degraded" \
+            in capsys.readouterr().out

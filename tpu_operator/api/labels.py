@@ -89,6 +89,21 @@ SLICE_INTENT_ACK = f"{DOMAIN}/slice-intent-ack"
 # the intent protocol; the operator skips straight to the hard-drain path
 # without burning the migration timeout waiting for an ack.
 SLICE_ELASTIC = f"{DOMAIN}/elastic"
+# --- fleet telemetry plane -------------------------------------------------
+# compact, schema-stamped node health digest published by the on-node
+# health engine (metrics/health_engine.py) on a jittered interval; the
+# operator folds it O(delta) through the informer cache's delta listener
+# (metrics/fleet.py), never a poll. Value is JSON: {"v": 1, "status",
+# "grades": {chip_id: ok|warn|fail}, "duty_pct", "hbm_free_frac",
+# "temp_max_c", "gen", "seq"}.
+HEALTH_DIGEST = f"{DOMAIN}/health-digest"
+# Node condition type raised by the telemetry scorer once a node's digest
+# FAILs for CONDEMN_AFTER consecutive publishes (metrics/fleet.py
+# hysteresis): status "False" means condemned — the placement engine
+# stops offering the node and Placed bindings on it drain. A single FAIL
+# (or a flap that never sustains) never flips the condition, which is
+# what the telemetry-no-flap-evict chaos invariant checks.
+TELEMETRY_CONDITION = "TPUTelemetryHealthy"
 
 # --- Pod Security Admission (namespace labels) ----------------------------
 # stamped on the operand namespace so privileged operand pods admit under

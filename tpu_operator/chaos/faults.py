@@ -68,6 +68,16 @@ OPERATOR_CRASH = "operator-crash"  # the process dies mid-pass; the runner
 BROWNOUT_START = "brownout-start"  # apiserver brownout: lists fail and
 #                                    watch streams die until the matching
 BROWNOUT_END = "brownout-end"      # heal — controllers must serve stale
+DIGEST_SEED = "digest-seed"        # every TPU node publishes an OK digest
+DIGEST_DEGRADE = "digest-degrade"  # one FAIL digest publish on a node
+#                                    (seeded per-chip temp ramp); arg may
+#                                    be "@placed:N" — resolved at apply
+#                                    time to the N-th node carrying a
+#                                    placement lease (deterministic, and
+#                                    guarantees the ramp hits a bound
+#                                    slice); the resolution is pinned so
+#                                    the whole ramp stays on one node
+DIGEST_HEAL = "digest-heal"        # one OK digest publish on a node
 
 
 @dataclass(frozen=True)
@@ -141,6 +151,7 @@ class FaultPlan:
             "shard-failover": cls._shard_failover,
             "operator-crash": cls._operator_crash,
             "apiserver-brownout": cls._apiserver_brownout,
+            "chip-degrade": cls._chip_degrade,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -474,6 +485,52 @@ class FaultPlan:
             out.append(Fault(crash2, OPERATOR_CRASH))
         for step in range(rollout_step + 1, steps):
             if step % 3 == 2:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 5 == 4:
+                out.append(Fault(step, WATCH_DROP))
+        return out
+
+    @classmethod
+    def _chip_degrade(cls, rng, nodes, steps) -> List[Fault]:
+        """Fleet telemetry under fire: elastic slices land and train,
+        every node starts publishing OK digests, then two telemetry
+        stories run concurrently on the virtual clock. A *ramp* node
+        (resolved at apply time to a node actually hosting a placed
+        slice) publishes FAIL digests every step — after CONDEMN_AFTER
+        consecutive publishes the scorer condemns it, the condition
+        lands, and its slice must evict and re-place with no acked work
+        lost. A *flap* node (a different placed node) alternates
+        FAIL/FAIL/OK forever — its streak never sustains, so it must
+        cause ZERO evictions (telemetry-no-flap-evict). Background 409s
+        and watch drops make sure the digest fold rides the same
+        delta/relist machinery as everything else."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8)
+        n_elastic = 0
+        for step in range(min(3, steps)):
+            for _ in range(rng.randrange(2, 4)):
+                n_elastic += 1
+                out.append(Fault(step, SLICE_REQUEST,
+                                 arg=f"ereq-{n_elastic:03d}",
+                                 count=rng.choice(sizes),
+                                 seconds=float(rng.randrange(0, 3))))
+        # everyone reports healthy before anyone degrades: the rollup
+        # sees a full fleet, and the scorer's streaks start from OK
+        out.append(Fault(min(3, steps - 1), DIGEST_SEED))
+        ramp_start = min(4, steps - 1)
+        for step in range(ramp_start, steps):
+            # sustained temp ramp: FAIL every publish, never healing
+            out.append(Fault(step, DIGEST_DEGRADE, arg="@placed:0",
+                             seconds=float(90 + 2 * (step - ramp_start))))
+            # flapping chip: two FAILs then an OK, forever — one short
+            # of the condemn threshold on every cycle
+            if (step - ramp_start) % 3 < 2:
+                out.append(Fault(step, DIGEST_DEGRADE, arg="@placed:1",
+                                 seconds=float(91)))
+            else:
+                out.append(Fault(step, DIGEST_HEAL, arg="@placed:1"))
+            if step % 4 == 1:
                 out.append(Fault(step, API_CONFLICT,
                                  count=rng.randrange(2, 5)))
             if step % 5 == 4:

@@ -67,6 +67,15 @@ point*, not just at convergence:
   panel of probe request shapes at every settle point. A divergence
   means the O(delta) maintenance lost or invented structure the full
   rebuild sees.
+- ``telemetry-no-flap-evict``: a telemetry-driven eviction
+  (``status.lastEvictionReason`` naming a node "condemned by
+  telemetry") is legal only for a node whose own digest stream — folded
+  independently here, seq by seq, through the same hysteresis rule the
+  scorer uses — actually sustained ``CONDEMN_AFTER`` consecutive FAIL
+  publishes, and at most once per (request, node) pair. A flapping chip
+  must cause zero evictions and a condemned one must never ping-pong
+  the same slice off the same node twice. Checked in every scenario — a
+  run that publishes no digests is a clean no-op.
 - ``lane-priority`` (recorded by the runner): no health-lane event may
   be dequeued having waited behind more than the runner's
   ``LANE_PRIORITY_BUDGET`` bulk reconciles — the workload-aware
@@ -132,6 +141,14 @@ class InvariantChecker:
         # long-lived FleetIndex fed by node-list diffs across the whole
         # run (index-coherence); built lazily on the first observation
         self._fleet_index = None
+        # telemetry-no-flap-evict: an independent digest fold (last seq,
+        # fail streak, ever-legitimately-condemned set) plus the
+        # telemetry-eviction ledger per (request key, node)
+        self._tel_seq: Dict[str, object] = {}
+        self._tel_fail: Dict[str, int] = {}
+        self._tel_ever: set = set()
+        self._tel_evicted: Dict[Tuple[str, str], int] = {}
+        self._tel_evictions: Dict[str, int] = {}
 
     def on_operator_restart(self, step: int, cache=None,
                             journal=None) -> None:
@@ -166,7 +183,75 @@ class InvariantChecker:
         self._check_dag(step)
         self._check_placement(step, nodes, settled=False)
         self._check_work(step)
+        self._check_telemetry(step, nodes)
         self._feed_index(nodes)
+
+    # -- telemetry eviction legality -----------------------------------------
+
+    def _check_telemetry(self, step: int, nodes: Dict[str, dict]) -> None:
+        """telemetry-no-flap-evict (see module docstring). The fold here
+        is the checker's OWN: same hysteresis rule as the production
+        scorer (consecutive FAIL publishes by digest seq, any other
+        status resets the streak) but fed straight from the node
+        annotations, so a scorer that miscounts is caught rather than
+        trusted. Runs at most one publish behind — the runner applies at
+        most one digest per node per step, and this observes every step."""
+        from ..api.slicerequest import KIND_SLICE_REQUEST, V1ALPHA1
+        from ..metrics.fleet import CONDEMN_AFTER
+        from ..metrics.health_engine import parse_digest
+
+        for name in sorted(nodes):
+            digest = parse_digest((get_nested(
+                nodes[name], "metadata", "annotations", default={})
+                or {}).get(L.HEALTH_DIGEST))
+            if digest is None or digest.get("seq") == self._tel_seq.get(
+                    name):
+                continue
+            self._tel_seq[name] = digest.get("seq")
+            if str(digest.get("status", "")) == "fail":
+                self._tel_fail[name] = self._tel_fail.get(name, 0) + 1
+                if self._tel_fail[name] >= CONDEMN_AFTER:
+                    self._tel_ever.add(name)
+            else:
+                self._tel_fail.pop(name, None)
+        requests = self.client.list(V1ALPHA1, KIND_SLICE_REQUEST)
+        if not requests and not self._tel_evictions:
+            return
+        live = set()
+        for req in sorted(requests, key=name_of):
+            key = f"{namespace_key(req) or 'default'}/{name_of(req)}"
+            live.add(key)
+            evictions = int(get_nested(req, "status", "evictions",
+                                       default=0) or 0)
+            prev = self._tel_evictions.get(key, 0)
+            self._tel_evictions[key] = evictions
+            if evictions <= prev:
+                continue
+            reason = str(get_nested(req, "status", "lastEvictionReason",
+                                    default="") or "")
+            if not (reason.startswith("node ")
+                    and reason.endswith(" condemned by telemetry")):
+                continue
+            node_name = reason[len("node "):-len(
+                " condemned by telemetry")]
+            if node_name not in self._tel_ever:
+                self.record(
+                    "telemetry-no-flap-evict", step,
+                    f"{key}: evicted off {node_name}, whose digest "
+                    f"stream never sustained {CONDEMN_AFTER} consecutive "
+                    f"FAIL publishes (streak now "
+                    f"{self._tel_fail.get(node_name, 0)}) — a flapping "
+                    f"chip caused an eviction")
+            pair = (key, node_name)
+            self._tel_evicted[pair] = self._tel_evicted.get(pair, 0) + 1
+            if self._tel_evicted[pair] > 1:
+                self.record(
+                    "telemetry-no-flap-evict", step,
+                    f"{key}: evicted off {node_name} by telemetry "
+                    f"{self._tel_evicted[pair]} times — condemn/absolve "
+                    f"ping-pong")
+        for key in [k for k in self._tel_evictions if k not in live]:
+            del self._tel_evictions[key]
 
     # -- incremental-index coherence ----------------------------------------
 
@@ -596,6 +681,7 @@ class InvariantChecker:
         nodes = {name_of(n): n for n in self.client.list("v1", "Node")}
         self._check_placement(step, nodes, settled=True)
         self._check_work(step)
+        self._check_telemetry(step, nodes)
         self._check_index(step, nodes)
 
 

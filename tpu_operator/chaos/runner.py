@@ -38,6 +38,8 @@ from ..api.slicerequest import (
 from ..benchmarks.controlplane import build_cluster
 from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
 from ..controllers.placement_controller import PlacementReconciler
+from ..controllers.telemetry_controller import TelemetryReconciler
+from ..metrics.fleet import FleetTelemetry
 from ..controllers.upgrade_controller import (
     STATE_DONE,
     UpgradeReconciler,
@@ -83,6 +85,9 @@ from .faults import (
     BROWNOUT_START,
     CHIP_LOSS,
     CHIP_RESTORE,
+    DIGEST_DEGRADE,
+    DIGEST_HEAL,
+    DIGEST_SEED,
     MUTATE_POLICY,
     NODE_ADD,
     NODE_FLAP,
@@ -112,14 +117,14 @@ SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
              "dag-race", "placement-contention", "placement-storm",
              "slice-migrate", "shard-failover", "operator-crash",
-             "apiserver-brownout")
+             "apiserver-brownout", "chip-degrade")
 
 # scenarios that run the placement controller (they create SliceRequests)
 PLACEMENT_SCENARIOS = ("placement-contention", "placement-storm",
-                       "slice-migrate", "operator-crash")
+                       "slice-migrate", "operator-crash", "chip-degrade")
 # scenarios whose elastic requests get workload shims (the training
 # jobs' half of the slice-intent protocol)
-SHIM_SCENARIOS = ("slice-migrate", "operator-crash")
+SHIM_SCENARIOS = ("slice-migrate", "operator-crash", "chip-degrade")
 
 # virtual deadlines for the slice-migrate scenario, sized in runner steps
 # (STEP_DT each): long enough for the elastic handshake (~3 passes),
@@ -412,6 +417,81 @@ def _set_node_ready(fake: FakeClient, name: str, ready: bool) -> bool:
     return True
 
 
+def _digest_target(arg: str, fake: FakeClient,
+                   state: dict) -> Optional[str]:
+    """Resolve a digest fault's target node. A literal node name passes
+    through; the ``@placed:N`` sentinel resolves to the N-th (sorted)
+    TPU node carrying a placement lease at FIRST resolution and is then
+    pinned in ``state`` — the whole FAIL ramp stays aimed at one node
+    even after the eviction it provokes moves the lease elsewhere.
+    Distinct sentinels pin distinct nodes, so the flap target can never
+    accidentally heal the ramp target's streak."""
+    if not arg.startswith("@placed:"):
+        return arg
+    targets = state.setdefault("digest_targets", {})
+    if arg in targets:
+        return targets[arg]
+    leased = sorted(
+        name_of(n) for n in fake.list("v1", "Node")
+        if labels_of(n).get(L.GKE_TPU_ACCELERATOR)
+        and annotations_of(n).get(L.PLACED_BY))
+    if not leased:
+        # nothing bound (all requests unschedulable this seed): fall
+        # back to any TPU node so the scorer is still exercised
+        leased = sorted(
+            name_of(n) for n in fake.list("v1", "Node")
+            if labels_of(n).get(L.GKE_TPU_ACCELERATOR))
+    pool = [n for n in leased if n not in set(targets.values())] or leased
+    if not pool:
+        return None
+    name = pool[int(arg.split(":", 1)[1]) % len(pool)]
+    targets[arg] = name
+    return name
+
+
+def _publish_digest(fake: FakeClient, node_name: str, state: dict,
+                    status: str, temp_c: float) -> bool:
+    """One digest publish onto a node's annotation — the chaos analog of
+    the on-node engine's jittered publish loop. ``seq`` counts publishes
+    per node, so the scorer's per-seq dedupe sees each write as exactly
+    one new sample no matter how many watch echoes deliver it."""
+    from ..metrics.health_engine import (
+        DIGEST_SCHEMA_VERSION,
+        digest_annotation,
+    )
+
+    node = fake.get_or_none("v1", "Node", node_name)
+    if node is None:
+        return False
+    seqs = state.setdefault("digest_seq", {})
+    seqs[node_name] = seqs.get(node_name, 0) + 1
+    nl = labels_of(node)
+    gen = L.accelerator_generation(nl.get(L.GKE_TPU_ACCELERATOR, "")) or ""
+    try:
+        chips = int(nl.get(L.GKE_ACCELERATOR_COUNT) or "4")
+    except ValueError:
+        chips = 4
+    # a FAIL digest is one overheating chip, not a dead board — exactly
+    # the single-chip degradation the hysteresis scorer arbitrates
+    grades = {f"chip{i}": "ok" for i in range(chips)}
+    if status == "fail" and grades:
+        grades["chip0"] = "fail"
+    digest = {"v": DIGEST_SCHEMA_VERSION, "status": status,
+              "grades": grades,
+              "duty_pct": 95.0 if status == "ok" else 35.0,
+              "hbm_free_frac": 0.4 if status == "ok" else 0.05,
+              "temp_max_c": float(temp_c), "gen": gen,
+              "seq": seqs[node_name]}
+    node = thaw_obj(node)
+    node.setdefault("metadata", {}).setdefault("annotations", {})[
+        L.HEALTH_DIGEST] = digest_annotation(digest)
+    try:
+        fake.update(node)
+    except ConflictError:
+        return False
+    return True
+
+
 def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                  state: dict) -> None:
     kind = fault.kind
@@ -529,6 +609,11 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                 spec=SliceRequestSpec(chips=fault.count,
                                       priority=int(fault.seconds)).to_obj(),
                 namespace=NAMESPACE))
+            if chaos.clock is not None:
+                # birth time on the virtual clock: the denominator of
+                # the verdict's deterministic per-slice goodput rate
+                state.setdefault("req_created", {})[fault.arg] = \
+                    chaos.clock.t
             applied = True
     elif kind == SLICE_RESIZE:
         # the user edits spec.chips on a live request (kubectl apply of a
@@ -586,6 +671,23 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                     applied = True
                 except ConflictError:
                     pass
+    elif kind == DIGEST_SEED:
+        # t=0 of the telemetry plane: every TPU node starts publishing
+        # healthy digests, so silence is never mistaken for health
+        for nm in sorted(
+                name_of(n) for n in fake.list("v1", "Node")
+                if labels_of(n).get(L.GKE_TPU_ACCELERATOR)):
+            applied = _publish_digest(fake, nm, state, "ok", 55.0) \
+                or applied
+    elif kind in (DIGEST_DEGRADE, DIGEST_HEAL):
+        target = _digest_target(fault.arg, fake, state)
+        if target is not None:
+            if kind == DIGEST_DEGRADE:
+                # the builder rides the chip temperature in ``seconds``
+                applied = _publish_digest(fake, target, state, "fail",
+                                          fault.seconds or 90.0)
+            else:
+                applied = _publish_digest(fake, target, state, "ok", 55.0)
     if applied:
         chaos.record(kind)
 
@@ -749,6 +851,75 @@ def _migration_summary(fake: FakeClient) -> dict:
     }
 
 
+def _telemetry_summary(fake: FakeClient, telemetry, state: dict) -> dict:
+    """Deterministic telemetry outcome block for the verdict: the fleet
+    rollup over the settled store, the scorer's condemned set and
+    streaks, the digest publish ledger, and every eviction the telemetry
+    path caused — the evidence the no-flap-evict invariant audited."""
+    from ..metrics.fleet import rollup_nodes
+
+    tel_evictions = []
+    for req in sorted(fake.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                      key=name_of):
+        reason = get_nested(req, "status", "lastEvictionReason") or ""
+        if "condemned by telemetry" in reason:
+            tel_evictions.append({
+                "request": name_of(req), "reason": reason,
+                "evictions": int(get_nested(req, "status", "evictions",
+                                            default=0) or 0)})
+    return {
+        "rollup": rollup_nodes(fake.list("v1", "Node")),
+        "condemned": telemetry.condemned() if telemetry is not None else [],
+        "targets": dict(sorted(
+            (state.get("digest_targets") or {}).items())),
+        "digest_publishes": dict(sorted(
+            (state.get("digest_seq") or {}).items())),
+        "telemetry_evictions": tel_evictions,
+    }
+
+
+def _goodput_summary(fake: FakeClient, now_s: float, state: dict) -> dict:
+    """Deterministic slice-goodput block for the verdict: each request's
+    durably-checkpointed steps rated against the generation-ideal rate
+    over its own virtual lifetime — pure store + virtual-clock reads,
+    byte-identical per seed. Feeds the ``slice-goodput`` SLO row: a
+    slice that lost its node to a condemned chip spends virtual time
+    evicted, and those slow steps burn the budget by design."""
+    from ..metrics.fleet import GOODPUT_DEGRADED_RATIO, ideal_steps_per_s
+
+    created = state.get("req_created") or {}
+    rows = []
+    good = bad = 0
+    for req in sorted(fake.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                      key=name_of):
+        nm = name_of(req)
+        acked = get_nested(req, "status", "progress", "checkpointedStep",
+                           default=None)
+        if acked is None:
+            acked = get_nested(req, "status", "migration", "ackedStep",
+                               default=None)
+        if acked is None:
+            continue
+        acked = int(acked)
+        born = created.get(nm)
+        elapsed = (now_s - born) if born is not None else 0.0
+        pool = str(get_nested(req, "status", "pool", default="") or "")
+        gen = pool.split("-")[0] if pool else ""
+        ratio = ((acked / elapsed) / ideal_steps_per_s(gen)) \
+            if elapsed > 0 else 0.0
+        quality = "good" if ratio >= GOODPUT_DEGRADED_RATIO \
+            else "degraded"
+        if quality == "good":
+            good += acked
+        else:
+            bad += acked
+        rows.append({"name": nm, "acked_steps": acked,
+                     "virtual_s": round(elapsed, 1), "generation": gen,
+                     "goodput_ratio": round(ratio, 4),
+                     "quality": quality})
+    return {"rows": rows, "steps_good": good, "steps_degraded": bad}
+
+
 # the convergence SLO's virtual budget: converging inside this many
 # virtual seconds past the last fault is "good". Generous next to the
 # soak budget (150 passes * 20s) so only a genuinely struggling run
@@ -792,6 +963,14 @@ def _slo_verdict(scenario: str, out: dict,
         slos["migration-success"] = burn_verdict(
             good=mig["phases"].get(MIG_RESUMED, 0),
             bad=mig["phases"].get(MIG_ABORTED, 0),
+            objective=0.90, threshold=CHAOS_BURN_THRESHOLD)
+    gp = out.get("goodput")
+    if gp is not None:
+        # the same objective the production slice-goodput SLOSpec
+        # carries (metrics/slo.py), fed the verdict's deterministic
+        # step classification instead of the live counters
+        slos["slice-goodput"] = burn_verdict(
+            good=gp["steps_good"], bad=gp["steps_degraded"],
             objective=0.90, threshold=CHAOS_BURN_THRESHOLD)
     return {
         "objective_threshold": CHAOS_BURN_THRESHOLD,
@@ -958,6 +1137,23 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                                      name="placement")
         lrec.setup_controller(place_ctrl, None)
         ctrls.append(place_ctrl)
+    # the fleet-telemetry plane joins the chip-degrade scenario: a fresh
+    # scorer on the virtual clock folds digests O(delta) off the cache's
+    # delta hook (per-tick resync when uncached), and the telemetry
+    # reconciler publishes its verdict as the node condition the
+    # placement engine then drains on — the full ingest -> score ->
+    # condemn -> evict loop under fire
+    telemetry = None
+    tel_ctrl = None
+    if scenario == "chip-degrade":
+        telemetry = FleetTelemetry(now=clock)
+        if cached:
+            telemetry.attach(client)
+        trec = TelemetryReconciler(client=traced, telemetry=telemetry)
+        tel_ctrl = _SyncController(trec, traced, clock, shards=shards,
+                                   name="telemetry")
+        trec.setup_controller(tel_ctrl, None)
+        ctrls.append(tel_ctrl)
     # elastic workload shims (the training jobs' half of the slice-intent
     # protocol) join only the migrate scenario; requests named ``rreq-*``
     # deliberately get none — they model rigid jobs that never ack, so the
@@ -982,10 +1178,21 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
                 c.add(Request(name=name_of(cr),
                               namespace=namespace_of(cr)))
+        elif c is tel_ctrl:
+            # the telemetry reconciler's primary is the Node: its resync
+            # re-audits every TPU node's condition against the scorer
+            for n in fake.list("v1", "Node"):
+                if labels_of(n).get(L.GKE_TPU_ACCELERATOR):
+                    c.add(Request(name=name_of(n)))
         else:
             c.add(resync)
 
     def tick() -> None:
+        if telemetry is not None and not cached:
+            # no delta hook to ride: feed the same fold from a listing
+            telemetry.resync(fake.list("v1", "Node"))
+            for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                telemetry.on_request_delta("MODIFIED", cr)
         for c in ctrls:
             _enqueue_resync(c)
             c.drain()
@@ -1154,6 +1361,9 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             settled = canonical_settled_state(fake, NAMESPACE)
             out["settled_state"] = settled
             out["settled_digest"] = settled_state_digest(settled)
+        if scenario == "chip-degrade":
+            out["telemetry"] = _telemetry_summary(fake, telemetry, state)
+            out["goodput"] = _goodput_summary(fake, clock.t, state)
         if scenario == "apiserver-brownout":
             out["brownout"] = {
                 "degraded_entered": bool(state.get("degraded_seen")),

@@ -88,6 +88,33 @@ def gather(client, out_dir: pathlib.Path) -> dict:
                     summary["upgrade_nodes"] = len(report)
             except Exception as e:
                 summary["errors"].append(f"upgrade report: {e}")
+            # the fleet telemetry plane, from the SAME Node snapshot:
+            # the rollup (the `tpuop-cfg top -f` input) plus each
+            # node's raw health digest for chip-level drill-down
+            try:
+                from ..api import labels as L
+                from ..metrics.fleet import rollup_nodes
+
+                d = out_dir / "fleet"
+                d.mkdir(parents=True, exist_ok=True)
+                (d / "fleet.json").write_text(
+                    json.dumps(rollup_nodes(objs), indent=2,
+                               sort_keys=True))
+                dd = d / "digests"
+                count = 0
+                for node in objs:
+                    meta = node.get("metadata", {})
+                    raw = (meta.get("annotations") or {}).get(
+                        L.HEALTH_DIGEST)
+                    if not raw:
+                        continue
+                    dd.mkdir(parents=True, exist_ok=True)
+                    (dd / f"{meta.get('name', 'unnamed')}.json"
+                     ).write_text(raw)
+                    count += 1
+                summary["fleet_digests"] = count
+            except Exception as e:
+                summary["errors"].append(f"fleet: {e}")
         d = out_dir / subdir
         d.mkdir(parents=True, exist_ok=True)
         for obj in objs:

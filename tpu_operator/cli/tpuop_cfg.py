@@ -120,7 +120,8 @@ def _status_report(client, namespace: str) -> dict:
                                           default=None),
                 "slices": slices,
             })
-    for node in client.list("v1", "Node"):
+    nodes = list(client.list("v1", "Node"))
+    for node in nodes:
         nl = labels_of(node)
         if L.TPU_PRESENT in nl:
             report["nodes"]["tpu"] += 1
@@ -128,6 +129,17 @@ def _status_report(client, namespace: str) -> dict:
         if s:
             states = report["nodes"]["upgradeStates"]
             states[s] = states.get(s, 0) + 1
+    # one-line fleet health: same rollup formula /debug/fleet and
+    # `top` use, collapsed to the numbers an on-call scans first
+    from ..metrics.fleet import rollup_nodes
+    roll = rollup_nodes(nodes)
+    report["fleet"] = {
+        "degradedChips": roll["totals"]["degraded_chips"],
+        "chips": roll["totals"]["chips"],
+        "reporting": roll["totals"]["reporting"],
+        "condemned": roll["totals"]["condemned"],
+        "worstDomain": roll["worst_domain"],
+    }
 
     if not report["crs"]:
         report["ready"] = False
@@ -178,6 +190,13 @@ def _print_status_text(report: dict) -> None:
     upgrade = nodes.get("upgradeStates") or {}
     print(f"nodes: {nodes.get('tpu', 0)} TPU"
           + (f", upgrade states {upgrade}" if upgrade else ""))
+    fleet = report.get("fleet")
+    if fleet and fleet.get("chips"):
+        worst = fleet.get("worstDomain") or ""
+        print(f"fleet health: {fleet.get('degradedChips', 0)}/"
+              f"{fleet.get('chips', 0)} chips degraded, "
+              f"{fleet.get('condemned', 0)} nodes condemned"
+              + (f", worst domain {worst}" if worst else ""))
     cache = report.get("operatorCache")
     if cache:
         if cache.get("degraded"):
@@ -822,6 +841,112 @@ def _slo(args) -> int:
     return 2 if breached else 0
 
 
+def render_fleet_top(snapshot: dict) -> str:
+    """The /debug/fleet body as a per-ICI-domain heatmap: one row per
+    domain with its digest coverage, degraded-chip count, duty-cycle
+    heat bar and max chip temperature, then the hysteresis scorer's
+    live state and the worst-goodput slices."""
+    lines = []
+    totals = snapshot.get("totals") or {}
+    lines.append(
+        f"fleet: {totals.get('nodes', 0)} TPU nodes "
+        f"({totals.get('reporting', 0)} reporting, "
+        f"{totals.get('silent', 0)} silent, "
+        f"{totals.get('condemned', 0)} condemned), "
+        f"{totals.get('chips', 0)} chips, "
+        f"{totals.get('degraded_chips', 0)} degraded")
+    domains = snapshot.get("domains") or {}
+    if domains:
+        lines.append(f"{'DOMAIN':<22s} {'GEN':<5s} {'NODES':>5s} "
+                     f"{'REP':>4s} {'CHIPS':>5s} {'BAD':>4s} "
+                     f"{'COND':>4s} {'DUTY%':>6s} {'HBM':>5s} "
+                     f"{'TEMP':>6s}  HEAT")
+    worst = snapshot.get("worst_domain") or ""
+    for dom in sorted(domains):
+        e = domains[dom]
+        duty = float(e.get("duty_cycle_pct", 0.0))
+        # ten-cell heat bar scaled on duty cycle — the at-a-glance
+        # load picture `top` owes its name to
+        filled = max(0, min(10, int(round(duty / 10.0))))
+        bar = "#" * filled + "." * (10 - filled)
+        lines.append(
+            f"{dom:<22s} {e.get('generation', ''):<5s}"
+            f" {e.get('nodes', 0):>5d} {e.get('reporting', 0):>4d}"
+            f" {e.get('chips', 0):>5d} {e.get('degraded_chips', 0):>4d}"
+            f" {e.get('condemned', 0):>4d} {duty:>6.1f}"
+            f" {e.get('hbm_headroom_frac', 1.0):>5.2f}"
+            f" {e.get('temp_max_c', 0.0):>6.1f}  {bar}"
+            + ("  << WORST" if dom == worst else ""))
+    scorer = snapshot.get("scorer") or {}
+    if scorer:
+        streaks = scorer.get("fail_streaks") or {}
+        parts = [f"condemn after {scorer.get('condemn_after', 0)} FAILs",
+                 f"absolve after {scorer.get('absolve_after', 0)} OKs"]
+        condemned = scorer.get("condemned") or []
+        parts.append("condemned: " + (", ".join(condemned)
+                                      if condemned else "none"))
+        lines.append("scorer: " + "; ".join(parts))
+        active = {n: s for n, s in streaks.items()
+                  if n not in set(condemned)}
+        if active:
+            lines.append("  fail streaks: " + ", ".join(
+                f"{n}={s}" for n, s in sorted(active.items())))
+    slices = snapshot.get("slices") or {}
+    if slices:
+        lines.append("slices (worst goodput first):")
+        order = list(snapshot.get("worst_slices") or [])
+        order += [k for k in sorted(slices) if k not in set(order)]
+        for key in order:
+            s = slices.get(key) or {}
+            ratio = s.get("goodput_ratio")
+            rated = f"{ratio:.2f}x" if ratio is not None else "n/a"
+            lines.append(
+                f"  {key:<28s} {s.get('generation') or '?':<4s}"
+                f" acked {s.get('acked_steps', 0):>5}  goodput {rated}"
+                + ("  DEGRADED" if ratio is not None
+                   and ratio < 0.5 else ""))
+    return "\n".join(lines)
+
+
+def _top(args) -> int:
+    """Fetch the fleet telemetry rollup from the manager's /debug/fleet
+    (or a must-gather's fleet/fleet.json) and render the per-domain
+    heatmap; exit 2 when any node is condemned so the command scripts
+    as a fleet-health probe."""
+    import pathlib
+    import urllib.request
+
+    if args.file:
+        path = pathlib.Path(args.file)
+        if path.is_dir():
+            # a must-gather bundle: the fleet plane lives at a fixed
+            # relative path inside it
+            path = path / "fleet" / "fleet.json"
+        try:
+            snapshot = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read fleet snapshot from {path}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        url = args.url.rstrip("/") + "/debug/fleet"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                snapshot = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if not isinstance(snapshot, dict):
+        print("fleet snapshot payload is not an object", file=sys.stderr)
+        return 1
+    condemned = (snapshot.get("totals") or {}).get("condemned", 0)
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(snapshot, indent=2, sort_keys=True))
+    else:
+        print(render_fleet_top(snapshot))
+    return 2 if condemned else 0
+
+
 def _dag(args) -> int:
     """Render the operand dependency DAG the scheduler compiles at
     startup: every state with its requires(), the parallel sync waves
@@ -1186,6 +1311,22 @@ def main(argv=None) -> int:
                     default="text")
     so.add_argument("--timeout", type=float, default=10.0)
 
+    tp = sub.add_parser(
+        "top", help="fleet telemetry heatmap from /debug/fleet (or a "
+                    "must-gather's fleet/fleet.json): per-ICI-domain "
+                    "digest coverage, degraded chips, duty/HBM/temp, "
+                    "scorer state and worst-goodput slices; exit 2 "
+                    "when any node is condemned")
+    tp.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    tp.add_argument("-f", "--file", default=None,
+                    help="read a fleet.json dump (or a must-gather "
+                         "directory containing fleet/fleet.json) "
+                         "instead of fetching")
+    tp.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    tp.add_argument("--timeout", type=float, default=10.0)
+
     dg = sub.add_parser(
         "dag", help="show the operand state dependency DAG the scheduler "
                     "compiles at startup: sync waves, per-state "
@@ -1246,6 +1387,8 @@ def main(argv=None) -> int:
         return _why(args)
     if args.cmd == "slo":
         return _slo(args)
+    if args.cmd == "top":
+        return _top(args)
     if args.cmd == "dag":
         return _dag(args)
     if args.cmd == "place":

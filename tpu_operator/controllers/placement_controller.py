@@ -77,6 +77,7 @@ from ..runtime.objects import (
 from ..topology.index import PLACEMENT_INDEX_GATE, FleetIndex
 from ..topology.placement import (
     FleetState,
+    _node_telemetry_ok,
     rank_candidates,
     unschedulable_reason,
 )
@@ -138,6 +139,7 @@ def _node_placement_changed(event: WatchEvent, old: Optional[dict]) -> bool:
             any(c.get("type") == "Ready" and c.get("status") == "True"
                 for c in get_nested(n, "status", "conditions",
                                     default=[]) or []),
+            _node_telemetry_ok(n),
             annotations_of(n).get(L.PLACED_BY),
             nl.get(L.GKE_TPU_ACCELERATOR),
             nl.get(L.GKE_TPU_TOPOLOGY),
@@ -611,8 +613,11 @@ class PlacementReconciler(Reconciler):
     def _binding_broken(self, cr: dict, spec: SliceRequestSpec,
                         key: str) -> Optional[str]:
         """None when the Placed binding is sound, else the drain reason.
-        NotReady is tolerated — only existence, lease and pool identity
-        break a binding."""
+        NotReady is tolerated — only existence, lease, pool identity and
+        a telemetry condemnation break a binding. The condemnation is
+        the hysteresis scorer's published verdict (sustained FAIL
+        digests, metrics/fleet.py) — a flapping chip never raises it,
+        so flaps never evict."""
         bound = list(get_nested(cr, "status", "nodes", default=[]) or [])
         if not bound:
             return "placed with no nodes recorded"
@@ -628,6 +633,8 @@ class PlacementReconciler(Reconciler):
                     L.GKE_TPU_ACCELERATOR) != spec.accelerator:
                 return (f"node {node_name} no longer matches accelerator "
                         f"pin {spec.accelerator!r}")
+            if not _node_telemetry_ok(node):
+                return f"node {node_name} condemned by telemetry"
         return None
 
     def _release_leases(self, key: str, engine=None) -> int:

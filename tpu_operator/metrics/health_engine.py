@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -75,11 +76,19 @@ def evaluate_chip(s: ChipSample) -> Dict:
             status = WARN
             reasons.append(f"temperature {s.temperature_c:.0f}C >= "
                            f"{TEMP_WARN_C:.0f}C")
-    if s.hbm_total and s.hbm_used / s.hbm_total >= HBM_WARN_FRACTION:
+    usage_unobservable = not getattr(s, "hbm_usage_known", True)
+    if usage_unobservable:
+        # datasheet-fallback totals make unobservable usage look
+        # healthy or unhealthy arbitrarily — say so instead of guessing
+        pass
+    elif s.hbm_total and s.hbm_used / s.hbm_total >= HBM_WARN_FRACTION:
         if status != FAIL:
             status = WARN
         reasons.append(f"HBM {s.hbm_used / s.hbm_total:.0%} full")
-    return {"chip_id": s.chip_id, "status": status, "reasons": reasons}
+    out = {"chip_id": s.chip_id, "status": status, "reasons": reasons}
+    if usage_unobservable:
+        out["usage_unobservable"] = True
+    return out
 
 
 class HealthEngine:
@@ -120,6 +129,88 @@ class HealthEngine:
             elif c["status"] == WARN and status == OK:
                 status = WARN
         return {"status": status, "reasons": reasons, "chips": chips}
+
+    def digest(self, generation: str = "", seq: int = 0) -> Dict:
+        """Compact, schema-stamped node health digest — the payload of
+        the ``tpu.graft.dev/health-digest`` node annotation the fleet
+        rollup (metrics/fleet.py) folds O(delta). Per-chip grades plus
+        three scalar summaries; size is bounded by chips-per-host (<= 8
+        on every known generation), never by fleet size."""
+        health = self.health()
+        with self._lock:
+            samples = list(self._samples)
+        duty = [s.duty_cycle_pct for s in samples]
+        temps = [s.temperature_c for s in samples
+                 if s.temperature_c is not None]
+        free = [1.0 - s.hbm_used / s.hbm_total for s in samples
+                if getattr(s, "hbm_usage_known", True) and s.hbm_total]
+        return {
+            "v": DIGEST_SCHEMA_VERSION,
+            "status": health["status"],
+            "grades": {c["chip_id"]: c["status"]
+                       for c in health["chips"]},
+            "duty_pct": round(sum(duty) / len(duty), 1) if duty else 0.0,
+            "hbm_free_frac": round(min(free), 4) if free else 1.0,
+            "temp_max_c": round(max(temps), 1) if temps else 0.0,
+            "gen": generation,
+            "seq": int(seq),
+        }
+
+
+# digest consumers reject any version they don't speak instead of
+# misreading it; bump on any key-meaning change
+DIGEST_SCHEMA_VERSION = 1
+
+
+def digest_annotation(digest: Dict) -> str:
+    """Canonical wire form of a digest: compact, key-sorted JSON —
+    byte-stable for a given digest, so unchanged health costs the
+    apiserver a no-op write the cache layer can dedupe."""
+    return json.dumps(digest, sort_keys=True, separators=(",", ":"))
+
+
+def parse_digest(raw: Optional[str]) -> Optional[Dict]:
+    """The digest carried by a node annotation, or None when absent,
+    malformed, or of a schema version this build doesn't speak."""
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    if not isinstance(d, dict) \
+            or d.get("v") != DIGEST_SCHEMA_VERSION:
+        return None
+    return d
+
+
+def publish_digests(client, node_name: str, engine: HealthEngine,
+                    generation: str = "", interval: float = 30.0,
+                    stop_event: Optional[threading.Event] = None,
+                    jitter: float = 0.2) -> int:
+    """Publish the node's digest into its ``health-digest`` annotation
+    on a jittered cadence (de-synchronized across the fleet so 10k
+    nodes don't stampede the apiserver on the same second; the jitter
+    stream is seeded from the node name, so a given node's schedule is
+    reproducible). Blocks until ``stop_event`` is set; returns the
+    number of digests published."""
+    from ..api import labels as L
+
+    stop = stop_event or threading.Event()
+    rng = random.Random(f"digest:{node_name}")
+    seq = 0
+    while True:
+        seq += 1
+        ann = digest_annotation(engine.digest(generation, seq))
+        try:
+            client.patch("v1", "Node", node_name,
+                         {"metadata": {"annotations": {
+                             L.HEALTH_DIGEST: ann}}})
+        except Exception:
+            log.exception("digest publish failed for %s", node_name)
+        wait = interval * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+        if stop.wait(max(wait, 0.1)):
+            return seq
 
 
 def serve(port: int, interval: float = 15.0,

@@ -297,6 +297,41 @@ class OperatorMetrics:
         self.snapshot_age_seconds = g(
             "tpu_operator_snapshot_age_seconds",
             "Age of the newest valid durable snapshot on disk")
+        # fleet telemetry plane (metrics/fleet.py): node health digests
+        # folded O(delta) into per-domain/generation rollups, the
+        # hysteresis scorer's condemned count, and per-slice goodput
+        # (acked steps per wall-second vs the generation-ideal rate)
+        self.fleet_duty_cycle_pct = g(
+            "tpu_operator_fleet_duty_cycle_pct",
+            "Mean chip duty cycle over a domain's digest-reporting "
+            "nodes, per ICI domain and generation",
+            labelnames=("domain", "generation"))
+        self.fleet_hbm_headroom_fraction = g(
+            "tpu_operator_fleet_hbm_headroom_fraction",
+            "Worst-chip free HBM fraction over a domain's "
+            "digest-reporting nodes, per ICI domain and generation",
+            labelnames=("domain", "generation"))
+        self.fleet_degraded_chips = g(
+            "tpu_operator_fleet_degraded_chips",
+            "Chips currently graded warn or fail by their node digest, "
+            "per ICI domain and generation",
+            labelnames=("domain", "generation"))
+        self.fleet_digest_nodes = g(
+            "tpu_operator_fleet_digest_nodes",
+            "TPU nodes by telemetry state (reporting|silent|condemned); "
+            "condemned = failed the hysteresis scorer, excluded from "
+            "placement",
+            labelnames=("state",))
+        self.fleet_slice_goodput_ratio = g(
+            "tpu_operator_fleet_slice_goodput_ratio",
+            "Acked steps per wall-second vs the generation-ideal rate "
+            "for one placed slice (1.0 = full-speed training)",
+            labelnames=("request",))
+        self.slice_goodput_steps = c(
+            "tpu_operator_slice_goodput_steps_total",
+            "Acked workload steps classified against the goodput bar "
+            "(good = at or above the degraded threshold ratio)",
+            labelnames=("quality",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

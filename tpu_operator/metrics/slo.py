@@ -136,6 +136,14 @@ DEFAULT_SLOS: Tuple[SLOSpec, ...] = (
         objective=0.99, sli="latency",
         histogram="tpu_operator_placement_latency_seconds",
         threshold_s=1.0),
+    SLOSpec(
+        name="slice-goodput",
+        description="90% of acked workload steps land at or above the "
+                    "generation-ideal goodput bar (degraded chips burn "
+                    "this budget)",
+        objective=0.90, sli="ratio",
+        counter="tpu_operator_slice_goodput_steps_total",
+        label="quality", good=("good",), bad=("degraded",)),
 )
 
 

@@ -603,6 +603,15 @@ class _HealthHandler(BaseHTTPRequestHandler):
                      "events": events}, sort_keys=True).encode()
                 code = 200
             ctype = "application/json"
+        elif url.path == "/debug/fleet":
+            import json
+
+            from ..metrics.fleet import FLEET_TELEMETRY
+
+            body = json.dumps(FLEET_TELEMETRY.snapshot(),
+                              sort_keys=True).encode()
+            code = 200
+            ctype = "application/json"
         elif url.path == "/debug/slo":
             import json
 
@@ -815,6 +824,16 @@ class Manager:
 
     def start(self):
         self.restore_from_snapshot()
+        cache = self.find_cache()
+        if cache is not None:
+            # fleet telemetry plane: fold node health digests O(delta)
+            # off the informer cache's delta listeners (never a poll)
+            from ..metrics.fleet import FLEET_TELEMETRY
+
+            try:
+                FLEET_TELEMETRY.attach(cache)
+            except Exception:
+                log.exception("fleet telemetry attach failed")
         if (self.snapshot_dir is not None and self.snapshot_interval > 0
                 and self.find_cache() is not None):
             self._snapshot_thread = threading.Thread(
@@ -847,6 +866,12 @@ class Manager:
         if self._snapshot_thread is not None:
             self._snapshot_thread.join(timeout=5.0)
         self.write_snapshot_now()
+        if self.find_cache() is not None:
+            # drop the singleton's cache listeners so a later manager
+            # (tests, restart-in-process) attaches to a live cache only
+            from ..metrics.fleet import FLEET_TELEMETRY
+
+            FLEET_TELEMETRY.detach()
         # signal the client FIRST: a worker sleeping in the HTTP client's
         # 429 throttle-retry wait is interruptible only by client.close(),
         # and ctrl.stop() below joins that worker — closing after the

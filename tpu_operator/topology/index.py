@@ -58,6 +58,7 @@ from .placement import (
     _group_candidates,
     _node_chips,
     _node_ready,
+    _node_telemetry_ok,
     rank_candidates,
     unschedulable_reason,
 )
@@ -185,7 +186,8 @@ class FleetIndex:
         return (nl.get(L.GKE_TPU_ACCELERATOR, ""),
                 nl.get(L.GKE_TPU_TOPOLOGY, ""),
                 nl.get(L.GKE_NODEPOOL), nl.get(L.GKE_TPU_WORKER_ID),
-                _node_chips(node), _node_ready(node))
+                _node_chips(node), _node_ready(node),
+                _node_telemetry_ok(node))
 
     def resync(self, nodes) -> None:
         """Delta-feed from a full node list: diff against the held
@@ -277,7 +279,8 @@ class FleetIndex:
         nl = labels_of(node)
         gen = L.accelerator_generation(nl.get(L.GKE_TPU_ACCELERATOR, ""))
         chips = _node_chips(node)
-        if gen in CHIPS and chips > 0 and _node_ready(node):
+        if gen in CHIPS and chips > 0 and _node_ready(node) \
+                and _node_telemetry_ok(node):
             self._chips[name] = chips
             self._gen[name] = gen
         else:

@@ -71,6 +71,18 @@ def _node_ready(node: dict) -> bool:
                                    default=[]) or [])
 
 
+def _node_telemetry_ok(node: dict) -> bool:
+    """False only when the telemetry scorer has *condemned* the node
+    (TPUTelemetryHealthy condition at status False, raised after
+    sustained FAIL digests — metrics/fleet.py). Absent condition means
+    healthy: telemetry is advisory until it has evidence, and a node
+    that merely stops reporting keeps its placements."""
+    for c in get_nested(node, "status", "conditions", default=[]) or []:
+        if c.get("type") == L.TELEMETRY_CONDITION:
+            return c.get("status") != "False"
+    return True
+
+
 def _node_chips(node: dict) -> int:
     nl = labels_of(node)
     raw = nl.get(L.GKE_ACCELERATOR_COUNT) or get_nested(
@@ -251,7 +263,8 @@ class FleetState:
             for i, node_name in enumerate(sorted(members)):
                 node = nodes_by_name[node_name]
                 chips = _node_chips(node)
-                if chips <= 0 or not _node_ready(node):
+                if chips <= 0 or not _node_ready(node) \
+                        or not _node_telemetry_ok(node):
                     continue
                 widx = labels_of(node).get(L.GKE_TPU_WORKER_ID)
                 try:

@@ -226,8 +226,16 @@ class ElasticWorkload:
 
         # one quantum of training, then the periodic checkpoint cadence
         self.step += self.steps_per_tick
+        saved = False
         if self.step - (self._last_saved or 0) >= self.checkpoint_every:
             self._save(self.step)
+            # goodput progress: the durably-checkpointed step is the
+            # acked-work counter the fleet telemetry plane rates against
+            # the generation-ideal step rate (metrics/fleet.py) — kept
+            # outside status.migration so it advances between handshakes
+            set_nested(cr, self._last_saved,
+                       "status", "progress", "checkpointedStep")
+            saved = True
 
         anns = annotations_of(cr)
         intent = anns.get(L.SLICE_INTENT)
@@ -243,6 +251,8 @@ class ElasticWorkload:
                 # save BEFORE ack — the ack is the operator's license to
                 # tear the old binding down
                 self._save(self.step)
+                set_nested(cr, self._last_saved,
+                           "status", "progress", "checkpointedStep")
                 self.max_acked = max(self.max_acked, self.step)
                 self.client.patch(
                     V1ALPHA1, KIND_SLICE_REQUEST, self.name,
@@ -254,6 +264,7 @@ class ElasticWorkload:
                     int(mig.get("ackedStep", -1) or -1), self.step)
                 set_nested(cr, mig, "status", "migration")
                 update_status_with_retry(self.client, cr, live=live)
+                saved = False  # the handshake write carried progress too
                 if TIMELINE.enabled:
                     TIMELINE.record("SliceRequest", self.key,
                                     "migration:" + MIG_CHECKPOINTED,
@@ -261,6 +272,8 @@ class ElasticWorkload:
                                      "ackedStep": self.step})
                 log.info("workload %s acked %s at step %d",
                          self.key, intent, self.step)
+        if saved:
+            update_status_with_retry(self.client, cr, live=live)
         if self._last_save_at is not None:
             OPERATOR_METRICS.slice_checkpoint_age.labels(
                 request=self.key).set(self.clock() - self._last_save_at)
