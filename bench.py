@@ -699,6 +699,46 @@ def _controlplane_doc() -> dict | None:
                 doc["warm_over_cold"] = round(rs["warm_over_cold"], 4)
             except Exception as e:
                 doc["restart"] = {"error": f"{type(e).__name__}: {e}"}
+        # fair-share admission at saturation: Jain's index over
+        # attained-vs-entitled service and drain throughput, quota-
+        # ordered gang pass vs the priority kill switch (its own try
+        # for the same reason as rollout's). fairness_jain_index /
+        # saturation_drain_rps at top level are the figures
+        # tests/test_bench_guard.py gates (Jain >= 0.8 absolute).
+        # TPUOP_BENCH_FAIRNESS_NODES scales it down for smoke runs;
+        # TPUOP_BENCH_SKIP_FAIRNESS skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_FAIRNESS"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_fairness_bench,
+                )
+
+                fn = int(os.environ.get(
+                    "TPUOP_BENCH_FAIRNESS_NODES", "300"))
+                fb = run_fairness_bench(fn)
+                doc["fairness"] = {
+                    "n_tpu_nodes": fb["n_tpu_nodes"],
+                    "n_requests": fb["n_requests"],
+                    "capacity_chips": fb["capacity_chips"],
+                    "policy": fb["policy"],
+                    "jain_baseline": round(
+                        fb["fairness_jain_baseline"], 4),
+                    "drain_rps_baseline": round(
+                        fb["drain_rps_baseline"], 1),
+                    "placed": fb["placed"],
+                    "placed_baseline": fb["placed_baseline"],
+                    "throughput_vs_baseline": round(
+                        fb["throughput_vs_baseline"], 4),
+                    "attained_over_share": fb["attained_over_share"],
+                    "attained_over_share_baseline":
+                        fb["attained_over_share_baseline"],
+                }
+                doc["fairness_jain_index"] = round(
+                    fb["fairness_jain_index"], 4)
+                doc["saturation_drain_rps"] = round(
+                    fb["saturation_drain_rps"], 1)
+            except Exception as e:
+                doc["fairness"] = {"error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

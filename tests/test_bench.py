@@ -407,6 +407,14 @@ def test_record_carries_controlplane_rider(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_diagnose", lambda note: [])
     monkeypatch.setenv("TPUOP_BENCH_SCALE_NODES", "20")  # keep it quick
     monkeypatch.delenv("TPUOP_BENCH_SKIP_SCALE", raising=False)
+    # every rider must still RUN (the record carries their figures in
+    # every outcome), but at smoke sizes — the 10k defaults are for the
+    # official record, not this wiring test
+    monkeypatch.setenv("TPUOP_BENCH_FLEET_NODES", "300")
+    monkeypatch.setenv("TPUOP_BENCH_PLACEMENT_FLEET_NODES", "600")
+    monkeypatch.setenv("TPUOP_BENCH_TELEMETRY_NODES", "200")
+    monkeypatch.setenv("TPUOP_BENCH_RESTART_NODES", "1000")
+    monkeypatch.setenv("TPUOP_BENCH_FAIRNESS_NODES", "60")
     monkeypatch.setattr(sys, "argv", [
         "bench.py", "--require-tpu", "--attempts", "1",
         "--attempt-timeout", "30", "--total-timeout", "30",

@@ -327,6 +327,53 @@ def test_restart_warm_not_regressed():
         f"{latest:.2f}s regressed >25% vs best on record ({best:.2f}s)")
 
 
+def test_fairness_jain_index_bounded():
+    """Absolute acceptance bar, like the warm_over_cold gate: the latest
+    round carrying ``fairness_jain_index`` (benchmarks.controlplane.
+    run_fairness_bench — Jain's index over per-class attained-vs-
+    entitled service under the quota-ordered gang pass at saturation)
+    must stay at or above 0.80. A fairness regression that quietly
+    drifts back toward the priority baseline fails here, not at the
+    next noisy-neighbor incident. Skips until a round carrying the key
+    is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "fairness_jain_index")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records fairness_jain_index yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    assert latest >= 0.80, (
+        f"BENCH_LOCAL_r{latest_round:02d} fairness_jain_index="
+        f"{latest:.4f} breaks the Jain >= 0.80 fairness acceptance bar")
+
+
+def test_saturation_drain_rps_not_regressed():
+    """The throughput twin of the Jain gate, higher-is-better like
+    placement_storm_rps: saturation_drain_rps (placement decisions per
+    wall second while the quota-ordered backlog drains) must stay above
+    best / 1.25 — fairness is not allowed to quietly buy its index with
+    drain throughput. Skips until a round carrying the key is
+    committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "saturation_drain_rps")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: max(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records saturation_drain_rps yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = max(rounds_with_figure.values())
+    assert latest >= best / REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} saturation_drain_rps="
+        f"{latest:.1f} regressed >25% vs best on record ({best:.1f})")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

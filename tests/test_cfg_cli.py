@@ -712,3 +712,113 @@ class TestSlicesView:
         doc = json.loads(out.out)
         assert doc["requests"] == []
         assert "no kubeconfig anywhere" in doc["error"]
+
+
+class TestQuotaView:
+    """`tpuop-cfg quota`: the fair-share admission explainer, live
+    (/debug/quota shape) and from a must-gather bundle."""
+
+    def _seed(self):
+        import json
+
+        from tpu_operator.api import labels as L
+        from tpu_operator.api.slicerequest import new_slice_request
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        for i in range(6):
+            c.add_node(f"n{i}", labels={
+                L.GKE_TPU_ACCELERATOR: "tpu-v5p-slice",
+                L.GKE_TPU_TOPOLOGY: "2x2x1",
+                L.GKE_ACCELERATOR_COUNT: "4"},
+                allocatable={"google.com/tpu": "4"})
+        c.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "tpu-operator-quota",
+                               "namespace": "tpu-operator"},
+                  "data": {"quota.json": json.dumps({"classes": [
+                      {"name": "prod", "weight": 6, "minChips": 8,
+                       "starvationBoundSeconds": 240},
+                      {"name": "batch", "weight": 3,
+                       "preemptTokens": 4}]})}})
+        queued = new_slice_request("q1", {"chips": 8})
+        queued["metadata"].setdefault("annotations", {})[
+            L.QUOTA_CLASS] = "prod"
+        c.create(queued)
+        running = new_slice_request("r1", {"chips": 4})
+        running["metadata"].setdefault("annotations", {})[
+            L.QUOTA_CLASS] = "batch"
+        running["status"] = {"phase": "Placed", "chips": 4,
+                             "nodes": ["n0"]}
+        c.create(running)
+        return c
+
+    def test_golden_table(self):
+        from tpu_operator.cli.tpuop_cfg import render_quota_report
+        from tpu_operator.scheduling.quota import (AdmissionState,
+                                                   quota_report)
+
+        rep = quota_report(self._seed(), "tpu-operator",
+                           state=AdmissionState(), now=lambda: 1000.0)
+        text = render_quota_report(rep)
+        assert text.splitlines() == [
+            "policy: priority   capacity: 24 chips",
+            "CLASS           W   MIN   MAX   USE SHARE     QUEUED"
+            "      DEFICIT TOKENS",
+            "batch           3     0     -     4     4      0c/0r"
+            "         0s/-      4",
+            "default         1     0     -     0     0      0c/0r"
+            "         0s/-      0",
+            "prod            6     8     -     0     8      8c/1r"
+            "      0s/240s      0",
+        ]
+
+    def test_unconfigured_is_explicit(self):
+        from tpu_operator.cli.tpuop_cfg import render_quota_report
+        from tpu_operator.runtime import FakeClient
+        from tpu_operator.scheduling.quota import quota_report
+
+        rep = quota_report(FakeClient(), "tpu-operator")
+        assert rep["configured"] is False
+        assert "no quota configured" in render_quota_report(rep)
+
+    def test_bundle_file_and_exit_codes(self, tmp_path, capsys):
+        import json
+
+        from tpu_operator.scheduling.quota import (AdmissionState,
+                                                   quota_report)
+
+        state = AdmissionState()
+        rep = quota_report(self._seed(), "tpu-operator", state=state,
+                           now=lambda: 1000.0)
+        d = tmp_path / "quota"
+        d.mkdir()
+        (d / "quota.json").write_text(json.dumps(rep))
+        assert main(["quota", "-f", str(tmp_path)]) == 0
+        capsys.readouterr()
+
+        # advance past the 240s starvation bound: prod still has queued
+        # demand and zero usage, so the deficit clock keeps running
+        rep2 = quota_report(self._seed(), "tpu-operator", state=state,
+                            now=lambda: 1300.0)
+        assert rep2["breached"] == ["prod"]
+        (d / "quota.json").write_text(json.dumps(rep2))
+        assert main(["quota", "-f", str(tmp_path)]) == 2
+        out = capsys.readouterr().out
+        assert "STARVING" in out
+        assert "starving: prod" in out
+
+    def test_json_output_roundtrips(self, tmp_path, capsys):
+        import json
+
+        from tpu_operator.scheduling.quota import quota_report
+
+        rep = quota_report(self._seed(), "tpu-operator")
+        f = tmp_path / "quota.json"
+        f.write_text(json.dumps(rep))
+        assert main(["quota", "-f", str(f), "-o", "json"]) == 0
+        assert json.loads(capsys.readouterr().out) == rep
+
+    def test_unreadable_file_is_clean_error(self, tmp_path, capsys):
+        rc = main(["quota", "-f", str(tmp_path / "missing.json")])
+        assert rc == 1
+        assert "cannot read quota report" in capsys.readouterr().err

@@ -1,0 +1,498 @@
+"""Fair-share admission: quota-tree math, batch-ordering policies, the
+priority kill switch's byte-identity, deficit clocks / preemption
+budgets, and the controller-level starvation-rescue arc.
+
+The chaos plane (tests/test_chaos.py saturation-storm) owns the
+end-to-end starvation-freedom verdict; this file owns the unit
+contracts those verdicts are built from."""
+
+import json
+import random
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.slicerequest import (
+    KIND_SLICE_REQUEST,
+    PHASE_PLACED,
+    PHASE_UNSCHEDULABLE,
+    V1ALPHA1,
+    SliceRequestSpec,
+    new_slice_request,
+)
+from tpu_operator.runtime import FakeClient, Request
+from tpu_operator.runtime.objects import get_nested
+from tpu_operator.scheduling.quota import (
+    POLICY_BASELINE,
+    POLICY_FINISH_TIME,
+    POLICY_THROUGHPUT,
+    AdmissionState,
+    QuotaClass,
+    QuotaTree,
+    baseline_key,
+    created_epoch,
+    order_batch,
+)
+
+
+def tree_of(*rows):
+    return QuotaTree.from_config({"classes": list(rows)})
+
+
+def item(name, cls, chips=4, priority=0, ns="default", stamp=None):
+    cr = new_slice_request(name, {"chips": chips, "priority": priority},
+                           namespace=ns)
+    cr["metadata"].setdefault("annotations", {})[L.QUOTA_CLASS] = cls
+    if stamp is not None:
+        cr["metadata"]["creationTimestamp"] = stamp
+    return (f"{ns}/{name}", cr, None, SliceRequestSpec.from_obj(cr))
+
+
+class TestQuotaTreeMath:
+    def test_weighted_shares_split_capacity(self):
+        t = tree_of({"name": "a", "weight": 3}, {"name": "b", "weight": 1})
+        assert t.shares(100, {"a": 100, "b": 100}) == \
+            {"a": 75, "b": 25, "default": 0}
+
+    def test_min_guarantee_granted_first(self):
+        t = tree_of({"name": "a", "weight": 1, "minChips": 50},
+                    {"name": "b", "weight": 1})
+        s = t.shares(60, {"a": 100, "b": 100})
+        assert s["a"] == 55 and s["b"] == 5
+
+    def test_max_cap_leftover_is_borrowed(self):
+        t = tree_of({"name": "a", "weight": 1, "maxChips": 30},
+                    {"name": "b", "weight": 1})
+        s = t.shares(100, {"a": 100, "b": 100})
+        assert s["a"] == 30 and s["b"] == 70
+
+    def test_demand_light_class_donates(self):
+        t = tree_of({"name": "a", "weight": 1}, {"name": "b", "weight": 1})
+        s = t.shares(100, {"a": 10, "b": 200})
+        assert s["a"] == 10 and s["b"] == 90
+
+    def test_hierarchical_borrow_within_parent(self):
+        t = tree_of({"name": "team", "weight": 1},
+                    {"name": "x", "parent": "team", "weight": 1},
+                    {"name": "y", "parent": "team", "weight": 3},
+                    {"name": "other", "weight": 1})
+        s = t.shares(100, {"x": 100, "y": 100, "other": 0})
+        # `other` has no demand: the whole 100 flows to team, then
+        # splits 1:3 between its children
+        assert s["other"] == 0
+        assert s["x"] == 25 and s["y"] == 75
+
+    def test_config_rejects_duplicates_unknown_parent_and_cycles(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            tree_of({"name": "a"}, {"name": "a"})
+        with pytest.raises(ValueError, match="unknown"):
+            tree_of({"name": "a", "parent": "ghost"})
+        with pytest.raises(ValueError, match="cycle"):
+            tree_of({"name": "a", "parent": "b"},
+                    {"name": "b", "parent": "a"})
+        with pytest.raises(ValueError, match="classes"):
+            QuotaTree.from_config({"classes": []})
+
+    def test_default_leaf_always_exists(self):
+        t = tree_of({"name": "a"})
+        assert "default" in t.leaf_names()
+        assert t.get("never-configured").name == "default"
+
+    def test_class_resolution_annotation_then_namespace(self):
+        t = tree_of({"name": "prod"}, {"name": "team-ns"})
+        ann = item("r", "prod", ns="team-ns")[1]
+        assert t.class_of(ann) == "prod"
+        plain = new_slice_request("r", {"chips": 4}, namespace="team-ns")
+        assert t.class_of(plain) == "team-ns"
+        other = new_slice_request("r", {"chips": 4}, namespace="elsewhere")
+        assert t.class_of(other) == "default"
+
+
+class TestBaselineOrder:
+    def test_fractional_seconds_order_numerically(self):
+        """The legacy sort compared raw strings: '...10.5Z' < '...10Z'
+        lexically ('.' < 'Z') even though 10.5s is LATER — the gang
+        pass drained the younger request first. Epoch parsing must get
+        this right."""
+        younger = "2024-01-01T00:00:10.5Z"
+        older = "2024-01-01T00:00:10Z"
+        assert younger < older  # the lexical trap this guards against
+        assert created_epoch({"metadata": {"creationTimestamp": younger}}) \
+            > created_epoch({"metadata": {"creationTimestamp": older}})
+
+    def test_offset_suffix_parses_like_zulu(self):
+        z = created_epoch(
+            {"metadata": {"creationTimestamp": "2024-01-01T00:00:10Z"}})
+        off = created_epoch(
+            {"metadata": {"creationTimestamp":
+                          "2024-01-01T00:00:10+00:00"}})
+        assert z == off
+
+    def test_unparseable_sorts_last_with_name_tiebreak(self):
+        good = item("a", "x", stamp="2024-01-01T00:00:00Z")
+        bad_b = item("b", "x", stamp="not-a-timestamp")
+        bad_c = item("c", "x", stamp="not-a-timestamp")
+        keys = sorted([baseline_key(*[it[0], it[1], it[3]])
+                       for it in (bad_c, bad_b, good)])
+        assert [k[3] for k in keys] == ["a", "b", "c"]
+
+    def test_priority_outranks_age(self):
+        old = item("old", "x", priority=0, stamp="2024-01-01T00:00:00Z")
+        new = item("new", "x", priority=5, stamp="2024-06-01T00:00:00Z")
+        assert baseline_key(new[0], new[1], new[3]) < \
+            baseline_key(old[0], old[1], old[3])
+
+
+class TestOrderBatch:
+    def test_kill_switch_is_identity_property(self):
+        """The parity the chaos plane's byte-identical verdicts rest
+        on: under the `priority` policy — or with no quota tree at all —
+        order_batch returns the batch UNCHANGED, for any batch."""
+        t = tree_of({"name": "a", "weight": 5}, {"name": "b"})
+        rng = random.Random(0)
+        for _ in range(50):
+            items = [item(f"r{i}", rng.choice(("a", "b", "zzz")),
+                          chips=rng.choice((4, 8, 16)),
+                          priority=rng.randrange(3),
+                          stamp=f"2024-01-01T00:00:{rng.randrange(60):02d}Z")
+                     for i in range(rng.randrange(12))]
+            rng.shuffle(items)
+            assert order_batch(items, POLICY_BASELINE, t,
+                               usage={"a": 99}) == items
+            assert order_batch(items, POLICY_FINISH_TIME, None) == items
+
+    def test_least_attained_class_drains_first(self):
+        t = tree_of({"name": "a", "weight": 1}, {"name": "b", "weight": 1})
+        items = [item("a1", "a"), item("a2", "a"), item("b1", "b")]
+        out = order_batch(items, POLICY_FINISH_TIME, t,
+                          usage={"a": 8, "b": 0})
+        assert [it[0].split("/")[1] for it in out] == ["b1", "a1", "a2"]
+
+    def test_interleave_charges_admitted_work(self):
+        """Admitting an item charges its class immediately, so one
+        backlogged class cannot monopolize the head of the batch."""
+        t = tree_of({"name": "a", "weight": 1}, {"name": "b", "weight": 1})
+        items = [item(f"a{i}", "a", chips=4) for i in range(3)] + \
+                [item(f"b{i}", "b", chips=4) for i in range(3)]
+        out = order_batch(items, POLICY_FINISH_TIME, t, usage={})
+        classes = [it[0].split("/")[1][0] for it in out]
+        assert classes == ["a", "b", "a", "b", "a", "b"]
+
+    def test_weight_scales_attainment(self):
+        t = tree_of({"name": "a", "weight": 4}, {"name": "b", "weight": 1})
+        items = [item(f"a{i}", "a", chips=4) for i in range(4)] + \
+                [item("b0", "b", chips=4)]
+        out = order_batch(items, POLICY_FINISH_TIME, t, usage={})
+        # one b item charges b 4 attained-per-weight; at w4, a has to
+        # admit FOUR items to reach the same attainment — so after the
+        # opening tie-break, all of a's backlog drains before b is due
+        # again
+        assert [it[0].split("/")[1] for it in out] == \
+            ["a0", "b0", "a1", "a2", "a3"]
+
+    def test_throughput_policy_uses_tflops_attainment(self):
+        t = tree_of({"name": "a", "weight": 1}, {"name": "b", "weight": 1})
+        items = [item("a1", "a"), item("b1", "b")]
+        # equal chips usage, but a's chips are on a faster generation:
+        # throughput-normalized fairness serves b first
+        out = order_batch(items, POLICY_THROUGHPUT, t,
+                          usage={"a": 8, "b": 8},
+                          usage_tflops={"a": 8000.0, "b": 10.0})
+        assert out[0][0] == "default/b1"
+
+
+class TestAdmissionState:
+    def test_deficit_clock_anchors_and_resets(self):
+        t = tree_of({"name": "p", "minChips": 8})
+        s = AdmissionState()
+        assert s.observe(t, {"p": 0}, {"p": 8}, 100.0)["p"] == 0.0
+        assert s.observe(t, {"p": 0}, {"p": 8}, 160.0)["p"] == 60.0
+        # served to its floor: the clock resets, not pauses
+        assert s.observe(t, {"p": 8}, {"p": 8}, 200.0)["p"] == 0.0
+        assert s.observe(t, {"p": 0}, {"p": 8}, 220.0)["p"] == 0.0
+
+    def test_floor_is_bounded_by_actual_demand(self):
+        """A class queuing less than its min-guarantee is satisfied by
+        what it asked for — no deficit for capacity it never wanted."""
+        t = tree_of({"name": "p", "minChips": 32})
+        s = AdmissionState()
+        s.observe(t, {"p": 4}, {"p": 4}, 0.0)
+        assert s.observe(t, {"p": 8}, {"p": 0}, 50.0)["p"] == 0.0
+
+    def test_token_bucket_exhausts_and_rolls(self):
+        qc = QuotaClass(name="b", preempt_tokens=2, preempt_window_s=600)
+        s = AdmissionState()
+        assert s.take_token(qc, 0.0)
+        assert s.take_token(qc, 1.0)
+        assert not s.take_token(qc, 2.0)
+        assert s.remaining(qc, 2.0) == 0.0
+        # a new window refills the bucket
+        assert s.take_token(qc, 601.0)
+        assert s.remaining(qc, 601.0) == 1.0
+
+    def test_snapshot_roundtrip_preserves_accounting(self):
+        qc = QuotaClass(name="b", preempt_tokens=3)
+        s = AdmissionState()
+        s.take_token(qc, 10.0)
+        s.deficit_since["p"] = 42.0
+        restored = AdmissionState.from_dict(
+            json.loads(json.dumps(s.to_dict())))
+        assert restored.deficit_since == {"p": 42.0}
+        assert restored.remaining(qc, 11.0) == 2.0
+        assert AdmissionState.from_dict(None).to_dict() == \
+            AdmissionState().to_dict()
+
+
+def add_tpu(c, name, accel="tpu-v5e-slice", topo="2x4", chips=4):
+    return c.add_node(name, labels={
+        L.GKE_TPU_ACCELERATOR: accel,
+        L.GKE_TPU_TOPOLOGY: topo,
+        L.GKE_ACCELERATOR_COUNT: str(chips)},
+        allocatable={"google.com/tpu": str(chips)})
+
+
+class TestStarvationRescueArc:
+    """The controller-level tentpole contract: a starving class's
+    min-guarantee is reclaimed through budget-bounded elastic MIGRATE
+    intents — never a hard kill, never past the victim class's budget
+    or its own floor."""
+
+    def make(self, quota_rows, policy=POLICY_FINISH_TIME, n_nodes=4):
+        from tpu_operator.controllers.placement_controller import (
+            PlacementReconciler,
+        )
+
+        c = FakeClient()
+        for i in range(n_nodes):  # 2x4 => two-node domains of 8 chips
+            add_tpu(c, f"v5e-{i}")
+        clock = [1000.0]
+        rec = PlacementReconciler(
+            client=c, namespace="default",
+            quota=QuotaTree.from_config({"classes": quota_rows}),
+            admission_policy=policy, now=lambda: clock[0])
+        return c, rec, clock
+
+    def seed(self, c, rec, clock, name, cls, chips=8, priority=0):
+        cr = new_slice_request(
+            name, {"chips": chips, "priority": priority},
+            namespace="default")
+        cr["metadata"].setdefault("annotations", {})[L.QUOTA_CLASS] = cls
+        c.create(cr)
+        clock[0] += 1.0
+        rec.reconcile(Request(name=name, namespace="default"))
+        return c.get(V1ALPHA1, KIND_SLICE_REQUEST, name, "default")
+
+    def rows(self):
+        return [{"name": "prod", "weight": 6, "minChips": 8,
+                 "starvationBoundSeconds": 240},
+                {"name": "batch", "weight": 1, "preemptTokens": 2}]
+
+    def test_starving_min_posts_one_shape_matched_intent(self):
+        from tpu_operator.controllers.slices import migration_of
+
+        c, rec, clock = self.make(self.rows())
+        assert get_nested(self.seed(c, rec, clock, "batch-a", "batch"),
+                          "status", "phase") == PHASE_PLACED
+        assert get_nested(self.seed(c, rec, clock, "batch-b", "batch"),
+                          "status", "phase") == PHASE_PLACED
+        prod = self.seed(c, rec, clock, "prod-1", "prod")
+        # the fleet was full: prod parks while the rescue is in flight
+        assert get_nested(prod, "status", "phase") == PHASE_UNSCHEDULABLE
+        intents = [n for n in ("batch-a", "batch-b")
+                   if migration_of(c.get(V1ALPHA1, KIND_SLICE_REQUEST, n,
+                                         "default")).get("intent")]
+        assert len(intents) == 1  # shape-matched: ONE 8-chip victim
+        mig = migration_of(c.get(V1ALPHA1, KIND_SLICE_REQUEST,
+                                 intents[0], "default"))
+        assert mig["intent"] == "migrate"
+        assert mig["preemptedFor"] == "prod"
+        # the victim class paid exactly one budget token
+        assert rec._admission.remaining(
+            rec.quota.get("batch"), clock[0]) == 1.0
+
+    def test_preemption_exempt_class_is_never_drained(self):
+        from tpu_operator.controllers.slices import migration_of
+
+        rows = [{"name": "prod", "weight": 6, "minChips": 8,
+                 "starvationBoundSeconds": 240},
+                {"name": "batch", "weight": 1}]  # preemptTokens 0
+        c, rec, clock = self.make(rows)
+        self.seed(c, rec, clock, "batch-a", "batch")
+        self.seed(c, rec, clock, "batch-b", "batch")
+        self.seed(c, rec, clock, "prod-1", "prod")
+        assert not any(
+            migration_of(c.get(V1ALPHA1, KIND_SLICE_REQUEST, n,
+                               "default")).get("intent")
+            for n in ("batch-a", "batch-b"))
+
+    def test_drain_never_breaches_victim_floor(self):
+        from tpu_operator.controllers.slices import migration_of
+
+        rows = [{"name": "prod", "weight": 6, "minChips": 8,
+                 "starvationBoundSeconds": 240},
+                {"name": "batch", "weight": 1, "minChips": 16,
+                 "preemptTokens": 4}]
+        c, rec, clock = self.make(rows)
+        self.seed(c, rec, clock, "batch-a", "batch")
+        self.seed(c, rec, clock, "batch-b", "batch")
+        self.seed(c, rec, clock, "prod-1", "prod")
+        # batch sits exactly at its own 16-chip floor: draining 8 would
+        # breach it, so prod's min must NOT be served by force here
+        assert not any(
+            migration_of(c.get(V1ALPHA1, KIND_SLICE_REQUEST, n,
+                               "default")).get("intent")
+            for n in ("batch-a", "batch-b"))
+
+    def test_non_elastic_victim_is_skipped(self):
+        from tpu_operator.controllers.slices import migration_of
+
+        c, rec, clock = self.make(self.rows())
+        self.seed(c, rec, clock, "batch-a", "batch")
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "batch-a", "default")
+        c.patch(V1ALPHA1, KIND_SLICE_REQUEST, "batch-a",
+                {"metadata": {"annotations": {L.SLICE_ELASTIC: "false"}}},
+                namespace="default")
+        self.seed(c, rec, clock, "batch-b", "batch")
+        cr_b = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "batch-b", "default")
+        c.patch(V1ALPHA1, KIND_SLICE_REQUEST, "batch-b",
+                {"metadata": {"annotations": {L.SLICE_ELASTIC: "false"}}},
+                namespace="default")
+        del cr, cr_b
+        self.seed(c, rec, clock, "prod-1", "prod")
+        # both victims pinned non-elastic: quota NEVER hard-kills
+        assert not any(
+            migration_of(c.get(V1ALPHA1, KIND_SLICE_REQUEST, n,
+                               "default")).get("intent")
+            for n in ("batch-a", "batch-b"))
+
+    def test_starvation_gauge_fires_before_the_bound(self):
+        from tpu_operator.metrics.registry import render_prometheus
+
+        c, rec, clock = self.make(self.rows())
+        self.seed(c, rec, clock, "batch-a", "batch")
+        self.seed(c, rec, clock, "batch-b", "batch")
+        self.seed(c, rec, clock, "prod-1", "prod")  # anchors the clock
+        clock[0] += 60.0
+        rec.reconcile(Request(name="prod-1", namespace="default"))
+        text = render_prometheus()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("tpu_operator_admission_starvation_seconds")
+            and 'class="prod"' in ln)
+        assert 0.0 < float(line.rsplit(" ", 1)[1]) < 240.0
+
+    def test_escalation_targets_starving_class_queue(self):
+        c, rec, clock = self.make(self.rows())
+        seen = []
+        rec._escalate_fn = lambda req, cause=None: seen.append(
+            (str(req), getattr(cause, "reason", None)))
+        self.seed(c, rec, clock, "batch-a", "batch")
+        self.seed(c, rec, clock, "batch-b", "batch")
+        self.seed(c, rec, clock, "prod-1", "prod")
+        assert ("default/prod-1", "admission-starvation") in seen
+
+    def test_kill_switch_matches_legacy_byte_for_byte(self):
+        """No quota config + the baseline policy must leave the gang
+        pass BYTE-identical to a reconciler that has never heard of
+        admission — same statuses, same leases, same everything."""
+        from tpu_operator.controllers.placement_controller import (
+            PlacementReconciler,
+        )
+
+        def drive(policy):
+            c = FakeClient()
+            for i in range(6):
+                add_tpu(c, f"v5e-{i}")
+            rec = PlacementReconciler(client=c, namespace="default",
+                                      admission_policy=policy,
+                                      now=lambda: 1000.0)
+            for i, (chips, prio) in enumerate(
+                    ((8, 0), (4, 2), (8, 1), (4, 0), (8, 2))):
+                cr = new_slice_request(
+                    f"r{i}", {"chips": chips, "priority": prio},
+                    namespace="default")
+                cr["metadata"]["creationTimestamp"] = \
+                    f"2024-01-01T00:00:{i:02d}Z"
+                c.create(cr)
+            for i in range(5):
+                rec.reconcile(Request(name=f"r{i}", namespace="default"))
+
+            def scrub(obj):
+                # uids are random per FakeClient run; everything else
+                # (phases, nodes, reasons, versions) must be identical
+                if isinstance(obj, dict):
+                    return {k: scrub(v) for k, v in obj.items()
+                            if k != "uid"}
+                if isinstance(obj, (list, tuple)):
+                    return [scrub(v) for v in obj]
+                return obj
+
+            return json.dumps(
+                [scrub(c.get(V1ALPHA1, KIND_SLICE_REQUEST, f"r{i}",
+                             "default")) for i in range(5)],
+                sort_keys=True, default=str)
+
+        assert drive(None) == drive(POLICY_BASELINE)
+        # and with no config present, even the fair policy cannot
+        # diverge: no tree means the admission layer is a strict no-op
+        assert drive(None) == drive(POLICY_FINISH_TIME)
+
+
+class TestQuotaDebugEndpoint:
+    """/debug/quota over the live health server: Manager.find_admission
+    unwraps the controller stack to the reconciler owning the report,
+    and its absence is an explicit "not configured", never a 404."""
+
+    @staticmethod
+    def _get(port, path):
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_no_admission_controller_is_explicit(self):
+        from tpu_operator.runtime.manager import Manager
+
+        mgr = Manager(FakeClient(), namespace="default", health_port=0)
+        mgr.start()
+        try:
+            status, doc = self._get(
+                mgr._http.server_address[1], "/debug/quota")
+        finally:
+            mgr.stop()
+        assert status == 200
+        assert doc == {"configured": False, "classes": []}
+
+    def test_serves_live_admission_report(self):
+        from tpu_operator.controllers.placement_controller import (
+            PlacementReconciler,
+        )
+        from tpu_operator.runtime.manager import Manager
+
+        c = FakeClient()
+        for i in range(2):
+            add_tpu(c, f"tpu-{i}")
+        tree = tree_of({"name": "prod", "weight": 3, "minChips": 4},
+                       {"name": "batch", "weight": 1})
+        mgr = Manager(c, namespace="default", health_port=0)
+        mgr.add_reconciler(PlacementReconciler(
+            client=c, namespace="default", quota=tree,
+            admission_policy=POLICY_FINISH_TIME))
+        mgr.start()
+        try:
+            status, doc = self._get(
+                mgr._http.server_address[1], "/debug/quota")
+        finally:
+            mgr.stop()
+        assert status == 200
+        assert doc["configured"] is True
+        assert doc["policy"] == POLICY_FINISH_TIME
+        assert doc["capacityChips"] == 8
+        rows = {row["class"]: row for row in doc["classes"]}
+        assert set(rows) == {"prod", "batch", "default"}
+        assert rows["prod"]["minChips"] == 4
+        # the manager-side report folds in live admission state, so
+        # deficit clocks and token buckets are present (not unknown)
+        assert "deficitSeconds" in rows["prod"]
+        assert "tokensRemaining" in rows["prod"]

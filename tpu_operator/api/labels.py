@@ -89,6 +89,11 @@ SLICE_INTENT_ACK = f"{DOMAIN}/slice-intent-ack"
 # the intent protocol; the operator skips straight to the hard-drain path
 # without burning the migration timeout waiting for an ack.
 SLICE_ELASTIC = f"{DOMAIN}/elastic"
+# fair-share admission class of a SliceRequest (scheduling/quota.py): the
+# quota-tree leaf this request draws share from. Absent, the request maps
+# to a leaf named after its namespace, then to the synthesized `default`
+# leaf — classification never rejects a request.
+QUOTA_CLASS = f"{DOMAIN}/quota-class"
 # --- fleet telemetry plane -------------------------------------------------
 # compact, schema-stamped node health digest published by the on-node
 # health engine (metrics/health_engine.py) on a jittered interval; the

@@ -1560,3 +1560,154 @@ def run_telemetry_bench(n_tpu: int = 800, rounds: int = 5,
             "breached": slo["breached"],
         },
     }
+
+
+def run_fairness_bench(n_tpu: int = 300, n_requests: Optional[int] = None,
+                       wave: int = 40, lifetime_waves: int = 4,
+                       seed: int = 0,
+                       policy: str = "finish-time") -> Dict:
+    """Fair-share admission at saturation: Jain's index and drain
+    throughput for the quota-ordered gang pass vs the priority baseline.
+
+    A three-class tenant mix (prod w6 with a min-guarantee, batch w3,
+    research w1 with a cap) floods a mixed fleet with ~3x oversubscribed
+    demand in waves; batch sets the highest numeric priority, so the
+    legacy priority/age order lets it monopolize the fleet. Each wave
+    replays the controller's admission pipeline — baseline sort, then
+    ``order_batch`` under ``policy`` — and placed slices release after
+    ``lifetime_waves`` waves, so classes compete for the holes forever.
+
+    Fairness is Jain's index over per-class attained-over-entitled
+    service (usage / water-filled share, sampled each post-warmup wave):
+    1.0 means every class sits exactly at its share. The same seeded
+    stream re-runs under the ``priority`` kill switch for the contrast
+    figures; ``saturation_drain_rps`` is placement decisions per wall
+    second while draining, the throughput cost of fairness."""
+    import random
+
+    from ..api.slicerequest import SliceRequestSpec
+    from ..scheduling.quota import (POLICY_BASELINE, QuotaTree,
+                                    _capacity_chips, baseline_key,
+                                    order_batch)
+    from ..topology.placement import FleetState, place
+
+    if n_requests is None:
+        # hold the oversubscription ratio constant across fleet sizes so
+        # a small-fleet run (TPUOP_BENCH_FAIRNESS_NODES) measures the
+        # same contention regime as the 300-node default
+        n_requests = 4 * n_tpu
+    nodes = build_cluster(n_tpu).list("v1", "Node")
+    capacity = _capacity_chips(nodes)
+    tree = QuotaTree.from_config({"classes": [
+        {"name": "prod", "weight": 6.0, "minChips": max(4, capacity // 5),
+         "starvationBoundSeconds": 240},
+        {"name": "batch", "weight": 3.0, "preemptTokens": 16},
+        {"name": "research", "weight": 1.0,
+         "maxChips": max(16, capacity // 3), "preemptTokens": 16},
+    ]})
+
+    # the seeded tenant stream: batch-heavy, batch loudest (priority 2)
+    rng = random.Random(seed)
+    sizes = (4, 4, 8, 8, 16)
+    mix = (("batch", 2, 0.50), ("research", 1, 0.30), ("prod", 0, 0.20))
+    stream = []
+    for i in range(n_requests):
+        r, acc = rng.random(), 0.0
+        for cls, prio, share in mix:
+            acc += share
+            if r < acc:
+                break
+        chips = rng.choice(sizes)
+        cr = {
+            "apiVersion": "tpu.graft.dev/v1alpha1",
+            "kind": "SliceRequest",
+            "metadata": {
+                "name": f"fair-{i:05d}", "namespace": "bench",
+                "annotations": {L.QUOTA_CLASS: cls},
+                "creationTimestamp": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(1_700_000_000 + i)),
+            },
+            "spec": {"chips": chips, "priority": prio},
+        }
+        stream.append((f"bench/fair-{i:05d}", cr,
+                       SliceRequestSpec.from_obj(cr)))
+
+    n_waves = -(-n_requests // wave) + lifetime_waves + 8
+
+    def _drive(pol):
+        fleet = FleetState(nodes)
+        usage: Dict[str, int] = {}
+        backlog: list = []
+        live: Dict[int, list] = {}
+        samples: Dict[str, list] = {}
+        placed = 0
+        feed = iter(stream)
+        t0 = time.perf_counter()
+        for w in range(n_waves):
+            for nodes_used, cls, chips in live.pop(w - lifetime_waves, []):
+                fleet.release(node_names=nodes_used)
+                usage[cls] = usage.get(cls, 0) - chips
+            for _ in range(wave):
+                nxt = next(feed, None)
+                if nxt is not None:
+                    key, cr, spec = nxt
+                    backlog.append((key, cr, None, spec))
+            backlog.sort(key=lambda it: baseline_key(it[0], it[1], it[3]))
+            ordered = order_batch(backlog, pol, tree, usage=dict(usage))
+            backlog = []
+            for item in ordered:
+                key, cr, _live, spec = item
+                best = place(spec, fleet)
+                if best is None:
+                    backlog.append(item)
+                    continue
+                fleet.book(best.nodes, key)
+                cls = tree.class_of(cr)
+                usage[cls] = usage.get(cls, 0) + spec.chips_needed()
+                live.setdefault(w, []).append(
+                    (best.nodes, cls, spec.chips_needed()))
+                placed += 1
+            if w < lifetime_waves:
+                continue
+            demand = dict(usage)
+            for key, cr, _live, spec in backlog:
+                cls = tree.class_of(cr)
+                demand[cls] = demand.get(cls, 0) + spec.chips_needed()
+            shares = tree.shares(capacity, demand)
+            for cls, share in shares.items():
+                if share > 0 and demand.get(cls, 0) > 0:
+                    samples.setdefault(cls, []).append(
+                        usage.get(cls, 0) / share)
+        wall = time.perf_counter() - t0
+        attained = {cls: sum(v) / len(v) for cls, v in samples.items() if v}
+        xs = list(attained.values())
+        jain = (sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs))
+                if xs and any(xs) else 0.0)
+        return {
+            "jain_index": jain,
+            "attained_over_share": {k: round(v, 4)
+                                    for k, v in sorted(attained.items())},
+            "placed": placed,
+            "backlog_left": len(backlog),
+            "drain_rps": placed / wall if wall > 0 else 0.0,
+            "utilization": fleet.utilization(),
+        }
+
+    fair = _drive(policy)
+    base = _drive(POLICY_BASELINE)
+    return {
+        "n_tpu_nodes": n_tpu,
+        "n_requests": n_requests,
+        "capacity_chips": capacity,
+        "policy": policy,
+        "fairness_jain_index": fair["jain_index"],
+        "fairness_jain_baseline": base["jain_index"],
+        "saturation_drain_rps": fair["drain_rps"],
+        "drain_rps_baseline": base["drain_rps"],
+        "placed": fair["placed"],
+        "placed_baseline": base["placed"],
+        "throughput_vs_baseline": (fair["placed"] / base["placed"]
+                                   if base["placed"] else None),
+        "attained_over_share": fair["attained_over_share"],
+        "attained_over_share_baseline": base["attained_over_share"],
+    }

@@ -152,6 +152,7 @@ class FaultPlan:
             "operator-crash": cls._operator_crash,
             "apiserver-brownout": cls._apiserver_brownout,
             "chip-degrade": cls._chip_degrade,
+            "saturation-storm": cls._saturation_storm,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -375,6 +376,57 @@ class FaultPlan:
                     victim = rng.choice(candidates)
                     nodes.remove(victim)
                     out.append(Fault(step, NODE_REMOVE, arg=victim))
+        return out
+
+    @classmethod
+    def _saturation_storm(cls, rng, nodes, steps) -> List[Fault]:
+        """Fair-share admission at ~10x chip oversubscription: the
+        opportunist classes (``batch``/``research``) flood the fleet
+        first and soak every chip, then the min-guaranteed ``prod``
+        class arrives into a saturated cluster — at LOWER priority, so
+        only the deficit-clock watchdog (never the baseline sort) can
+        rescue it, via budgeted elastic preemption of the over-share
+        incumbents. A few rigid (``rreq-*``) opportunists verify the
+        drain routes around slices that cannot checkpoint. One seeded
+        operator crash lands mid-rescue: deficit clocks and budget
+        tokens must ride the snapshot, and the restart-coherent rerun
+        demands the same settled state as a never-crashed run. Node
+        capacity deliberately never changes — the fair-share math under
+        audit, not churn survival."""
+        out: List[Fault] = []
+        sizes = (4, 8, 8, 16, 16)
+        flood = max(24, 2 * len(nodes))
+        front = max(1, min(2, steps))
+        n = 0
+        for i in range(flood):
+            n += 1
+            qclass = "batch" if i % 3 else "research"
+            out.append(Fault(i % front, SLICE_REQUEST,
+                             arg=f"ereq-sat-{n:04d}@{qclass}",
+                             count=rng.choice(sizes),
+                             seconds=float(rng.randrange(1, 3))))
+        for _ in range(3):
+            n += 1
+            out.append(Fault(0, SLICE_REQUEST,
+                             arg=f"rreq-sat-{n:04d}@batch",
+                             count=rng.choice(sizes),
+                             seconds=float(rng.randrange(1, 3))))
+        prod_step = min(2, steps - 1)
+        for _ in range(max(4, len(nodes) // 10)):
+            n += 1
+            out.append(Fault(prod_step, SLICE_REQUEST,
+                             arg=f"ereq-sat-{n:04d}@prod",
+                             count=rng.choice((4, 8)),
+                             seconds=0.0))
+        if steps > prod_step + 3:
+            out.append(Fault(rng.randrange(prod_step + 2, steps - 1),
+                             OPERATOR_CRASH))
+        for step in range(steps):
+            if step % 3 == 2:
+                out.append(Fault(step, API_CONFLICT,
+                                 count=rng.randrange(2, 5)))
+            if step % 5 == 4:
+                out.append(Fault(step, WATCH_DROP))
         return out
 
     @classmethod

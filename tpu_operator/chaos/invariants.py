@@ -76,6 +76,25 @@ point*, not just at convergence:
   must cause zero evictions and a condemned one must never ping-pong
   the same slice off the same node twice. Checked in every scenario — a
   run that publishes no digests is a clean no-op.
+- ``no-starvation`` (when the runner hands over the scenario's quota
+  tree): a quota class with work queued and usage below its
+  min-guarantee floor (``min(minChips, usage + queued)`` — the same
+  floor the admission watchdog clocks) may stay starved for at most its
+  ``starvationBoundSeconds`` of virtual time before the deficit-driven
+  escalation and budgeted preemption must have rescued it. Folded
+  independently from CR phases and spec sizes, never from the
+  controller's own deficit clocks. Checked in every scenario — with no
+  quota tree the fold is a strict no-op, so legacy verdicts stay
+  byte-identical.
+- ``preemption-budget`` (same gating): preemptions are bounded AND
+  non-lethal. Each posted preempt intent (a ``status.migration`` with
+  ``preemptedFor`` and a fresh ``startedAt``) counts against the
+  victim's class inside a sliding ``preemptWindowSeconds`` window; more
+  events than the class's ``preemptTokens`` is a violation. And a
+  preemption must route through the elastic checkpoint->rebind
+  handshake — a ``status.evictions`` increment whose reason names a
+  preemption means a slice was killed for quota, the one thing the
+  budgeted path exists to prevent.
 - ``lane-priority`` (recorded by the runner): no health-lane event may
   be dequeued having waited behind more than the runner's
   ``LANE_PRIORITY_BUDGET`` bulk reconciles — the workload-aware
@@ -121,11 +140,17 @@ class Violation:
 
 class InvariantChecker:
     def __init__(self, client: Client, namespace: str = "tpu-operator",
-                 cache=None, journal=None):
+                 cache=None, journal=None, quota=None,
+                 step_dt: float = 1.0):
         self.client = client
         self.namespace = namespace
         self.cache = cache  # CachedClient under test, or None
         self.journal = journal  # state manager's SyncJournal, or None
+        # the scenario's QuotaTree (admission invariants), or None —
+        # with None the admission fold is a strict no-op, so every
+        # pre-quota scenario's verdict stays byte-identical
+        self.quota = quota
+        self.step_dt = step_dt  # virtual seconds per observation step
         self.violations: List[Violation] = []
         self._last_rv: Dict[Tuple[str, str, str], int] = {}
         self._unit_states: Dict[Tuple[str, ...], Optional[str]] = {}
@@ -149,6 +174,14 @@ class InvariantChecker:
         self._tel_ever: set = set()
         self._tel_evicted: Dict[Tuple[str, str], int] = {}
         self._tel_evictions: Dict[str, int] = {}
+        # admission fold: class -> step its starvation began; request
+        # key -> last migration startedAt counted as a preempt event;
+        # class -> event steps inside the sliding window; request key ->
+        # last evictions count (preemptions must never surface here)
+        self._starve_start: Dict[str, int] = {}
+        self._preempt_seen: Dict[str, object] = {}
+        self._preempt_events: Dict[str, List[int]] = {}
+        self._adm_evictions: Dict[str, int] = {}
 
     def on_operator_restart(self, step: int, cache=None,
                             journal=None) -> None:
@@ -184,7 +217,90 @@ class InvariantChecker:
         self._check_placement(step, nodes, settled=False)
         self._check_work(step)
         self._check_telemetry(step, nodes)
+        self._check_admission(step)
         self._feed_index(nodes)
+
+    # -- fair-share admission ------------------------------------------------
+
+    def _check_admission(self, step: int) -> None:
+        """no-starvation + preemption-budget (see module docstring).
+        The fold is the checker's OWN: per-class usage and queue depth
+        come straight from CR phases and spec sizes, the starvation
+        floor is recomputed from the tree — a watchdog whose deficit
+        clocks drift is caught rather than trusted."""
+        if self.quota is None:
+            return
+        from ..api.slicerequest import (
+            KIND_SLICE_REQUEST,
+            PHASE_PLACED,
+            V1ALPHA1,
+            SliceRequestSpec,
+        )
+        from ..controllers.slices import migration_of
+
+        dt = max(self.step_dt, 1e-9)
+        usage: Dict[str, int] = {}
+        queued: Dict[str, int] = {}
+        for req in sorted(self.client.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                          key=lambda r: (namespace_key(r), name_of(r))):
+            key = f"{namespace_key(req) or 'default'}/{name_of(req)}"
+            cls = self.quota.class_of(req)
+            if get_nested(req, "status", "phase") == PHASE_PLACED:
+                usage[cls] = usage.get(cls, 0) + int(
+                    get_nested(req, "status", "chips", default=0) or 0)
+            else:
+                queued[cls] = queued.get(cls, 0) + int(
+                    SliceRequestSpec.from_obj(req).chips_needed() or 0)
+            mig = migration_of(req)
+            started = mig.get("startedAt")
+            if mig.get("preemptedFor") and started is not None \
+                    and self._preempt_seen.get(key) != started:
+                # one event per posted preempt intent, charged to the
+                # VICTIM's class (the budget bounds what a class suffers)
+                self._preempt_seen[key] = started
+                self._preempt_events.setdefault(cls, []).append(step)
+            evictions = int(get_nested(req, "status", "evictions",
+                                       default=0) or 0)
+            prev = self._adm_evictions.get(key, 0)
+            self._adm_evictions[key] = evictions
+            if evictions > prev:
+                reason = str(get_nested(req, "status",
+                                        "lastEvictionReason",
+                                        default="") or "")
+                if reason.startswith("preempted"):
+                    self.record(
+                        "preemption-budget", step,
+                        f"{key}: hard-evicted for a preemption "
+                        f"({reason!r}) — quota reclaim must migrate "
+                        f"through the checkpoint handshake, never kill")
+        for name in self.quota.leaf_names():
+            qc = self.quota.get(name)
+            events = self._preempt_events.get(name)
+            if events:
+                horizon = step - qc.preempt_window_s / dt
+                events[:] = [s for s in events if s > horizon]
+                if len(events) > qc.preempt_tokens:
+                    self.record(
+                        "preemption-budget", step,
+                        f"class {name}: {len(events)} preemptions inside "
+                        f"one {qc.preempt_window_s:.0f}s window, budget "
+                        f"is {qc.preempt_tokens}")
+                    events.clear()  # one report per overrun, not per step
+            use = usage.get(name, 0)
+            q = queued.get(name, 0)
+            floor = min(qc.min_chips, use + q)
+            if not (q > 0 and use < floor):
+                self._starve_start.pop(name, None)
+                continue
+            start = self._starve_start.setdefault(name, step)
+            waited = (step - start) * dt
+            if waited > qc.starvation_bound_s:
+                self.record(
+                    "no-starvation", step,
+                    f"class {name}: {use}/{floor} min-guarantee chips "
+                    f"with {q} queued for {waited:.0f} virtual s — past "
+                    f"the {qc.starvation_bound_s:.0f}s starvation bound")
+                self._starve_start[name] = step  # re-arm, don't spam
 
     # -- telemetry eviction legality -----------------------------------------
 
@@ -682,6 +798,7 @@ class InvariantChecker:
         self._check_placement(step, nodes, settled=True)
         self._check_work(step)
         self._check_telemetry(step, nodes)
+        self._check_admission(step)
         self._check_index(step, nodes)
 
 

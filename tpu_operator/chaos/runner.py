@@ -117,14 +117,19 @@ SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
              "dag-race", "placement-contention", "placement-storm",
              "slice-migrate", "shard-failover", "operator-crash",
-             "apiserver-brownout", "chip-degrade")
+             "apiserver-brownout", "chip-degrade", "saturation-storm")
 
 # scenarios that run the placement controller (they create SliceRequests)
 PLACEMENT_SCENARIOS = ("placement-contention", "placement-storm",
-                       "slice-migrate", "operator-crash", "chip-degrade")
+                       "slice-migrate", "operator-crash", "chip-degrade",
+                       "saturation-storm")
 # scenarios whose elastic requests get workload shims (the training
 # jobs' half of the slice-intent protocol)
-SHIM_SCENARIOS = ("slice-migrate", "operator-crash", "chip-degrade")
+SHIM_SCENARIOS = ("slice-migrate", "operator-crash", "chip-degrade",
+                  "saturation-storm")
+# scenarios that crash the operator AND must reach the byte-identical
+# canonical settled state as a never-crashed run of the same seed
+RESTART_COHERENT_SCENARIOS = ("operator-crash", "saturation-storm")
 
 # virtual deadlines for the slice-migrate scenario, sized in runner steps
 # (STEP_DT each): long enough for the elastic handshake (~3 passes),
@@ -149,6 +154,27 @@ FAILOVER_SHARDS = 4      # shard count for the shard-failover scenario
 # the lane-priority invariant: no health-lane item may be dequeued having
 # waited behind more than this many bulk reconciles
 LANE_PRIORITY_BUDGET = 8
+
+
+def _saturation_quota(n_nodes: int) -> dict:
+    """The saturation-storm scenario's quota config, scaled to fleet
+    size. ``prod`` carries the min-guarantee (the floor self-caps at
+    live demand, so a generous value just means "rescue all of prod")
+    and zero preempt tokens — the guaranteed class is itself
+    preemption-exempt. The opportunists carry token budgets sized so
+    the whole rescue fits without exhausting a window: budget
+    EXHAUSTION mid-rescue would make the crashed run's outcome hinge
+    on one lost tick of token accounting, and the restart-coherent
+    check demands tick-for-tick-identical settled state."""
+    return {"classes": [
+        {"name": "prod", "weight": 6.0, "minChips": 2 * n_nodes,
+         "starvationBoundSeconds": 240},
+        {"name": "batch", "weight": 3.0, "preemptTokens": 16,
+         "preemptWindowSeconds": 600},
+        {"name": "research", "weight": 1.0,
+         "maxChips": max(32, 2 * n_nodes), "preemptTokens": 16,
+         "preemptWindowSeconds": 600},
+    ]}
 
 
 class _SyncController:
@@ -599,20 +625,27 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
                     pass
     elif kind == SLICE_REQUEST:
         # demand arrives: a user submits a SliceRequest. Chip count rides
-        # in ``count`` and priority in ``seconds`` (the plan's only free
-        # numeric slots); the placement controller picks it up from the
-        # ADDED watch event like any other client would.
-        if fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, fault.arg,
+        # in ``count``, priority in ``seconds`` (the plan's only free
+        # numeric slots), and an optional quota class suffixed onto the
+        # name as ``name@class`` (the saturation scenario's classed
+        # demand); the placement controller picks it up from the ADDED
+        # watch event like any other client would.
+        req_name, _, qclass = fault.arg.partition("@")
+        if fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, req_name,
                             NAMESPACE) is None:
-            fake.create(new_slice_request(
-                fault.arg,
+            obj = new_slice_request(
+                req_name,
                 spec=SliceRequestSpec(chips=fault.count,
                                       priority=int(fault.seconds)).to_obj(),
-                namespace=NAMESPACE))
+                namespace=NAMESPACE)
+            if qclass:
+                obj.setdefault("metadata", {}).setdefault(
+                    "annotations", {})[L.QUOTA_CLASS] = qclass
+            fake.create(obj)
             if chaos.clock is not None:
                 # birth time on the virtual clock: the denominator of
                 # the verdict's deterministic per-slice goodput rate
-                state.setdefault("req_created", {})[fault.arg] = \
+                state.setdefault("req_created", {})[req_name] = \
                     chaos.clock.t
             applied = True
     elif kind == SLICE_RESIZE:
@@ -1050,12 +1083,15 @@ def _run_scenario(scenario: str, nodes: int, seed: int,
     with _chaos_globals(scenario, seed) as clock:
         out = _run_scenario_impl(scenario, nodes, seed, steps, cached,
                                  clock)
-    if scenario == "operator-crash":
+    if scenario in RESTART_COHERENT_SCENARIOS:
         # restart-coherent: re-run the same seed with ONLY the crash
         # faults stripped — every other fault, request and clock tick
         # identical — and demand the byte-identical canonical settled
         # state. A crash changing what settled state the fleet reaches
-        # is exactly the bug class this scenario exists to catch.
+        # is exactly the bug class this scenario exists to catch. For
+        # the saturation scenario this also pins the snapshot-restored
+        # deficit clocks and budget tokens: a restart that re-ran or
+        # skipped a rescue would settle a different set of placements.
         with _chaos_globals(scenario, seed) as base_clock:
             base = _run_scenario_impl(scenario, nodes, seed, steps,
                                       cached, base_clock,
@@ -1106,6 +1142,31 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         # time out into the hard-drain degradation path
         upgrade_spec["migrationTimeoutSeconds"] = int(MIGRATION_TIMEOUT_S)
     fake.create(new_cluster_policy(spec={"upgradePolicy": upgrade_spec}))
+    # the saturation scenario runs under a quota tree (seeded as the
+    # production ConfigMap, so the controller exercises its own config
+    # loading) and the throughput-aware finish-time admission policy;
+    # every other scenario has no tree, so its admission layer — and
+    # its verdict — is byte-identical to before this plane existed
+    quota_tree = None
+    admission_policy = None
+    if scenario == "saturation-storm":
+        import json as _json
+
+        from ..scheduling.quota import (
+            QUOTA_CONFIG_KEY,
+            QUOTA_CONFIGMAP,
+            QuotaTree,
+        )
+
+        quota_doc = _saturation_quota(nodes)
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": QUOTA_CONFIGMAP,
+                         "namespace": NAMESPACE},
+            "data": {QUOTA_CONFIG_KEY: _json.dumps(quota_doc,
+                                                   sort_keys=True)}})
+        quota_tree = QuotaTree.from_config(quota_doc)
+        admission_policy = "finish-time"
     prec = ClusterPolicyReconciler(client=traced, namespace=NAMESPACE)
     urec = UpgradeReconciler(client=traced, namespace=NAMESPACE, now=clock)
     # the failover scenario runs sharded queues (kills rehash keys); every
@@ -1132,7 +1193,8 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
         lrec = PlacementReconciler(
             client=traced, namespace=NAMESPACE,
             preemption=(scenario == "placement-contention"),
-            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S)
+            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S,
+            admission_policy=admission_policy)
         place_ctrl = _SyncController(lrec, traced, clock, shards=shards,
                                      name="placement")
         lrec.setup_controller(place_ctrl, None)
@@ -1165,7 +1227,8 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
     resync = Request(name=POLICY)
     checker = InvariantChecker(fake, NAMESPACE,
                                cache=client if cached else None,
-                               journal=prec.state_manager.journal)
+                               journal=prec.state_manager.journal,
+                               quota=quota_tree, step_dt=STEP_DT)
     relists_lost = 0  # relists crashed processes performed, for the verdict
 
     def _enqueue_resync(c: _SyncController) -> None:
@@ -1251,13 +1314,20 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                                     name="upgrade")]
         lrec = PlacementReconciler(
             client=traced, namespace=NAMESPACE, preemption=False,
-            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S)
+            now=clock, resize_timeout=RESIZE_TIMEOUT_VIRTUAL_S,
+            admission_policy=admission_policy)
         if snap is not None:
             idx = snapshot_mod.restore_index(snap)
             if idx is not None:
                 # before any watch subscribes: the adopted index's delta
                 # listener then folds exactly the replayed delta
                 lrec.adopt_index(idx)
+            adm = snapshot_mod.restore_admission(snap)
+            if adm is not None:
+                # deficit clocks + preemption-budget tokens survive the
+                # crash: a restart must neither reset a starving class's
+                # clock nor refill a spent window
+                lrec.adopt_admission(adm)
             for skey, payload in snap.get("stores", {}).items():
                 if skey.endswith("/" + KIND_SLICE_REQUEST):
                     lrec.seed_requeue_state(payload.get("objects") or [])
@@ -1353,7 +1423,7 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                 if k.split("/", 1)[0] in ("SliceRequest",
                                           "TPUClusterPolicy",
                                           "UpgradeUnit")}
-        if scenario == "operator-crash":
+        if scenario in RESTART_COHERENT_SCENARIOS:
             out["restarts"] = {
                 "crashes": state.get("crashes", 0),
                 "restores": state.get("restores", []),
@@ -1361,6 +1431,14 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
             settled = canonical_settled_state(fake, NAMESPACE)
             out["settled_state"] = settled
             out["settled_digest"] = settled_state_digest(settled)
+        if scenario == "saturation-storm" and place_ctrl is not None:
+            # the fair-share ledger at settle: per-class usage, queue
+            # depth, shares, deficit clocks and remaining budget tokens
+            # — all virtual-clock reads, byte-identical per seed
+            try:
+                out["admission"] = place_ctrl.reconciler.admission_report()
+            except ApiError:
+                pass  # an unconsumed armed fault ate the report reads
         if scenario == "chip-degrade":
             out["telemetry"] = _telemetry_summary(fake, telemetry, state)
             out["goodput"] = _goodput_summary(fake, clock.t, state)
@@ -1467,7 +1545,9 @@ def _run_scenario_impl(scenario: str, nodes: int, seed: int,
                 snapshot_mod.capture(client, index=getattr(
                     place_ctrl.reconciler, "fleet_index", None)
                     if place_ctrl is not None else None,
-                    wall=clock()),
+                    wall=clock(),
+                    admission=place_ctrl.reconciler.admission_snapshot()
+                    if place_ctrl is not None else None),
                 sort_keys=True))
         checker.observe(step)
 

@@ -185,6 +185,21 @@ def gather(client, out_dir: pathlib.Path) -> dict:
     except Exception as e:
         summary["errors"].append(f"slo: {e}")
     try:
+        # the fair-share admission picture (the `tpuop-cfg quota -f`
+        # input). A bundle has no live AdmissionState, so deficit clocks
+        # render as unknown rather than fabricated zeros; shares/usage/
+        # queued still explain who is entitled to what
+        from ..scheduling.quota import quota_report
+
+        d = out_dir / "quota"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "quota.json").write_text(
+            json.dumps(quota_report(client, "tpu-operator"),
+                       indent=2, sort_keys=True))
+        summary["quota_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"quota: {e}")
+    try:
         # the informer-cache picture (/debug/cache equivalent): unwrap
         # the client stack the same way Manager.find_cache does
         inner, stats = client, None

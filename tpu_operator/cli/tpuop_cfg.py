@@ -841,6 +841,82 @@ def _slo(args) -> int:
     return 2 if breached else 0
 
 
+def render_quota_report(report: dict) -> str:
+    """The /debug/quota body as a table: one row per leaf class with its
+    weight, min/max bounds, live usage vs water-filled share, queued
+    demand, deficit clock vs starvation bound, and the remaining
+    preemption-budget tokens. A must-gather bundle carries no live
+    admission state, so deficit/token columns render as ``-`` there
+    rather than fabricated zeros."""
+    if not report.get("configured"):
+        return "no quota configured (admission layer is a no-op)"
+
+    def _n(v, unit=""):
+        return "-" if v is None else f"{v:g}{unit}"
+
+    lines = [f"policy: {report.get('policy', '')}   "
+             f"capacity: {report.get('capacityChips', 0)} chips"]
+    lines.append(
+        f"{'CLASS':<12s} {'W':>4s} {'MIN':>5s} {'MAX':>5s} {'USE':>5s}"
+        f" {'SHARE':>5s} {'QUEUED':>10s} {'DEFICIT':>12s} {'TOKENS':>6s}")
+    for row in report.get("classes") or []:
+        queued = f"{row.get('queuedChips', 0)}c" \
+                 f"/{row.get('queuedRequests', 0)}r"
+        bound = row.get("starvationBoundSeconds")
+        deficit = row.get("deficitSeconds")
+        dcol = "-" if deficit is None else (
+            f"{deficit:g}s/{_n(bound, 's')}")
+        tokens = row.get("tokensRemaining")
+        lines.append(
+            f"{row.get('class', ''):<12s} {row.get('weight', 0):>4g}"
+            f" {row.get('minChips', 0):>5d} {_n(row.get('maxChips')):>5s}"
+            f" {row.get('usageChips', 0):>5d} {row.get('shareChips', 0):>5d}"
+            f" {queued:>10s} {dcol:>12s} {_n(tokens):>6s}"
+            + ("  STARVING" if row.get("starving") else ""))
+    return "\n".join(lines)
+
+
+def _quota(args) -> int:
+    """Fetch the fair-share admission explainer from the manager's
+    /debug/quota (or a must-gather's quota/quota.json) and print the
+    per-class table; exit 2 when any class sits past its starvation
+    bound so the command scripts as a fairness probe."""
+    import pathlib
+    import urllib.request
+
+    if args.file:
+        path = pathlib.Path(args.file)
+        if path.is_dir():
+            # a must-gather bundle: the admission plane lives at a
+            # fixed relative path inside it
+            path = path / "quota" / "quota.json"
+        try:
+            report = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"cannot read quota report from {path}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        url = args.url.rstrip("/") + "/debug/quota"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                report = json.load(resp)
+        except Exception as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    if not isinstance(report, dict):
+        print("quota report payload is not an object", file=sys.stderr)
+        return 1
+    breached = sorted(str(c) for c in report.get("breached") or [])
+    if getattr(args, "output", "text") == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_quota_report(report))
+        if breached:
+            print("starving: " + ", ".join(breached))
+    return 2 if breached else 0
+
+
 def render_fleet_top(snapshot: dict) -> str:
     """The /debug/fleet body as a per-ICI-domain heatmap: one row per
     domain with its digest coverage, degraded-chip count, duty-cycle
@@ -1311,6 +1387,23 @@ def main(argv=None) -> int:
                     default="text")
     so.add_argument("--timeout", type=float, default=10.0)
 
+    qo = sub.add_parser(
+        "quota", help="fair-share admission explainer from /debug/quota "
+                      "(or a must-gather quota/quota.json): per-class "
+                      "usage vs water-filled share, queued demand, "
+                      "deficit clocks and preemption-budget tokens; "
+                      "exit 2 when any class is past its starvation "
+                      "bound")
+    qo.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="manager health endpoint base URL")
+    qo.add_argument("-f", "--file", default=None,
+                    help="read a quota.json dump (or a must-gather "
+                         "directory containing quota/quota.json) "
+                         "instead of fetching")
+    qo.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    qo.add_argument("--timeout", type=float, default=10.0)
+
     tp = sub.add_parser(
         "top", help="fleet telemetry heatmap from /debug/fleet (or a "
                     "must-gather's fleet/fleet.json): per-ICI-domain "
@@ -1387,6 +1480,8 @@ def main(argv=None) -> int:
         return _why(args)
     if args.cmd == "slo":
         return _slo(args)
+    if args.cmd == "quota":
+        return _quota(args)
     if args.cmd == "top":
         return _top(args)
     if args.cmd == "dag":

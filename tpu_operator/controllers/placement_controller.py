@@ -53,6 +53,20 @@ from ..api.slicerequest import (
 )
 from ..metrics.operator_metrics import OPERATOR_METRICS
 from ..runtime.timeline import TIMELINE
+from ..runtime.workqueue import Cause
+from ..scheduling.quota import (
+    _GEN_TFLOPS,
+    ADMISSION_GATE,
+    KIND_TPU_QUOTA,
+    POLICY_BASELINE,
+    QUOTA_CONFIGMAP,
+    AdmissionState,
+    QuotaTree,
+    baseline_key,
+    order_batch,
+    quota_report,
+)
+from ..scheduling.quota import V1ALPHA1 as QUOTA_API
 from ..runtime import (
     LANE_HEALTH,
     LANE_PLACEMENT,
@@ -155,7 +169,9 @@ class PlacementReconciler(Reconciler):
 
     def __init__(self, client, namespace: Optional[str] = None,
                  preemption: Optional[bool] = None,
-                 now=time.time, resize_timeout: float = RESIZE_TIMEOUT_S):
+                 now=time.time, resize_timeout: float = RESIZE_TIMEOUT_S,
+                 quota: Optional[QuotaTree] = None,
+                 admission_policy: Optional[str] = None):
         self.client = client
         self.namespace = namespace or os.environ.get(
             "OPERATOR_NAMESPACE", "tpu-operator")
@@ -163,6 +179,23 @@ class PlacementReconciler(Reconciler):
                            else preemption)
         self.now = now
         self.resize_timeout = resize_timeout
+        # fair-share admission: an injected QuotaTree wins; None means
+        # load the TPUQuota CRD / tpu-operator-quota ConfigMap per gang
+        # pass (rides the informer cache — no config means a strict
+        # no-op and the legacy pass, byte for byte)
+        self.quota = quota
+        self.admission_policy = admission_policy
+        # deficit clocks + preemption-budget buckets; snapshot-persisted
+        # (schema v3) so a crash never resets starvation accounting
+        self._admission = AdmissionState()
+        # starvation watchdog -> workqueue health-lane promotion; wired
+        # by setup_controller, absent in library/bench use
+        self._escalate_fn = None
+        # quota config memo keyed on resourceVersion, and the virtual
+        # timestamp of the last admission pass (a gang pass at the same
+        # instant would re-derive the identical decisions)
+        self._quota_cache = None
+        self._admission_last_pass = None
         # place-and-bind is read-rank-annotate: serialized so N workers
         # placing different requests can't both observe a node as free
         self._bind_lock = threading.Lock()
@@ -203,6 +236,59 @@ class PlacementReconciler(Reconciler):
         OPERATOR_METRICS.placement_index_updates.labels(
             event="adopt").inc()
 
+    def admission_snapshot(self) -> dict:
+        """JSON-safe admission state (deficit clocks, token buckets) for
+        the durable snapshot's ``admission`` section."""
+        return self._admission.to_dict()
+
+    def adopt_admission(self, doc: Optional[dict]) -> None:
+        """Warm-restore: adopt snapshot-persisted admission state so a
+        restart resumes mid-deficit instead of resetting every class's
+        starvation clock to zero."""
+        self._admission = AdmissionState.from_dict(doc)
+
+    def admission_report(self) -> dict:
+        """The live quota explainer (CLI ``tpuop-cfg quota --url``,
+        ``/debug/quota``): the shared report with THIS process's deficit
+        clocks and token buckets folded in."""
+        tree = self.quota if self.quota is not None \
+            else QuotaTree.load(self.client, self.namespace)
+        return quota_report(self.client, self.namespace, tree=tree,
+                            state=self._admission,
+                            policy=self._policy(), now=self.now)
+
+    def _policy(self) -> str:
+        return self.admission_policy or ADMISSION_GATE.policy
+
+    def _quota_tree(self) -> Optional[QuotaTree]:
+        """Per-pass quota lookup, memoized on config resourceVersion:
+        the common case (config unchanged) costs two cache reads, not a
+        JSON parse and tree rebuild on every gang pass."""
+        if self.quota is not None:
+            return self.quota
+        key: tuple = ()
+        try:
+            key += tuple(sorted(
+                (name_of(o) or "",
+                 str(get_nested(o, "metadata", "resourceVersion")))
+                for o in self.client.list(QUOTA_API, KIND_TPU_QUOTA)))
+        except Exception:
+            pass
+        try:
+            cm = self.client.get_or_none("v1", "ConfigMap",
+                                         QUOTA_CONFIGMAP, self.namespace)
+        except Exception:
+            cm = None
+        if cm is not None:
+            key += (("cm", str(get_nested(cm, "metadata",
+                                          "resourceVersion"))),)
+        cached = self._quota_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        tree = QuotaTree.load(self.client, self.namespace)
+        self._quota_cache = (key, tree)
+        return tree
+
     def seed_requeue_state(self, requests: Iterable[dict]) -> int:
         """Warm-restore hook: pre-seed the in-memory backoff counters
         from the ``status.requeueAttempts`` a previous process
@@ -233,6 +319,14 @@ class PlacementReconciler(Reconciler):
                          predicate=_node_placement_changed,
                          mapper=self._enqueue_all_requests,
                          lane=LANE_HEALTH)
+        # starvation watchdog: a starving class's queued requests jump
+        # the placement/bulk churn via the queue's escalate path (any
+        # controller stand-in without one still promotes through add)
+        esc = getattr(controller, "escalate", None)
+        if esc is None:
+            def esc(req, cause=None, _c=controller):
+                _c.add(req, lane=LANE_HEALTH, cause=cause)
+        self._escalate_fn = esc
 
     def _enqueue_all_requests(self, event: WatchEvent) -> Iterable[Request]:
         for cr in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
@@ -293,6 +387,8 @@ class PlacementReconciler(Reconciler):
                                 L.PLACED_BY: None}}})
                 res = self._reap_expired_migration(cr, live)
                 if res is None:
+                    res = self._complete_preemption(cr, live, key)
+                if res is None:
                     res = self._maybe_resize(cr, live, spec, key)
                 self._export_gauges(nodes)
                 return res if res is not None else Result()
@@ -336,16 +432,24 @@ class PlacementReconciler(Reconciler):
         # their backoff (they observe Unschedulable).
         with self._bind_lock:
             engine = self._fleet_snapshot()
+            tree = self._quota_tree()
             if PLACEMENT_INDEX_GATE.enabled:
-                batch = self._drain_batch(key, cr, live, spec)
+                batch = self._drain_batch(key, cr, live, spec, tree=tree)
             else:
                 batch = [(key, cr, live, spec)]
+            batch = self._admission_order(batch, tree, engine)
             OPERATOR_METRICS.placement_batch_size.set(len(batch))
             my_result = Result()
             for bkey, bcr, blive, bspec in batch:
-                res = self._place_one(bkey, bcr, blive, bspec, engine)
+                res = self._place_one(bkey, bcr, blive, bspec, engine,
+                                      tree=tree)
                 if bkey == key:
                     my_result = res
+            if tree is not None:
+                # watchdog: advance deficit clocks, fire the starvation
+                # gauges, escalate starving classes and reclaim their
+                # min-guarantee via budgeted elastic preemption
+                self._admission_pass(tree, engine)
             self._export_gauges(None, fleet=engine)
         return my_result
 
@@ -390,31 +494,82 @@ class PlacementReconciler(Reconciler):
             event=str(event_type).lower()).inc()
 
     def _drain_batch(self, key: str, cr: dict, live: dict,
-                     spec: SliceRequestSpec) -> list:
+                     spec: SliceRequestSpec,
+                     tree: Optional[QuotaTree] = None) -> list:
         """The gang for this pass: every Pending/new SliceRequest
         visible now, ordered by priority (desc), age, key. Unschedulable
         siblings keep their own backoff cadence — re-scoring them on
         every sibling's pass would defeat it — but the triggering
-        request always rides, whatever its phase."""
+        request always rides, whatever its phase, and so does any
+        Unschedulable request of a STARVING class: between a
+        preemption's lease release and the victim's own rebind there
+        may be exactly one pass, and the starving class must be in it
+        to claim the freed nodes (its backoff retry would arrive after
+        the victim took them back)."""
         batch = {key: (cr, live, spec)}
         for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
             okey = f"{namespace_of(other) or 'default'}/{name_of(other)}"
             if okey in batch:
                 continue
-            if get_nested(other, "status", "phase") in (
-                    PHASE_PLACED, PHASE_UNSCHEDULABLE):
+            phase = get_nested(other, "status", "phase")
+            if phase == PHASE_PLACED:
+                continue
+            if phase == PHASE_UNSCHEDULABLE and not (
+                    tree is not None
+                    and self._admission.deficit_since
+                    and tree.class_of(other)
+                    in self._admission.deficit_since):
                 continue
             ocr = thaw_obj(other)
             batch[okey] = (ocr, other, SliceRequestSpec.from_obj(ocr))
 
+        # priority desc, then PARSED creation epoch, then (ns, name):
+        # the raw-string compare broke total order as soon as two API
+        # clients serialized timestamps differently (clock skew in
+        # disguise) — baseline_key is deterministic under skew
         def order(item):
             k, (c, _unused, s) = item
-            return (-int(s.priority or 0),
-                    str(get_nested(c, "metadata", "creationTimestamp",
-                                   default="") or ""), k)
+            return baseline_key(k, c, s)
 
         return [(k, c, l, s)
                 for k, (c, l, s) in sorted(batch.items(), key=order)]
+
+    def _admission_order(self, batch: list, tree: Optional[QuotaTree],
+                         engine) -> list:
+        """Apply the selected admission policy to the gang batch. The
+        baseline policy (or no quota tree) returns the batch UNCHANGED —
+        the kill-switch guarantee the parity tests pin."""
+        policy = self._policy()
+        if tree is None or policy == POLICY_BASELINE or len(batch) <= 1:
+            return batch
+        usage = usage_tflops = None
+        if isinstance(engine, FleetIndex):
+            self._register_owner_classes(tree, engine)
+            usage = engine.class_usage()
+            usage_tflops = engine.class_tflops()
+        else:
+            usage = {}
+            for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                if get_nested(other, "status", "phase") != PHASE_PLACED:
+                    continue
+                cls = tree.class_of(other)
+                usage[cls] = usage.get(cls, 0) + int(
+                    get_nested(other, "status", "chips", default=0) or 0)
+        dominant = max(
+            (_GEN_TFLOPS.get(gen, 1.0)
+             for gen in engine.chip_totals()), default=1.0)
+        return order_batch(batch, policy, tree, usage=usage,
+                           usage_tflops=usage_tflops,
+                           dominant_tflops=dominant)
+
+    def _register_owner_classes(self, tree: QuotaTree,
+                                engine: FleetIndex) -> None:
+        """Teach the index which quota class each lease owner draws
+        from, so per-class usage folds O(delta) with the leases
+        (set_owner_class no-ops on unchanged owners)."""
+        for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+            okey = f"{namespace_of(other) or 'default'}/{name_of(other)}"
+            engine.set_owner_class(okey, tree.class_of(other))
 
     def _best_for(self, spec: SliceRequestSpec, key: str, engine):
         if isinstance(engine, FleetIndex):
@@ -423,9 +578,12 @@ class PlacementReconciler(Reconciler):
         return ranked[0] if ranked else None
 
     def _place_one(self, key: str, cr: dict, live: dict,
-                   spec: SliceRequestSpec, engine) -> Result:
+                   spec: SliceRequestSpec, engine,
+                   tree: Optional[QuotaTree] = None) -> Result:
         """One request's placement decision against the pass's shared
-        snapshot. Caller holds the bind lock."""
+        snapshot. Caller holds the bind lock. With a quota tree active,
+        the legacy hard-evict preemption is superseded by the budgeted
+        elastic path (_preempt_budgeted) — victims migrate, never die."""
         import time as _time
 
         from ..runtime.tracing import TRACER
@@ -433,7 +591,7 @@ class PlacementReconciler(Reconciler):
         t0 = _time.perf_counter()
         with TRACER.trace("placement.score", key):
             best = self._best_for(spec, key, engine)
-        if best is None and self.preemption \
+        if best is None and self.preemption and tree is None \
                 and self._preempt(spec, key, engine):
             # bind in THIS pass: requeueing instead would let the
             # victims re-place onto the freed nodes before we run
@@ -447,6 +605,21 @@ class PlacementReconciler(Reconciler):
             reason = engine.unschedulable_reason(spec) \
                 if isinstance(engine, FleetIndex) \
                 else unschedulable_reason(spec, engine)
+            from .slices import clear_intent, migration_of
+            mig = migration_of(cr)
+            if mig.get("intent") == INTENT_MIGRATE \
+                    and mig.get("preemptedFor") \
+                    and mig.get("phase") in (MIG_MIGRATING,
+                                             MIG_CHECKPOINTED):
+                # a preempted slice that cannot re-place right now parks
+                # Unschedulable with the handshake closed; its durable
+                # checkpoint restores whenever capacity returns
+                mig["phase"] = MIG_ABORTED
+                mig["reason"] = "preempted; no replacement capacity yet"
+                set_nested(cr, mig, "status", "migration")
+                clear_intent(self.client, cr)
+                OPERATOR_METRICS.slice_migrations.labels(
+                    outcome="aborted").inc()
             set_nested(cr, PHASE_UNSCHEDULABLE, "status", "phase")
             set_nested(cr, [], "status", "nodes")
             set_nested(cr, reason, "status", "reason")
@@ -491,6 +664,22 @@ class PlacementReconciler(Reconciler):
                 "v1", "Node", n,
                 {"metadata": {"annotations": {L.PLACED_BY: key}}})
         engine.book(best.nodes, key)
+        from .slices import clear_intent, migration_of
+        mig = migration_of(cr)
+        if mig.get("intent") == INTENT_MIGRATE \
+                and mig.get("preemptedFor") \
+                and mig.get("phase") == MIG_CHECKPOINTED:
+            # budgeted preemption completing: the victim re-binds onto
+            # new capacity with its acked checkpoint intact — the elastic
+            # shim restores from ackedStep and resumes (never dies)
+            mig["phase"] = MIG_REBOUND
+            mig["to"] = sorted(best.nodes)
+            set_nested(cr, mig, "status", "migration")
+            clear_intent(self.client, cr)
+            OPERATOR_METRICS.slice_migrations.labels(
+                outcome="preempted").inc()
+        if tree is not None and isinstance(engine, FleetIndex):
+            engine.set_owner_class(key, tree.class_of(cr))
         set_nested(cr, PHASE_PLACED, "status", "phase")
         set_nested(cr, sorted(best.nodes), "status", "nodes")
         set_nested(cr, best.pool, "status", "pool")
@@ -541,6 +730,230 @@ class PlacementReconciler(Reconciler):
                         "migration deadline exceeded; hard drain",
                         outcome="timeout")
         return Result()
+
+    def _complete_preemption(self, cr: dict, live: dict,
+                             key: str) -> Optional[Result]:
+        """Drive a budgeted preemption handshake on a sound Placed
+        binding. MIGRATING waits for the workload's checkpoint ack (the
+        reaper aborts it past the deadline); CHECKPOINTED releases the
+        binding — the durable checkpoint is acked, so the slice re-enters
+        the gang pass and *migrates* onto fair-share capacity. The
+        release rides ``status.migrations`` (not an eviction): a
+        preempted slice never dies."""
+        from .slices import migration_of
+
+        mig = migration_of(cr)
+        if mig.get("intent") != INTENT_MIGRATE \
+                or not mig.get("preemptedFor"):
+            return None
+        phase = mig.get("phase")
+        if phase == MIG_MIGRATING:
+            return Result(requeue_after=REQUEUE_RESIZE_S)
+        if phase != MIG_CHECKPOINTED:
+            return None
+        self._release_leases(key)
+        set_nested(cr, PHASE_PENDING, "status", "phase")
+        set_nested(cr, [], "status", "nodes")
+        set_nested(cr, int(get_nested(cr, "status", "migrations",
+                                      default=0) or 0) + 1,
+                   "status", "migrations")
+        update_status_with_retry(self.client, cr, live=live)
+        OPERATOR_METRICS.placement_decisions.labels(
+            outcome="preempted").inc()
+        if TIMELINE.enabled:
+            TIMELINE.record("SliceRequest", key, "preempted",
+                            {"controller": self.name,
+                             "for": str(mig.get("preemptedFor"))})
+        log.info("request %s preempted for class %s (checkpoint acked)",
+                 key, mig.get("preemptedFor"))
+        self._nudge_starving(str(mig.get("preemptedFor")))
+        return Result(requeue=True)
+
+    def _nudge_starving(self, fcls: str) -> None:
+        """A preemption just released its leases: put the class it was
+        reclaimed FOR back on the health lane NOW. The health lane pops
+        before the victim's own bulk requeue, so the starving class
+        claims the freed nodes instead of losing the race and watching
+        the victim re-place onto them (preemption ping-pong)."""
+        if self._escalate_fn is None:
+            return
+        try:
+            tree = self._quota_tree()
+            if tree is None:
+                return
+            cause = Cause(reason="preemption-complete",
+                          origin=f"class/{fcls}")
+            for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                if get_nested(other, "status", "phase") == PHASE_PLACED:
+                    continue
+                if tree.class_of(other) != fcls:
+                    continue
+                self._escalate_fn(
+                    Request(name=name_of(other),
+                            namespace=namespace_of(other) or "default"),
+                    cause=cause)
+        except Exception:
+            # admission is best-effort: a nudge must never fail the
+            # victim's own status transition
+            log.debug("starvation nudge for class %s failed", fcls,
+                      exc_info=True)
+
+    def _admission_pass(self, tree: QuotaTree, engine) -> None:
+        """The starvation watchdog, run once per gang pass under the
+        bind lock: advance every leaf's deficit clock, export the
+        admission gauges, escalate a starving class's queued requests
+        onto the health lane, and reclaim its min-guarantee through
+        budget-bounded elastic preemption of over-share classes."""
+        from .slices import migration_of
+
+        now = self.now()
+        if now == self._admission_last_pass:
+            # gang passes at the same instant (a drained batch under a
+            # virtual clock) would re-derive identical decisions —
+            # observe/escalate/preempt are all keyed on `now`
+            return
+        self._admission_last_pass = now
+        usage: dict = {}
+        queued: dict = {}
+        queued_keys: dict = {}
+        queued_sizes: dict = {}
+        pending_reclaim: dict = {}
+        placed: list = []
+        for other in self.client.list(V1ALPHA1, KIND_SLICE_REQUEST):
+            okey = f"{namespace_of(other) or 'default'}/{name_of(other)}"
+            cls = tree.class_of(other)
+            if get_nested(other, "status", "phase") == PHASE_PLACED:
+                chips = int(get_nested(other, "status", "chips",
+                                       default=0) or 0)
+                usage[cls] = usage.get(cls, 0) + chips
+                mig = migration_of(other)
+                if mig.get("intent") == INTENT_MIGRATE \
+                        and mig.get("preemptedFor") \
+                        and mig.get("phase") in (MIG_MIGRATING,
+                                                 MIG_CHECKPOINTED):
+                    # in-flight reclaim: counts toward the starving
+                    # class so back-to-back passes never double-preempt
+                    fcls = str(mig.get("preemptedFor"))
+                    pending_reclaim[fcls] = (
+                        pending_reclaim.get(fcls, 0) + chips)
+                else:
+                    placed.append((okey, other, cls, chips))
+            else:
+                ospec = SliceRequestSpec.from_obj(other)
+                size = int(ospec.chips_needed() or 0)
+                queued[cls] = queued.get(cls, 0) + size
+                queued_keys.setdefault(cls, []).append(okey)
+                queued_sizes.setdefault(cls, []).append(size)
+        deficits = self._admission.observe(tree, usage, queued, now)
+        capacity = sum(b["free"] + b["placed"]
+                       for b in engine.chip_totals().values())
+        demand = {n: usage.get(n, 0) + queued.get(n, 0)
+                  for n in tree.leaf_names()}
+        shares = tree.shares(int(capacity), demand)
+        for name in tree.leaf_names():
+            qc = tree.get(name)
+            lbl = {"class": name}
+            OPERATOR_METRICS.admission_starvation_seconds.labels(
+                **lbl).set(deficits.get(name, 0.0))
+            OPERATOR_METRICS.admission_share.labels(
+                **lbl).set(shares.get(name, 0))
+            OPERATOR_METRICS.preemption_budget_remaining.labels(
+                **lbl).set(self._admission.remaining(qc, now))
+        for name in sorted(deficits):
+            # a running deficit clock (anchored this pass or earlier)
+            # marks the class starving — rescue starts immediately, not
+            # one pass late when elapsed seconds turn nonzero
+            if name not in self._admission.deficit_since:
+                continue
+            qc = tree.get(name)
+            # escalate BEFORE the bound: the whole point is to rescue
+            # the class while the deficit clock still has runway
+            if self._escalate_fn is not None:
+                cause = Cause(reason="admission-starvation",
+                              origin=f"class/{name}")
+                for okey in sorted(queued_keys.get(name, [])):
+                    ns, _, nm = okey.partition("/")
+                    self._escalate_fn(Request(name=nm, namespace=ns),
+                                      cause=cause)
+            use = usage.get(name, 0)
+            floor = min(qc.min_chips, use + queued.get(name, 0))
+            needed = floor - use - pending_reclaim.get(name, 0)
+            if needed > 0:
+                self._preempt_budgeted(name, needed, tree, shares,
+                                       usage, placed, now,
+                                       targets=queued_sizes.get(name))
+
+    def _preempt_budgeted(self, for_cls: str, needed: int,
+                          tree: QuotaTree, shares: dict, usage: dict,
+                          placed: list, now: float,
+                          targets: Optional[list] = None) -> int:
+        """Post MIGRATE intents (stamped ``preemptedFor``) at Placed
+        requests of other classes until ``needed`` chips are in flight
+        back to the starving class. Victims sitting over their fair
+        share drain first; under-share victims are still eligible (a
+        fragmented fleet can leave every class under its nominal share
+        while a min-guarantee goes unmet — the min outranks the soft
+        share), but no drain ever pushes a victim class below its OWN
+        min-guarantee floor. Every victim costs its class one
+        preemption-budget token — an exhausted window stops the drain
+        cold — and every victim rides the full checkpoint->rebind
+        handshake. Returns chips reclaimed (in flight)."""
+        from .slices import migration_of, post_intent
+
+        cands = []
+        for okey, other, vcls, chips in placed:
+            if vcls == for_cls or chips <= 0:
+                continue
+            if tree.get(vcls).preempt_tokens <= 0:
+                continue  # preemption-exempt class
+            if annotations_of(other).get(L.SLICE_ELASTIC) == "false":
+                continue  # cannot checkpoint: never hard-kill for quota
+            if migration_of(other).get("phase") in (MIG_MIGRATING,
+                                                    MIG_CHECKPOINTED):
+                continue  # already mid-handshake
+            over = usage.get(vcls, 0) - shares.get(vcls, 0)
+            prio = int(SliceRequestSpec.from_obj(other).priority or 0)
+            cands.append((-over, prio, okey, other, vcls, chips))
+        cands.sort(key=lambda v: (v[0], v[1], v[2]))
+        # shape-matched drain: serve the starving class's queued slice
+        # sizes smallest-first, each by ONE victim at least that large.
+        # Chip-count greed is shape-blind — two 4-chip fragments freed
+        # on different pools can never host an 8-chip slice, so blind
+        # accumulation churns victims (and burns tokens) for nothing.
+        goals = sorted(t for t in (targets or []) if t > 0) or [needed]
+        reclaimed = 0
+        drained: dict = {}
+        used = set()
+        for goal in goals:
+            if reclaimed >= needed:
+                break
+            for i, (_over, _prio, okey, other, vcls, chips) in \
+                    enumerate(cands):
+                if i in used or chips < goal:
+                    continue
+                vqc = tree.get(vcls)
+                vfloor = min(vqc.min_chips, usage.get(vcls, 0))
+                if (usage.get(vcls, 0) - drained.get(vcls, 0) - chips
+                        < vfloor):
+                    continue  # would push the victim below ITS floor
+                if not self._admission.take_token(vqc, now):
+                    continue  # window budget exhausted for this class
+                vcr = thaw_obj(other)
+                post_intent(self.client, vcr, other, INTENT_MIGRATE,
+                            now + self.resize_timeout, now,
+                            extra={"preemptedFor": for_cls})
+                used.add(i)
+                drained[vcls] = drained.get(vcls, 0) + chips
+                reclaimed += chips
+                if TIMELINE.enabled:
+                    TIMELINE.record("SliceRequest", okey,
+                                    "preempt-intent",
+                                    {"controller": self.name,
+                                     "for": for_cls})
+                log.info("posted preempt intent at %s (class %s) for "
+                         "starving class %s", okey, vcls, for_cls)
+                break
+        return reclaimed
 
     def _maybe_resize(self, cr: dict, live: dict, spec: SliceRequestSpec,
                       key: str) -> Optional[Result]:

@@ -332,6 +332,25 @@ class OperatorMetrics:
             "Acked workload steps classified against the goodput bar "
             "(good = at or above the degraded threshold ratio)",
             labelnames=("quality",))
+        # fair-share admission plane (scheduling/quota.py + the
+        # placement gang pass): per-class deficit clocks, computed fair
+        # shares, and the preemption-budget buckets — the observables
+        # behind the no-starvation and preemption-budget invariants
+        self.admission_starvation_seconds = g(
+            "tpu_operator_admission_starvation_seconds",
+            "Seconds a quota class has sat below its min-guarantee "
+            "floor with work queued (its starvation deficit clock)",
+            labelnames=("class",))
+        self.admission_share = g(
+            "tpu_operator_admission_share",
+            "Fair-share chips computed for a quota class by the "
+            "weighted water-fill over current demand",
+            labelnames=("class",))
+        self.preemption_budget_remaining = g(
+            "tpu_operator_preemption_budget_remaining",
+            "Preemption-budget tokens a quota class has left in the "
+            "current window (preemptions the class may still suffer)",
+            labelnames=("class",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

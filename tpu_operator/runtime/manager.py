@@ -294,6 +294,22 @@ class Controller:
                              "lane": lane or "bulk"},
                             causes=(cause,))
 
+    def escalate(self, req: Request,
+                 cause: Optional[Cause] = None) -> None:
+        """Promote ``req`` onto the health lane wherever it currently
+        sits (queued, delayed-behind-a-backoff, in flight, or absent) —
+        the admission starvation watchdog's escalation entry. Rides
+        :meth:`WorkQueue.escalate` so the promotion is counted."""
+        with self._shard_lock:
+            fresh = self._queue_for_locked(req).escalate(req, cause=cause)
+        if fresh and cause is not None and self.timeline_kind is not None:
+            from .timeline import TIMELINE
+
+            TIMELINE.record(self.timeline_kind, str(req), "enqueue",
+                            {"controller": self.name,
+                             "lane": "health"},
+                            causes=(cause,))
+
     def _requeue_after(self, req: Request, delay: float,
                        cause: Optional[Cause] = None) -> None:
         with self._shard_lock:
@@ -612,6 +628,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               sort_keys=True).encode()
             code = 200
             ctype = "application/json"
+        elif url.path == "/debug/quota":
+            import json
+
+            rec = self.manager.find_admission()
+            if rec is None:
+                body = b'{"configured": false, "classes": []}'
+            else:
+                body = json.dumps(rec.admission_report(),
+                                  sort_keys=True).encode()
+            code = 200
+            ctype = "application/json"
         elif url.path == "/debug/slo":
             import json
 
@@ -700,6 +727,20 @@ class Manager:
                 return c
             c = getattr(c, "inner", None)
             hops += 1
+        return None
+
+    def find_admission(self):
+        """The reconciler carrying the admission layer (anything with an
+        ``admission_report``), if any controller holds one — wrappers
+        are unwrapped via their ``inner`` links, same as find_cache (the
+        /debug/quota and ``tpuop-cfg quota --url`` source)."""
+        for ctrl in self.controllers:
+            r, hops = getattr(ctrl, "reconciler", None), 0
+            while r is not None and hops < 8:
+                if callable(getattr(r, "admission_report", None)):
+                    return r
+                r = getattr(r, "inner", None)
+                hops += 1
         return None
 
     @staticmethod

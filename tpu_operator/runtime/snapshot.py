@@ -44,8 +44,10 @@ logger = logging.getLogger("tpu_operator.snapshot")
 #: stamp is a corrupt snapshot, not a best-effort parse. v2: arrays are
 #: wrapped on disk (see ``_wrap_lists``) so the loader freezes the whole
 #: tree during the C-driven JSON parse — restore pays no per-object
-#: freeze walk.
-SCHEMA_VERSION = 2
+#: freeze walk. v3: optional ``admission`` section (per-class deficit
+#: clocks + preemption-budget buckets) so a crash never resets
+#: starvation accounting.
+SCHEMA_VERSION = 3
 
 SNAPSHOT_PREFIX = "snapshot-"
 SNAPSHOT_SUFFIX = ".json"
@@ -103,7 +105,8 @@ def _split_gvk(key: str) -> tuple:
 
 
 def capture(cached, index=None, now: Optional[Callable[[], float]] = None,
-            wall: Optional[float] = None) -> dict:
+            wall: Optional[float] = None,
+            admission: Optional[dict] = None) -> dict:
     """Distill the live cache (and optionally the placement index) into
     one JSON-serializable snapshot dict. Objects are thawed copies —
     the snapshot must not alias the live frozen stores once serialized.
@@ -146,6 +149,10 @@ def capture(cached, index=None, now: Optional[Callable[[], float]] = None,
     }
     if index is not None:
         snap["index_nodes"] = [thaw_obj(n) for n in index.export_nodes()]
+    if admission is not None:
+        # the placement controller's admission_snapshot(): deficit
+        # clocks and preemption-budget token buckets, JSON scalars only
+        snap["admission"] = thaw_obj(admission)
     return snap
 
 
@@ -212,6 +219,17 @@ def restore_index(snap, index_cls=None):
 
         index_cls = FleetIndex
     return index_cls(freeze_obj(n) for n in nodes)
+
+
+def restore_admission(snap) -> Optional[dict]:
+    """The snapshot's admission section (deficit clocks + budget
+    buckets) as a plain dict, or None when the snapshot predates it or
+    carries garbage — a bad section degrades to fresh accounting, never
+    a crash."""
+    doc = snap.get("admission")
+    if not isinstance(doc, dict):
+        return None
+    return thaw_obj(doc)
 
 
 # -- durable persistence --------------------------------------------------
@@ -365,6 +383,7 @@ def snapshot_metadata(directory: Optional[str],
             "objects": {key: len(dump.get("objects", ()))
                         for key, dump in sorted(snap["stores"].items())},
             "has_index": "index_nodes" in snap,
+            "has_admission": "admission" in snap,
         }
     marker = os.path.join(directory, RESTORE_MARKER)
     try:

@@ -242,6 +242,9 @@ class WorkQueue:
         # counter) runs under the queue lock — it must stay cheap.
         self.coalesced_total = 0
         self.on_coalesced = on_coalesced
+        # lane escalations served via escalate() — the admission
+        # starvation watchdog's deficit-driven promotions
+        self.escalations_total = 0
 
     @staticmethod
     def _resolve_lane(lane: Optional[str]) -> str:
@@ -334,6 +337,18 @@ class WorkQueue:
                 return False
             self._enqueue_locked(item, lane, time.monotonic())
             return True
+
+    def escalate(self, item: Any, cause: Any = None) -> bool:
+        """Promote-or-enqueue the item onto the health lane. The
+        starvation watchdog's entry point (deficit-driven lane
+        escalation): a queued item moves ahead of placement/bulk churn
+        via :meth:`add`'s lane-promotion path, an in-flight item gets
+        its re-run marked health-urgent, an absent item is enqueued
+        fresh. Returns :meth:`add`'s fresh-work verdict. No-ops lane
+        routing (but still enqueues) when the lane gate is off."""
+        with self._cond:
+            self.escalations_total += 1
+        return self.add(item, lane=LANE_HEALTH, cause=cause)
 
     def add_after(self, item: Any, delay: float,
                   lane: Optional[str] = None, cause: Any = None) -> None:

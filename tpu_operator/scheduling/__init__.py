@@ -1,0 +1,15 @@
+"""Fair-share admission over the placement plane (Gavel-style policies)."""
+
+from .quota import (  # noqa: F401
+    ADMISSION_GATE,
+    DEFAULT_CLASS,
+    POLICIES,
+    POLICY_BASELINE,
+    AdmissionState,
+    QuotaClass,
+    QuotaTree,
+    baseline_key,
+    env_admission_policy,
+    order_batch,
+    quota_report,
+)

@@ -132,6 +132,11 @@ class FleetIndex:
         # reentrant because the scoring path re-enters free_runs/slices
         self._lock = threading.RLock()
         self.updates: Dict[str, int] = {}  # event kind -> applied count
+        # fair-share admission registry: owner key -> quota-class name.
+        # Registered by the placement controller at bind/adopt time and
+        # deliberately NOT reset by replace()/resync() — a relist heal
+        # must not wipe what the controller told us about its requests.
+        self._owner_class: Dict[str, str] = {}
         self.replace(nodes)
 
     # -- full resync --------------------------------------------------------
@@ -158,6 +163,11 @@ class FleetIndex:
         self._owner_nodes: Dict[str, Set[str]] = {}
         self._chips: Dict[str, int] = {}
         self._gen: Dict[str, str] = {}
+        # per-class usage, folded O(delta): node -> (class, chips
+        # counted) so removal never needs a live _chips lookup, and the
+        # class -> chips rollup the admission layer reads per gang pass
+        self._class_contrib: Dict[str, Tuple[str, int]] = {}
+        self._class_usage: Dict[str, int] = {}
         pools: Set[str] = set()
         for node in nodes:
             name = name_of(node)
@@ -288,6 +298,9 @@ class FleetIndex:
             self._gen.pop(name, None)
         owner = annotations_of(node).get(L.PLACED_BY) or None
         self._set_owner(name, owner, dirty=dirty, touched=touched)
+        # chips can change while the owner stays put (capacity relabel,
+        # eligibility flip) — re-fold the class contribution either way
+        self._account(name)
 
     def _set_owner(self, name: str, owner: Optional[str], dirty=True,
                    touched: Optional[Set[_GroupKey]] = None) -> None:
@@ -304,6 +317,7 @@ class FleetIndex:
         if owner is not None:
             self.owner_of[name] = owner
             self._owner_nodes.setdefault(owner, set()).add(name)
+        self._account(name)
         if dirty:
             gk = self._group_of_node.get(name)
             if gk is not None:
@@ -387,6 +401,65 @@ class FleetIndex:
     def owned_nodes(self, owner: str) -> Tuple[str, ...]:
         with self._lock:
             return tuple(sorted(self._owner_nodes.get(owner, ())))
+
+    # -- per-class usage accounting (fair-share admission) -------------------
+
+    def _account(self, name: str) -> None:
+        """Re-fold one node's chip contribution into the per-class
+        rollup. The stored (class, chips) pair is what gets removed, so
+        ``_forget`` popping ``_chips`` before ``_set_owner`` can never
+        leak usage."""
+        prev = self._class_contrib.pop(name, None)
+        if prev is not None:
+            cls, chips = prev
+            left = self._class_usage.get(cls, 0) - chips
+            if left > 0:
+                self._class_usage[cls] = left
+            else:
+                self._class_usage.pop(cls, None)
+        owner = self.owner_of.get(name)
+        if owner is None:
+            return
+        chips = self._chips.get(name, 0)
+        if chips <= 0:
+            return
+        cls = self._owner_class.get(owner, "default")
+        self._class_contrib[name] = (cls, chips)
+        self._class_usage[cls] = self._class_usage.get(cls, 0) + chips
+
+    def set_owner_class(self, owner: str, cls: Optional[str]) -> None:
+        """Register (or with None, forget) which quota class an owner
+        key charges. Re-folds only that owner's held nodes — O(lease),
+        not O(fleet)."""
+        with self._lock:
+            if cls is None:
+                if self._owner_class.pop(owner, None) is None:
+                    return
+            else:
+                if self._owner_class.get(owner) == cls:
+                    return
+                self._owner_class[owner] = cls
+            for n in list(self._owner_nodes.get(owner, ())):
+                self._account(n)
+
+    def class_usage(self) -> Dict[str, int]:
+        """Chips currently leased per quota class (O(1) copy of the
+        incrementally-maintained rollup)."""
+        with self._lock:
+            return dict(self._class_usage)
+
+    def class_tflops(self) -> Dict[str, float]:
+        """Peak-bf16-TFLOPs leased per class (throughput-normalized
+        allocation input): chips x generation peak, summed over the
+        contribution ledger — O(leases), called once per gang pass."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for name, (cls, chips) in self._class_contrib.items():
+                gen = self._gen.get(name, "")
+                spec = CHIPS.get(gen)
+                rate = spec.peak_bf16_tflops if spec is not None else 1.0
+                out[cls] = out.get(cls, 0.0) + chips * rate
+            return out
 
     def snapshot_state(self) -> FleetState:
         """A FleetState twin sharing this index's (immutable-in-place)
@@ -560,6 +633,7 @@ class FleetIndex:
             "domains": len(self._groups),
             "leases": len(self.owner_of),
             "owners": len(self._owner_nodes),
+            "quota_classes": len(self._class_usage),
             "cached_runs": len(self._runs),
             "spec_shapes": len(self._entries),
             "heap_entries": sum(len(e.heap)
