@@ -739,6 +739,45 @@ def _controlplane_doc() -> dict | None:
                     fb["saturation_drain_rps"], 1)
             except Exception as e:
                 doc["fairness"] = {"error": f"{type(e).__name__}: {e}"}
+        # multi-cluster federation: the global router's digest-scored
+        # decision vs one flat plane over the same fleet (its own try
+        # for the same reason as rollout's). federation_route_p99_ms /
+        # federation_quality_vs_flat at top level are the figures
+        # tests/test_bench_guard.py gates (quality >= 0.95 absolute).
+        # TPUOP_BENCH_FEDERATION_CELLS scales the cell count down for
+        # smoke runs; TPUOP_BENCH_SKIP_FEDERATION skips it.
+        if not os.environ.get("TPUOP_BENCH_SKIP_FEDERATION"):
+            try:
+                from tpu_operator.benchmarks.controlplane import (
+                    run_federation_bench,
+                )
+
+                fc = int(os.environ.get(
+                    "TPUOP_BENCH_FEDERATION_CELLS", "5"))
+                fnodes = int(os.environ.get(
+                    "TPUOP_BENCH_FEDERATION_NODES_PER_CELL", "2000"))
+                fd = run_federation_bench(n_cells=fc,
+                                          nodes_per_cell=fnodes)
+                doc["federation"] = {
+                    "n_cells": fd["n_cells"],
+                    "nodes_per_cell": fd["nodes_per_cell"],
+                    "n_requests": fd["n_requests"],
+                    "flat_placed_chips": fd["flat_placed_chips"],
+                    "federated_placed_chips":
+                        fd["federated_placed_chips"],
+                    "unrouted": fd["federated_unrouted"],
+                    "infeasible": fd["federated_infeasible"],
+                    "flat_p99_ms": round(fd["flat_p99_ms"], 3),
+                    "route_vs_flat_x": round(
+                        fd["route_vs_flat_x"], 3),
+                }
+                doc["federation_route_p99_ms"] = round(
+                    fd["federation_route_p99_ms"], 3)
+                doc["federation_quality_vs_flat"] = round(
+                    fd["federation_quality_vs_flat"], 4)
+            except Exception as e:
+                doc["federation"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         return doc
     except Exception as e:  # the scale rider must never kill the record
         return {"error": f"{type(e).__name__}: {e}"}

@@ -374,6 +374,53 @@ def test_saturation_drain_rps_not_regressed():
         f"{latest:.1f} regressed >25% vs best on record ({best:.1f})")
 
 
+def test_federation_route_p99_not_regressed():
+    """Same relative contract as the placement-fleet gate, for the
+    global router's per-decision p99 (benchmarks.controlplane.
+    run_federation_bench — digest scoring over N cells): the latest
+    round's federation_route_p99_ms may be at most 25% above the best
+    on record. Skips until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "federation_route_p99_ms")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records federation_route_p99_ms yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} federation_route_p99_ms="
+        f"{latest:.3f}ms regressed >25% vs best on record ({best:.3f}ms)")
+
+
+def test_federation_quality_bounded():
+    """Absolute acceptance bar, like the Jain gate: the latest round
+    carrying ``federation_quality_vs_flat`` (chips placed through the
+    digest-routed N-cell plane / chips placed by one flat plane over
+    the same fleet and request stream) must stay at or above 0.95 —
+    federation is not allowed to quietly buy its decision latency with
+    stranded capacity. Skips until a round carrying the key is
+    committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "federation_quality_vs_flat")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip(
+            "no committed round records federation_quality_vs_flat yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    assert latest >= 0.95, (
+        f"BENCH_LOCAL_r{latest_round:02d} federation_quality_vs_flat="
+        f"{latest:.4f} breaks the >= 0.95 placement-quality acceptance "
+        f"bar vs the flat plane")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

@@ -822,3 +822,127 @@ class TestQuotaView:
         rc = main(["quota", "-f", str(tmp_path / "missing.json")])
         assert rc == 1
         assert "cannot read quota report" in capsys.readouterr().err
+
+
+class TestWhyCellBoundary:
+    """`tpuop-cfg why` on a cause chain that crossed clusters: the
+    `cell/<name>` origin gets an explicit boundary marker so the
+    cross-cell hop reads at a glance."""
+
+    def test_golden_cross_cell_story(self):
+        from tpu_operator.cli.tpuop_cfg import render_timeline
+
+        text = render_timeline({
+            "kind": "SliceRequest", "name": "default/job",
+            "events": [
+                {"ts": 10.0, "event": "routed",
+                 "detail": {"cell": "east"},
+                 "causes": [{"reason": "federation-route",
+                             "origin": "cell/east", "trace_id": 7}]},
+                {"ts": 40.0, "event": "migration:CrossCellHop",
+                 "detail": {"to": "west"},
+                 "causes": [{"reason": "cell-condemned",
+                             "origin": "cell/east", "trace_id": -1},
+                            {"reason": "watch:MODIFIED",
+                             "origin": "Node/tpu-3", "trace_id": 9}]},
+            ]})
+        assert text.splitlines() == [
+            "SliceRequest/default/job — 2 event(s)",
+            "  t=    10.000  routed                 cell=east",
+            "      <- federation-route cell/east (trace #7)",
+            "         ↪ cell boundary: east",
+            "  t=    40.000  migration:CrossCellHop to=west",
+            "      <- cell-condemned cell/east",
+            "         ↪ cell boundary: east",
+            "      <- watch:MODIFIED Node/tpu-3 (trace #9)",
+        ]
+
+    def test_in_cluster_origins_get_no_marker(self):
+        from tpu_operator.cli.tpuop_cfg import render_timeline
+
+        text = render_timeline({
+            "kind": "SliceRequest", "name": "default/job",
+            "events": [{"ts": 1.0, "event": "enqueue",
+                        "causes": [{"reason": "watch:ADDED",
+                                    "origin": "Node/tpu-0",
+                                    "trace_id": 3}]}]})
+        assert "cell boundary" not in text
+
+    def test_why_cli_renders_the_marker_from_a_bundle(self, tmp_path,
+                                                      capsys):
+        import json
+
+        f = tmp_path / "timeline.json"
+        f.write_text(json.dumps({"SliceRequest/default/job": [
+            {"ts": 5.0, "event": "routed",
+             "causes": [{"reason": "federation-route",
+                         "origin": "cell/west", "trace_id": 2}]}]}))
+        rc = main(["why", "SliceRequest/default/job", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "         ↪ cell boundary: west" in out
+
+
+class TestCellsView:
+    """`tpuop-cfg cells`: the federation breaker table, from a
+    must-gather bundle and as a scriptable partition probe."""
+
+    def _report(self):
+        return {
+            "cells": {"east": {"requests": [
+                {"name": "a1", "phase": "Placed", "chips": 8}],
+                "chips": 8}},
+            "unrouted": [{"name": "q1", "phase": "Pending",
+                          "chips": 4}],
+            "router": {
+                "cells": {
+                    "east": {"state": "Healthy", "failure_streak": 0,
+                             "probes": 0, "digest_age_s": 2.5,
+                             "routed_total": 3},
+                    "west": {"state": "Open", "failure_streak": 3,
+                             "probes": 2, "digest_age_s": None,
+                             "routed_total": 0}},
+                "condemnation_horizon_s": 600.0}}
+
+    def test_bundle_table_and_open_breaker_exit_code(self, tmp_path,
+                                                     capsys):
+        import json
+
+        d = tmp_path / "federation"
+        d.mkdir()
+        (d / "cells.json").write_text(json.dumps(self._report()))
+        rc = main(["cells", "-f", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 2  # west's breaker is Open: the probe fires
+        assert "open breakers: west" in out
+        lines = out.splitlines()
+        assert lines[0].startswith("CELL")
+        east = next(l for l in lines if l.startswith("east"))
+        assert "Healthy" in east and east.rstrip().endswith("8")
+        west = next(l for l in lines if l.startswith("west"))
+        assert "Open" in west
+        assert "unrouted (1):" in out
+        assert "condemnation horizon: 600.0s" in out
+
+    def test_all_healthy_exits_zero(self, tmp_path, capsys):
+        import json
+
+        rep = self._report()
+        rep["router"]["cells"]["west"]["state"] = "Suspect"
+        f = tmp_path / "cells.json"
+        f.write_text(json.dumps(rep))
+        assert main(["cells", "-f", str(f)]) == 0
+        assert "open breakers" not in capsys.readouterr().out
+
+    def test_json_output_roundtrips(self, tmp_path, capsys):
+        import json
+
+        f = tmp_path / "cells.json"
+        f.write_text(json.dumps(self._report()))
+        assert main(["cells", "-f", str(f), "-o", "json"]) == 2
+        assert json.loads(capsys.readouterr().out) == self._report()
+
+    def test_unreadable_file_is_clean_error(self, tmp_path, capsys):
+        rc = main(["cells", "-f", str(tmp_path / "missing.json")])
+        assert rc == 1
+        assert "cannot read cells report" in capsys.readouterr().err

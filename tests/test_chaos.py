@@ -204,6 +204,68 @@ class TestSliceMigrateScenario:
         assert faults.get("slice-resize", 0) >= 1
 
 
+class TestFederationScenarios:
+    """The federation plane's own acceptance bars, beyond the
+    parametrized all-scenarios sweep above: byte-identical verdicts at
+    two node counts (the N-cell loop, the router's breaker ledgers and
+    the cross-cell migration passes must add no nondeterminism), and
+    the partition scenario's specific story — the breaker opens, work
+    migrates out of the condemned cell, and the mid-partition router
+    crash leaves the settled state byte-identical to a never-crashed
+    run."""
+
+    @pytest.mark.parametrize("scenario", ["cell-partition",
+                                          "stale-digest",
+                                          "split-brain-router"])
+    @pytest.mark.parametrize("nodes", [24, 48])
+    def test_same_seed_byte_identical_verdict(self, scenario, nodes):
+        runs = [run_scenario(scenario, nodes=nodes, seed=11)
+                for _ in range(2)]
+        payloads = [json.dumps(v, indent=2, sort_keys=True)
+                    for v in runs]
+        assert payloads[0] == payloads[1]
+        assert runs[0]["ok"] is True
+
+    def test_cell_partition_migrates_and_restarts_coherent(self):
+        v = run_scenario("cell-partition", nodes=48, seed=7)
+        assert v["ok"] is True
+        assert v["faults_injected"].get("cell-partition-start", 0) >= 1
+        assert v["faults_injected"].get("router-crash", 0) >= 1
+        # the condemned cell's slices actually moved, with the causal
+        # chain surviving the hop
+        assert v["cross_cell_migrated"], \
+            "no slice crossed cells during the partition"
+        for key in v["cross_cell_migrated"]:
+            events = v["timelines"][key]
+            hops = [e for e in events
+                    if e["event"] == "migration:CrossCellHop"]
+            assert hops, f"{key} migrated without a CrossCellHop event"
+            assert any(
+                str(c.get("origin", "")).startswith("cell/")
+                for e in hops for c in e.get("causes") or []), \
+                f"{key}'s hop lost its cell/<src> cause origin"
+        # the mid-partition router crash changed nothing observable
+        assert v["restart_coherent"]["ok"] is True
+        assert (v["restart_coherent"]["digest"]
+                == v["restart_coherent"]["baseline_digest"])
+
+    def test_stale_digest_is_age_discounted_not_trusted(self):
+        v = run_scenario("stale-digest", nodes=48, seed=7)
+        assert v["ok"] is True
+        assert v["faults_injected"].get("digest-stale-start", 0) >= 1
+        # the wedged cell stayed reachable, so its breaker never opened
+        for name, row in v["router"]["cells"].items():
+            assert row["state"] == "Healthy", \
+                f"{name} opened on staleness alone: {row}"
+
+    def test_split_brain_router_sees_no_divergence(self):
+        v = run_scenario("split-brain-router", nodes=48, seed=7)
+        assert v["ok"] is True
+        assert v["faults_injected"].get("router-split", 0) >= 1
+        assert not [x for x in v["violations"]
+                    if x["invariant"] == "split-brain-router"]
+
+
 class TestCausalLineageGolden:
     """The lineage-plane acceptance bar: a seeded slice-migrate run
     carries, for a request that settled Resumed, the single causal

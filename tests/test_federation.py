@@ -1,0 +1,613 @@
+"""Federation plane: cell digests, the circuit-breaking global router,
+cross-cell elastic migration, and the router reconciler.
+
+The chaos matrix (tests/test_chaos.py) pins the plane's end-to-end
+behavior under seeded partitions; this file pins the units those
+scenarios are built from — digest schema discipline, breaker
+transitions and backoff arithmetic, the arrival-order-independence
+property, snapshot round-trips, and the migration handshake's causal
+record.
+"""
+
+import itertools
+import json
+import random
+
+import pytest
+
+from tpu_operator.api import labels as L
+from tpu_operator.api.slicerequest import (
+    KIND_SLICE_REQUEST,
+    MIG_CHECKPOINTED,
+    MIG_RESUMED,
+    PHASE_PLACED,
+    V1ALPHA1,
+    new_slice_request,
+)
+from tpu_operator.benchmarks.controlplane import build_cluster
+from tpu_operator.controllers.federation_controller import (
+    FederationReconciler,
+)
+from tpu_operator.controllers.placement_controller import (
+    PlacementReconciler,
+)
+from tpu_operator.federation.digest import (
+    CELL_DIGEST_SCHEMA_VERSION,
+    cell_digest,
+    cell_digest_json,
+    parse_cell_digest,
+    publish_wait,
+)
+from tpu_operator.federation.router import (
+    CELL_HEALTHY,
+    CELL_OPEN,
+    CELL_SUSPECT,
+    GlobalRouter,
+    cells_report,
+)
+from tpu_operator.runtime import Request
+from tpu_operator.runtime.fake import FakeClient, simulate_kubelet
+from tpu_operator.runtime.multicell import Cell, MultiCellHarness
+from tpu_operator.runtime.objects import annotations_of, get_nested
+from tpu_operator.runtime.timeline import TIMELINE
+from tpu_operator.topology.index import FleetIndex
+from tpu_operator.workloads.elastic import ElasticWorkload
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_digest(cell, seq, at=0.0, chips_free=64, hosts=16,
+              fragmentation=0.0, condemned=0, headroom=None):
+    return {
+        "v": CELL_DIGEST_SCHEMA_VERSION,
+        "cell": cell,
+        "seq": seq,
+        "at": at,
+        "hosts": hosts,
+        "chips_free": chips_free,
+        "chips_placed": hosts * 4 - chips_free,
+        "utilization": 1.0 - chips_free / (hosts * 4.0),
+        "headroom": headroom if headroom is not None
+        else {"v5p": chips_free},
+        "fragmentation": fragmentation,
+        "condemned": condemned,
+    }
+
+
+class TestCellDigest:
+    def test_digest_from_real_index_round_trips(self):
+        nodes = build_cluster(n_tpu=12).list("v1", "Node")
+        d = cell_digest(FleetIndex(nodes), "cell-a", 3, 42.0)
+        assert d["v"] == CELL_DIGEST_SCHEMA_VERSION
+        assert d["cell"] == "cell-a" and d["seq"] == 3
+        assert d["chips_free"] > 0 and d["hosts"] > 0
+        # the wire form parses back to the same dict
+        assert parse_cell_digest(cell_digest_json(d)) == d
+
+    def test_unknown_schema_version_parses_to_none(self):
+        d = mk_digest("a", 1)
+        d["v"] = CELL_DIGEST_SCHEMA_VERSION + 1
+        assert parse_cell_digest(d) is None
+        assert parse_cell_digest(json.dumps(d)) is None
+
+    def test_malformed_payloads_parse_to_none(self):
+        assert parse_cell_digest(None) is None
+        assert parse_cell_digest("{not json") is None
+        assert parse_cell_digest("[1,2]") is None
+        no_cell = mk_digest("a", 1)
+        no_cell.pop("cell")
+        assert parse_cell_digest(no_cell) is None
+        bad_seq = mk_digest("a", 1)
+        bad_seq["seq"] = "three"
+        assert parse_cell_digest(bad_seq) is None
+
+    def test_publish_wait_is_seeded_and_bounded(self):
+        assert publish_wait("cell-a") == publish_wait("cell-a")
+        waits = {publish_wait(f"cell-{i}") for i in range(8)}
+        assert len(waits) > 1  # cells don't publish in lockstep
+        for w in waits:
+            assert 15.0 * 0.8 <= w <= 15.0 * 1.2
+
+
+class TestRouterBreaker:
+    def test_streak_walks_healthy_suspect_open_and_heals(self):
+        clock = Clock()
+        r = GlobalRouter(["a"], now=clock, failure_threshold=3)
+        assert r.cells["a"].state == CELL_HEALTHY
+        r.record_failure("a")
+        assert r.cells["a"].state == CELL_SUSPECT
+        r.record_failure("a")
+        assert r.cells["a"].state == CELL_SUSPECT
+        r.record_failure("a")
+        assert r.cells["a"].state == CELL_OPEN
+        # one success is a full heal — streak, probes, Open clock gone
+        r.record_success("a")
+        cs = r.cells["a"]
+        assert (cs.state, cs.failure_streak, cs.probes,
+                cs.open_since) == (CELL_HEALTHY, 0, 0, None)
+
+    def test_open_cell_probed_on_capped_exponential_backoff(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a", "b"], now=clock, failure_threshold=1,
+                         probe_base_s=10.0, probe_cap_s=35.0)
+        r.record_failure("a")  # straight to Open at threshold 1
+        assert r.cells["a"].state == CELL_OPEN
+        # not due before the base backoff; a healthy cell is always due
+        assert r.cells_to_contact() == ["b"]
+        clock.t = 10.0
+        assert r.cells_to_contact() == ["a", "b"]
+        r.record_failure("a")  # failed probe: backoff doubles
+        assert "a" not in r.cells_to_contact()
+        clock.t = 29.9
+        assert "a" not in r.cells_to_contact()
+        clock.t = 30.0
+        assert "a" in r.cells_to_contact()
+        r.record_failure("a")  # 40s would be next, capped at 35
+        clock.t = 64.9
+        assert "a" not in r.cells_to_contact()
+        clock.t = 65.0
+        assert "a" in r.cells_to_contact()
+
+    def test_condemnation_waits_for_the_horizon(self):
+        clock = Clock(t=100.0)
+        r = GlobalRouter(["a"], now=clock, failure_threshold=1,
+                         condemnation_horizon_s=60.0)
+        r.record_failure("a")
+        assert r.condemned_cells() == []  # Open, but not dead yet
+        clock.t = 159.9
+        assert r.condemned_cells() == []
+        clock.t = 160.0
+        assert r.condemned_cells() == ["a"]
+        r.record_success("a")  # partition healed before anyone moved
+        assert r.condemned_cells() == []
+
+
+class TestRouterScoring:
+    def test_open_and_digestless_cells_never_score(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a", "b", "c"], now=clock,
+                         failure_threshold=1)
+        r.observe_digest(mk_digest("a", 1))
+        r.observe_digest(mk_digest("b", 1))
+        r.record_failure("b")
+        assert r.score("a", chips=4) > 0.0
+        assert r.score("b", chips=4) == 0.0  # Open
+        assert r.score("c", chips=4) == 0.0  # never heard from
+        assert r.route(4)["cell"] == "a"
+
+    def test_stale_digest_is_age_discounted(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a"], now=clock, digest_half_life_s=60.0)
+        r.observe_digest(mk_digest("a", 1, at=0.0))
+        fresh = r.score("a", chips=4)
+        clock.t = 60.0  # one half-life
+        assert r.score("a", chips=4) == pytest.approx(fresh / 2)
+        clock.t = 120.0  # two half-lives -> a third
+        assert r.score("a", chips=4) == pytest.approx(fresh / 3)
+
+    def test_suspect_cell_scores_at_a_discount_not_zero(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a"], now=clock, failure_threshold=3)
+        r.observe_digest(mk_digest("a", 1))
+        healthy = r.score("a", chips=4)
+        r.record_failure("a")
+        assert r.cells["a"].state == CELL_SUSPECT
+        assert r.score("a", chips=4) == pytest.approx(healthy / 2)
+
+    def test_generation_headroom_gates_pinned_requests(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a", "b"], now=clock)
+        r.observe_digest(mk_digest("a", 1, chips_free=64,
+                                   headroom={"v5e": 64}))
+        r.observe_digest(mk_digest("b", 1, chips_free=16,
+                                   headroom={"v5p": 16}))
+        # un-pinned: the bigger free pool wins
+        assert r.route(4)["cell"] == "a"
+        # v5p-pinned: only b has v5p headroom
+        assert r.route(4, generation="v5p")["cell"] == "b"
+        # pinned past the headroom: unroutable, stays queued
+        assert r.route(32, generation="v5p") is None
+
+    def test_routing_books_capacity_until_the_next_publish(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a"], now=clock)
+        r.observe_digest(mk_digest("a", 1, chips_free=8,
+                                   headroom={"v5p": 8}))
+        assert r.route(8)["cell"] == "a"
+        # the held digest says 8 free but the router just spent them
+        assert r.route(8) is None
+        # a fresh publish supersedes the booking ledger
+        r.observe_digest(mk_digest("a", 2, chips_free=8,
+                                   headroom={"v5p": 8}))
+        assert r.route(8)["cell"] == "a"
+
+    def test_locality_steers_between_comparable_cells_only(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a", "b"], now=clock)
+        r.observe_digest(mk_digest("a", 1, chips_free=64))
+        r.observe_digest(mk_digest("b", 1, chips_free=48))
+        d = r.route(4, locality="b")
+        assert (d["cell"], d["reason"]) == ("b", "locality")
+        # a collapsed cell loses the preference: 4 free is far below
+        # half of a's score, so the digest winner takes it
+        r2 = GlobalRouter(["a", "b"], now=clock)
+        r2.observe_digest(mk_digest("a", 1, chips_free=64))
+        r2.observe_digest(mk_digest("b", 1, chips_free=4))
+        d2 = r2.route(4, locality="b")
+        assert (d2["cell"], d2["reason"]) == ("a", "digest-score")
+
+
+class TestArrivalOrderIndependence:
+    def test_seeded_permutations_reach_identical_decisions(self):
+        """The split-brain property as a unit test: routers fed the
+        same digest SET in different orders (seeded shuffles, plus a
+        duplicate echo of every digest) make byte-identical decisions
+        for the same request stream."""
+        rng = random.Random(1513)
+        cells = [f"cell-{i}" for i in range(4)]
+        digests = [mk_digest(c, seq,
+                             at=float(seq),
+                             chips_free=rng.randrange(8, 96, 4),
+                             fragmentation=rng.random() / 2,
+                             headroom={"v5p": rng.randrange(4, 64, 4)})
+                   for c in cells for seq in (1, 2, 3)]
+        stream = [(rng.choice((4, 8, 16)),
+                   rng.choice((None, "v5p")),
+                   rng.choice((None, rng.choice(cells))))
+                  for _ in range(30)]
+
+        def decisions(order_seed):
+            clock = Clock(t=10.0)
+            r = GlobalRouter(cells, now=clock)
+            batch = digests + digests  # echoes must dedupe by seq
+            random.Random(order_seed).shuffle(batch)
+            for d in batch:
+                r.observe_digest(dict(d))
+            return [r.route(chips, generation=gen, locality=loc)
+                    for chips, gen, loc in stream]
+
+        baseline = decisions(0)
+        assert any(d is not None for d in baseline)
+        for order_seed in range(1, 6):
+            assert decisions(order_seed) == baseline
+
+    def test_stale_echo_never_regresses_the_held_view(self):
+        clock = Clock(t=0.0)
+        r = GlobalRouter(["a"], now=clock)
+        assert r.observe_digest(mk_digest("a", 5, chips_free=32))
+        assert not r.observe_digest(mk_digest("a", 4, chips_free=99))
+        assert not r.observe_digest(mk_digest("a", 5, chips_free=99))
+        assert r.cells["a"].digest["chips_free"] == 32
+
+
+class TestRouterSnapshot:
+    def test_breaker_ledger_survives_the_json_round_trip(self):
+        clock = Clock(t=50.0)
+        r = GlobalRouter(["a", "b"], now=clock, failure_threshold=2)
+        r.observe_digest(mk_digest("a", 7, at=40.0))
+        r.record_failure("b")
+        r.record_failure("b")  # Open
+        r.record_failure("b")  # one failed probe
+        r.route(4)
+        snap = json.loads(json.dumps(r.snapshot(), sort_keys=True))
+        clock2 = Clock(t=50.0)
+        r2 = GlobalRouter.restore(snap, ["a", "b"], now=clock2,
+                                  failure_threshold=2)
+        b = r2.cells["b"]
+        assert (b.state, b.probes) == (CELL_OPEN, 1)
+        assert b.open_since == 50.0
+        a = r2.cells["a"]
+        assert a.digest["seq"] == 7 and a.booked == 4
+        # the successor keeps routing around the Open cell
+        assert r2.route(4)["cell"] == "a"
+
+    def test_adopt_refuses_foreign_or_malformed_state(self):
+        r = GlobalRouter(["a"], now=Clock())
+        assert not r.adopt(None)
+        assert not r.adopt({"cells": {}})  # no version stamp
+        assert not r.adopt({"v": 999, "cells": {}})
+        assert not r.adopt({"v": 1, "cells": "nope"})
+        assert r.adopt({"v": 1, "cells": {}})
+
+
+class _Ctx:
+    """TIMELINE needs the virtual clock for the migration tests; keep
+    the process-global recorder's state out of other tests."""
+
+    def __init__(self, clock):
+        self.clock = clock
+
+    def __enter__(self):
+        self._prev = (TIMELINE.clock, TIMELINE.enabled)
+        TIMELINE.reset(clock=self.clock, enabled=True)
+        return self
+
+    def __exit__(self, *exc):
+        TIMELINE.reset(clock=self._prev[0], enabled=self._prev[1])
+
+
+class TestMultiCellMigration:
+    def _harness(self, clock):
+        fakes = {name: build_cluster(n_tpu=8)
+                 for name in ("cell-a", "cell-b")}
+        cells = {}
+        for name, fake in fakes.items():
+            recon = PlacementReconciler(fake, namespace="default",
+                                        preemption=False, now=clock,
+                                        cell=name)
+            cells[name] = Cell(name, fake, reconciler=recon)
+        router = GlobalRouter(
+            ["cell-a", "cell-b"], now=clock, failure_threshold=1,
+            condemnation_horizon_s=30.0)
+        harness = MultiCellHarness(
+            router, cells, now=clock,
+            shim_factory=lambda cell, name, ns, store: ElasticWorkload(
+                fakes[cell.name], name, ns, clock=clock, store=store))
+        return fakes, cells, router, harness
+
+    def _settle(self, fakes, cells, harness, shims_for=()):
+        for _ in range(6):
+            for name in sorted(cells):
+                fake = fakes[name]
+                for cr in fake.list(V1ALPHA1, KIND_SLICE_REQUEST):
+                    cells[name].reconciler.reconcile(Request(
+                        name=cr["metadata"]["name"],
+                        namespace="default"))
+                simulate_kubelet(fake, ready=True)
+                for key in shims_for:
+                    ns, _, nm = key.partition("/")
+                    cr = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                          nm, ns)
+                    owned = {k for c in cells.values()
+                             for k in c.shims}
+                    if (cr is not None and key not in owned
+                            and get_nested(cr, "status",
+                                           "phase") == PHASE_PLACED):
+                        cells[name].shims[key] = ElasticWorkload(
+                            fake, nm, ns, clock=harness.now)
+                for key in sorted(cells[name].shims):
+                    cells[name].shims[key].tick()
+            harness.migration_pass()
+
+    def test_condemned_cell_slices_hop_with_their_checkpoints(self):
+        clock = Clock(t=0.0)
+        with _Ctx(clock):
+            fakes, cells, router, harness = self._harness(clock)
+            router.observe_digest(cell_digest(
+                cells["cell-a"].fleet_index(), "cell-a", 1, clock()))
+            router.observe_digest(cell_digest(
+                cells["cell-b"].fleet_index(), "cell-b", 1, clock()))
+            harness.submit(new_slice_request("job", {"chips": 4}))
+            assert harness.route_pass() == 1
+            key = "default/job"
+            src = next(n for n in fakes
+                       if fakes[n].list(V1ALPHA1, KIND_SLICE_REQUEST))
+            dst = "cell-b" if src == "cell-a" else "cell-a"
+            self._settle(fakes, cells, harness, shims_for=(key,))
+            assert get_nested(
+                fakes[src].get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                       "job", "default"),
+                "status", "phase") == PHASE_PLACED
+            # let the workload bank some acked-able progress
+            for _ in range(4):
+                clock.t += 10.0
+                for k in sorted(cells[src].shims):
+                    cells[src].shims[k].tick()
+            # partition: the source cell drops off the global plane
+            router.record_failure(src)
+            assert router.cells[src].state == CELL_OPEN
+            clock.t += 31.0  # past the condemnation horizon
+            assert router.condemned_cells() == [src]
+            self._settle(fakes, cells, harness, shims_for=())
+            # the slice now lives in the destination, resumed
+            twin = fakes[dst].get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                          "job", "default")
+            assert get_nested(twin, "status", "phase") == PHASE_PLACED
+            mig = get_nested(twin, "status", "migration", default={})
+            assert mig["phase"] == MIG_RESUMED
+            assert mig["from"] == f"cell/{src}"
+            assert int(mig["restoredStep"]) >= int(mig["ackedStep"])
+            # the source copy is gone, the shim (and its checkpoint
+            # store) crossed with the slice
+            assert fakes[src].get_or_none(
+                V1ALPHA1, KIND_SLICE_REQUEST, "job", "default") is None
+            assert key in cells[dst].shims
+            assert key not in cells[src].shims
+            assert harness.migrations == {}
+            # the causal record tells the cross-cluster story
+            events = TIMELINE.timeline("SliceRequest", key)
+            hop = next(e for e in events
+                       if e["event"] == "migration:CrossCellHop")
+            assert any(c["origin"] == f"cell/{src}"
+                       for c in hop["causes"])
+
+    def test_recover_migrations_rebuilds_from_request_status(self):
+        clock = Clock(t=0.0)
+        with _Ctx(clock):
+            fakes, cells, router, harness = self._harness(clock)
+            # a dst-side twin mid-hop: Checkpointed, from cell-a
+            body = new_slice_request("moving", {"chips": 4})
+            body["metadata"]["annotations"] = {L.CELL_PIN: "cell-b"}
+            fakes["cell-b"].create(body)
+            live = fakes["cell-b"].get_or_none(
+                V1ALPHA1, KIND_SLICE_REQUEST, "moving", "default")
+            cr = json.loads(json.dumps(live))
+            cr.setdefault("status", {})["migration"] = {
+                "phase": MIG_CHECKPOINTED, "from": "cell/cell-a",
+                "ackedStep": 12}
+            fakes["cell-b"].update_status(cr)
+            assert harness.migrations == {}
+            assert harness.recover_migrations() == 1
+            assert harness.migrations["default/moving"] == {
+                "src": "cell-a", "dst": "cell-b", "stage": "hop"}
+
+
+class TestFederationReconciler:
+    def test_unpinned_request_gets_routed_and_stamped(self):
+        clock = Clock(t=0.0)
+        with _Ctx(clock):
+            fake = FakeClient()
+            router = GlobalRouter(["east", "west"], now=clock)
+            router.observe_digest(mk_digest("east", 1, chips_free=64))
+            router.observe_digest(mk_digest("west", 1, chips_free=8))
+            fake.create(new_slice_request("train", {"chips": 16}))
+            rec = FederationReconciler(fake, router)
+            res = rec.reconcile(Request(name="train",
+                                        namespace="default"))
+            assert not res.requeue and res.requeue_after == 0.0
+            live = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                    "train", "default")
+            assert annotations_of(live)[L.CELL_PIN] == "east"
+            events = TIMELINE.timeline("SliceRequest", "default/train")
+            routed = next(e for e in events if e["event"] == "routed")
+            assert any(c["origin"] == "cell/east"
+                       for c in routed["causes"])
+
+    def test_pinned_request_is_left_alone(self):
+        fake = FakeClient()
+        body = new_slice_request("pinned", {"chips": 4})
+        body["metadata"]["annotations"] = {L.CELL_PIN: "west"}
+        fake.create(body)
+        router = GlobalRouter(["east", "west"], now=Clock())
+        router.observe_digest(mk_digest("east", 1))
+        rec = FederationReconciler(fake, router)
+        rec.reconcile(Request(name="pinned", namespace="default"))
+        live = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                "pinned", "default")
+        assert annotations_of(live)[L.CELL_PIN] == "west"
+        assert router.cells["east"].routed_total == 0
+
+    def test_unroutable_request_requeues_on_the_retry_cadence(self):
+        from tpu_operator.controllers.federation_controller import (
+            ROUTE_RETRY_S,
+        )
+
+        fake = FakeClient()
+        fake.create(new_slice_request("stuck", {"chips": 4}))
+        router = GlobalRouter(["east"], now=Clock(),
+                              failure_threshold=1)
+        router.record_failure("east")  # every cell Open
+        rec = FederationReconciler(fake, router)
+        res = rec.reconcile(Request(name="stuck", namespace="default"))
+        assert res.requeue_after == ROUTE_RETRY_S
+        live = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                "stuck", "default")
+        assert L.CELL_PIN not in annotations_of(live)
+
+    def test_cells_report_groups_by_pin(self):
+        fake = FakeClient()
+        for name, pin in (("a1", "east"), ("a2", "east"),
+                          ("b1", "west"), ("q1", None)):
+            body = new_slice_request(name, {"chips": 4})
+            if pin:
+                body["metadata"]["annotations"] = {L.CELL_PIN: pin}
+            fake.create(body)
+        router = GlobalRouter(["east", "west"], now=Clock())
+        rep = cells_report(fake, "default", router=router)
+        assert sorted(rep["cells"]) == ["east", "west"]
+        assert rep["cells"]["east"]["chips"] == 8
+        assert [r["name"] for r in rep["unrouted"]] == ["q1"]
+        assert rep["router"]["cells"]["east"]["state"] == CELL_HEALTHY
+
+
+class TestCrossCellWorkChecker:
+    def _client_with(self, name, status, anns=None):
+        fake = FakeClient()
+        body = new_slice_request(name, {"chips": 4})
+        if anns:
+            body["metadata"]["annotations"] = dict(anns)
+        fake.create(body)
+        live = fake.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, name,
+                                "default")
+        cr = json.loads(json.dumps(live))
+        cr["status"] = status
+        fake.update_status(cr)
+        return fake
+
+    def test_restore_below_acked_high_water_is_a_violation(self):
+        from tpu_operator.chaos.invariants import CrossCellWorkChecker
+
+        checker = CrossCellWorkChecker()
+        a = self._client_with("job", {"phase": "Placed", "migration": {
+            "phase": MIG_CHECKPOINTED, "ackedStep": 40,
+            "toCell": "b"}})
+        checker.observe(0, {"a": a})
+        b = self._client_with("job", {"phase": "Placed", "migration": {
+            "phase": MIG_RESUMED, "from": "cell/a",
+            "restoredStep": 30}})
+        checker.observe(1, {"b": b})
+        assert [v.invariant for v in checker.violations] == [
+            "no-lost-work-cross-cell"]
+        # the same stale marker is judged once, not every observation
+        checker.observe(2, {"b": b})
+        assert len(checker.violations) == 1
+
+    def test_double_placement_flagged_but_handoff_window_exempt(self):
+        from tpu_operator.chaos.invariants import CrossCellWorkChecker
+
+        checker = CrossCellWorkChecker()
+        # outbound handoff: src copy carries toCell -> by design
+        src = self._client_with("job", {"phase": "Placed", "migration": {
+            "phase": MIG_CHECKPOINTED, "toCell": "b"}})
+        dst = self._client_with("job", {"phase": "Placed"})
+        checker.observe(0, {"a": src, "b": dst})
+        assert checker.violations == []
+        # two full bindings with no handoff in flight: a double-spend
+        rogue = self._client_with("job", {"phase": "Placed"})
+        checker.observe(1, {"a": rogue, "b": dst})
+        assert [v.invariant for v in checker.violations] == [
+            "single-binding"]
+
+
+class TestCellsEndpoint:
+    def _manager(self, controllers=()):
+        from types import SimpleNamespace
+
+        from tpu_operator.runtime.manager import Manager
+
+        mgr = Manager(FakeClient(), namespace="tpu-operator",
+                      health_port=0)
+        for rec in controllers:
+            mgr.controllers.append(SimpleNamespace(
+                reconciler=rec, start=lambda: None, stop=lambda: None))
+        mgr.start()
+        return mgr
+
+    def _get(self, port, path):
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_serves_the_federation_report(self):
+        fake = FakeClient()
+        body = new_slice_request("a1", {"chips": 8})
+        body["metadata"]["annotations"] = {L.CELL_PIN: "east"}
+        fake.create(body)
+        router = GlobalRouter(["east"], now=Clock())
+        mgr = self._manager([FederationReconciler(fake, router)])
+        try:
+            status, doc = self._get(
+                mgr._http.server_address[1], "/debug/cells")
+        finally:
+            mgr.stop()
+        assert status == 200
+        assert doc["cells"]["east"]["chips"] == 8
+        assert doc["router"]["cells"]["east"]["state"] == CELL_HEALTHY
+
+    def test_no_federation_plane_is_explicit_not_404(self):
+        mgr = self._manager()
+        try:
+            status, doc = self._get(
+                mgr._http.server_address[1], "/debug/cells")
+        finally:
+            mgr.stop()
+        assert status == 200
+        assert doc == {"cells": {}, "unrouted": [], "router": None}

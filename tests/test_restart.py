@@ -342,6 +342,77 @@ class TestManagerSnapshotLifecycle:
         assert cc2.watch_resumes == 1
         cc2.close()
 
+    def test_degraded_cache_never_writes_a_snapshot(self, tmp_path):
+        """A degraded cache is serving a stale view by design; letting
+        the periodic writer persist it would poison the next warm
+        restore with pre-brownout state wearing a fresh timestamp. The
+        writer must refuse (and say so on the metric) until the breaker
+        heals."""
+        from tpu_operator.metrics.registry import REGISTRY
+
+        def skipped():
+            return REGISTRY.get_sample_value(
+                "tpu_operator_snapshot_writes_total",
+                {"outcome": "skipped_degraded"}) or 0.0
+
+        d = str(tmp_path)
+        clock = Clock(100.0)
+        fake = small_fleet(2)
+        shim = _FlakyInner(fake)
+        cc = CachedClient(shim, now=clock, relist_chunk=0)
+        cc.list("v1", "Node")
+        m = Manager(cc, snapshot_dir=d, snapshot_interval=0)
+
+        shim.fail = True
+        cc.mark_stale()
+        for _ in range(DEGRADED_THRESHOLD - 1):
+            with pytest.raises(ApiError):
+                cc.list("v1", "Node")
+        cc.list("v1", "Node")  # trips the breaker; stale view served
+        assert cc.degraded
+
+        before = skipped()
+        assert m.write_snapshot_now() is None
+        assert skipped() == before + 1
+        assert not snapshot_mod.snapshot_files(d)
+
+        # the apiserver heals -> the breaker resets -> writes resume
+        shim.fail = False
+        clock.t = 200.0
+        cc.list("v1", "Node")
+        assert not cc.degraded
+        assert m.write_snapshot_now() is not None
+        assert len(snapshot_mod.snapshot_files(d)) == 1
+        assert skipped() == before + 1
+        cc.close()
+
+    def test_federation_section_survives_the_disk_round_trip(
+            self, tmp_path):
+        from tpu_operator.federation.router import CELL_OPEN, GlobalRouter
+
+        clock = Clock(100.0)
+        router = GlobalRouter(["east", "west"], now=clock,
+                              failure_threshold=1)
+        router.record_failure("west")
+        cc = CachedClient(small_fleet(2))
+        cc.list("v1", "Node")
+        snap = snapshot_mod.capture(cc, wall=1000.0,
+                                    federation=router.snapshot())
+        snapshot_mod.write_snapshot(str(tmp_path), snap)
+        cc.close()
+
+        loaded = snapshot_mod.load_latest(str(tmp_path),
+                                          now_wall=1000.0)
+        fed = snapshot_mod.restore_federation(loaded)
+        assert fed is not None
+        successor = GlobalRouter(["east", "west"], now=clock,
+                                 failure_threshold=1)
+        assert successor.adopt(fed)
+        assert successor.cells["west"].state == CELL_OPEN
+        # a snapshot without the section restores to None, not a crash
+        bare = snapshot_mod.capture(cc, wall=1000.0)
+        assert snapshot_mod.restore_federation(bare) is None
+
     def test_snapshot_plane_off_without_dir(self, tmp_path):
         cc = CachedClient(small_fleet(1))
         m = Manager(cc, snapshot_dir="", snapshot_interval=0)

@@ -110,6 +110,19 @@ HEALTH_DIGEST = f"{DOMAIN}/health-digest"
 # what the telemetry-no-flap-evict chaos invariant checks.
 TELEMETRY_CONDITION = "TPUTelemetryHealthy"
 
+# --- multi-cluster federation plane ----------------------------------------
+# which operator cell a SliceRequest is routed to, stamped by the global
+# router (federation/router.py) once a cell is chosen. A cell's placement
+# reconciler only places requests pinned to its own cell (the placement
+# rider in controllers/placement_controller.py); an unpinned request is a
+# global-queue entry the router still owes a decision.
+CELL_PIN = f"{DOMAIN}/cell"
+# data-locality preference: the cell whose storage holds this request's
+# dataset/checkpoints. The router prefers it while its digest-scored
+# capacity stays competitive, but never routes to it while its breaker is
+# Open — locality is a tiebreaker, not an override.
+CELL_AFFINITY = f"{DOMAIN}/cell-affinity"
+
 # --- Pod Security Admission (namespace labels) ----------------------------
 # stamped on the operand namespace so privileged operand pods admit under
 # PSA-enforcing clusters (setPodSecurityLabelsForNamespace analog,

@@ -79,6 +79,26 @@ DIGEST_DEGRADE = "digest-degrade"  # one FAIL digest publish on a node
 #                                    the whole ramp stays on one node
 DIGEST_HEAL = "digest-heal"        # one OK digest publish on a node
 
+# fault kinds consumed by the federation runner (chaos/federation.py);
+# ``arg`` targets a CELL name, not a node
+CELL_PARTITION_START = "cell-partition-start"  # one cell's apiserver
+#                                    unreachable from the global plane:
+#                                    contacts fail, the breaker opens —
+#                                    but the cell keeps running inside
+CELL_PARTITION_END = "cell-partition-end"      # the partition heals
+DIGEST_STALE_START = "digest-stale-start"  # cell reachable, but its
+#                                    digest publishes freeze (a wedged
+#                                    publisher): the router must age-
+#                                    discount, never trust the last words
+DIGEST_STALE_END = "digest-stale-end"
+ROUTER_CRASH = "router-crash"      # the global router dies mid-pass;
+#                                    the runner rebuilds it from its
+#                                    durable snapshot (restart-coherent)
+ROUTER_SPLIT = "router-split"      # a shadow router is spawned from the
+#                                    snapshot and fed the same digests
+#                                    in seeded-permuted order; every
+#                                    decision is compared (split-brain)
+
 
 @dataclass(frozen=True)
 class Fault:
@@ -153,6 +173,11 @@ class FaultPlan:
             "apiserver-brownout": cls._apiserver_brownout,
             "chip-degrade": cls._chip_degrade,
             "saturation-storm": cls._saturation_storm,
+            # federation scenarios: ``node_names`` is the sorted CELL
+            # name list (the federation runner passes it)
+            "cell-partition": cls._cell_partition,
+            "stale-digest": cls._stale_digest,
+            "split-brain-router": cls._split_brain_router,
         }.get(scenario)
         if build is None:
             raise ValueError(f"unknown chaos scenario {scenario!r}")
@@ -686,6 +711,87 @@ class FaultPlan:
                 out.append(Fault(step, POD_CRASH, arg=rng.choice(nodes)))
             if step % 6 == 5:
                 out.append(Fault(step, API_UNAVAILABLE, count=1))
+        return out
+
+    # -- federation scenarios (``nodes`` is the sorted CELL name list) -----
+
+    @classmethod
+    def _federation_load(cls, rng, cells, steps, prefix="freq",
+                         front=2) -> List[Fault]:
+        """Shared request load for the federation scenarios: elastic
+        SliceRequests land on the GLOBAL queue across the opening steps
+        and keep trickling, ~a third carrying a data-locality affinity
+        (arg suffix ``@<cell>``) the router should honor while the cell
+        stays competitive."""
+        out: List[Fault] = []
+        sizes = (4, 4, 8, 8)
+        n = 0
+        for step in range(steps):
+            burst = rng.randrange(2, 5) if step < front else (
+                1 if step % 2 == 0 else 0)
+            for _ in range(burst):
+                n += 1
+                affinity = (rng.choice(cells)
+                            if cells and rng.random() < 0.35 else "")
+                out.append(Fault(step, SLICE_REQUEST,
+                                 arg=f"{prefix}-{n:03d}@{affinity}",
+                                 count=rng.choice(sizes)))
+        return out
+
+    @classmethod
+    def _cell_partition(cls, rng, cells, steps) -> List[Fault]:
+        """One cell drops off the global plane for a seeded window while
+        request load keeps arriving. The breaker must open (no request
+        routed to the Open cell — the no-route-to-open invariant), the
+        cell's bound slices are left alone through the window, and past
+        the condemnation horizon they migrate cross-cell with no acked
+        work lost. A router crash lands mid-window: the rebuilt-from-
+        snapshot router must carry the Open/backoff state forward, and
+        the restart-coherent rerun must settle byte-identically."""
+        out = cls._federation_load(rng, cells, steps)
+        victim = rng.choice(cells) if cells else ""
+        start = min(2, steps - 1)
+        end = min(start + max(3, steps // 2), steps - 1)
+        out.append(Fault(start, CELL_PARTITION_START, arg=victim,
+                         seconds=float(max(0, end - start))))
+        out.append(Fault(end, CELL_PARTITION_END, arg=victim))
+        if steps > start + 2:
+            out.append(Fault(rng.randrange(start + 1, end), ROUTER_CRASH))
+        return out
+
+    @classmethod
+    def _stale_digest(cls, rng, cells, steps) -> List[Fault]:
+        """One cell stays perfectly reachable but its digest publisher
+        wedges: seq stops advancing while the cell's real capacity
+        drains under routed load. The router must age-discount the
+        frozen digest toward zero — a stale cell fades out of the score
+        race — instead of stampeding capacity its last words promised."""
+        out = cls._federation_load(rng, cells, steps)
+        victim = rng.choice(cells) if cells else ""
+        start = min(1, steps - 1)
+        end = min(start + max(3, steps // 2), steps - 1)
+        out.append(Fault(start, DIGEST_STALE_START, arg=victim,
+                         seconds=float(max(0, end - start))))
+        out.append(Fault(end, DIGEST_STALE_END, arg=victim))
+        return out
+
+    @classmethod
+    def _split_brain_router(cls, rng, cells, steps) -> List[Fault]:
+        """A shadow router is forked from the primary's snapshot and fed
+        the same digest stream in seeded-permuted arrival order, with a
+        cell partition thrown in so breaker transitions interleave with
+        digest delivery. Every routing decision is cross-checked: any
+        divergence is a violation — the arrival-order-independence
+        property, run as chaos instead of a unit test."""
+        out = cls._federation_load(rng, cells, steps)
+        out.append(Fault(0, ROUTER_SPLIT))
+        if cells and steps >= 4:
+            victim = rng.choice(cells)
+            start = min(3, steps - 1)
+            end = min(start + 2, steps - 1)
+            out.append(Fault(start, CELL_PARTITION_START, arg=victim,
+                             seconds=float(max(0, end - start))))
+            out.append(Fault(end, CELL_PARTITION_END, arg=victim))
         return out
 
 

@@ -802,6 +802,108 @@ class InvariantChecker:
         self._check_index(step, nodes)
 
 
+class CrossCellWorkChecker:
+    """Federation invariants over N cells (chaos/federation.py):
+
+    - **no-lost-work-cross-cell**: per request key, the acked
+      checkpoint high-water (max of ``status.migration.ackedStep``,
+      ``status.progress.checkpointedStep`` and the intent-ack
+      annotation, observed across EVERY cell) must never regress, and
+      any observed ``restoredStep`` must be at or above it — a restore
+      below the high-water after a hop means acked work evaporated in
+      transit between clusters.
+    - **single-binding**: a request Placed in more than one cell at the
+      same observation, excluding the source copy of an in-flight
+      outbound handoff (it carries ``migration.toCell``; the window
+      between the destination's bind and the source's retirement is the
+      handshake working as designed, not a double-spend).
+    - **no-route-to-open** is recorded by the runner via :meth:`record`
+      — only the decision site knows the breaker state at decision
+      time.
+
+    Observes each cell's RAW client (never the chaos-wrapped one): the
+    auditor sees ground truth even while the global plane is
+    partitioned away from it.
+    """
+
+    def __init__(self, namespace: str = "default"):
+        self.namespace = namespace
+        self.violations: List[Violation] = []
+        self._high: Dict[str, int] = {}
+        # last restoredStep judged per key (judge each restore once)
+        self._judged: Dict[str, int] = {}
+
+    def record(self, invariant: str, step: int, detail: str) -> None:
+        self.violations.append(Violation(invariant, step, detail))
+        OPERATOR_METRICS.chaos_invariant_violations.labels(
+            invariant=invariant).inc()
+
+    def to_list(self) -> List[dict]:
+        return [v.to_dict() for v in self.violations]
+
+    @property
+    def acked_high_water(self) -> Dict[str, int]:
+        return dict(self._high)
+
+    def observe(self, step: int, cells: Dict[str, Client]) -> None:
+        from ..api import labels as L
+        from ..api.slicerequest import KIND_SLICE_REQUEST, V1ALPHA1
+
+        placed_in: Dict[str, List[str]] = {}
+        for cell_name in sorted(cells):
+            client = cells[cell_name]
+            for cr in client.list(V1ALPHA1, KIND_SLICE_REQUEST,
+                                  ListOptions(namespace=self.namespace)):
+                ns = get_nested(cr, "metadata", "namespace") or "default"
+                key = f"{ns}/{name_of(cr)}"
+                mig = get_nested(cr, "status", "migration",
+                                 default={}) or {}
+                # the high-water is built from ACK points only — the
+                # steps a workload declared durably checkpointed for a
+                # handoff. The live checkpointedStep is deliberately
+                # excluded: a resumed twin trains past its restore
+                # point immediately, and holding yesterday's
+                # restoredStep against today's progress would flag the
+                # recovery working as designed.
+                acked = [mig.get("ackedStep"),
+                         (get_nested(cr, "metadata", "annotations",
+                                     default={}) or {}).get(
+                             L.SLICE_INTENT_ACK)]
+                for val in acked:
+                    try:
+                        val = int(val)
+                    except (TypeError, ValueError):
+                        continue
+                    if val > self._high.get(key, -1):
+                        self._high[key] = val
+                restored = mig.get("restoredStep")
+                try:
+                    restored = int(restored)
+                except (TypeError, ValueError):
+                    restored = None
+                # judge each restore once, when it appears (or moves):
+                # the marker is historical and must not be re-tried
+                # against high-waters acked after it
+                if restored is not None \
+                        and self._judged.get(key) != restored:
+                    self._judged[key] = restored
+                    if restored < self._high.get(key, -1):
+                        self.record(
+                            "no-lost-work-cross-cell", step,
+                            f"{key} restored at step {restored} in "
+                            f"{cell_name}, below the acked high-water "
+                            f"{self._high[key]}")
+                if get_nested(cr, "status", "phase") == "Placed" \
+                        and not mig.get("toCell"):
+                    placed_in.setdefault(key, []).append(cell_name)
+        for key, where in sorted(placed_in.items()):
+            if len(where) > 1:
+                self.record(
+                    "single-binding", step,
+                    f"{key} Placed in {len(where)} cells at once: "
+                    f"{sorted(where)}")
+
+
 def namespace_key(obj: dict) -> str:
     return get_nested(obj, "metadata", "namespace", default="") or ""
 

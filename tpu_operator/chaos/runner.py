@@ -113,11 +113,17 @@ from .invariants import (
     settled_state_digest,
 )
 
+# federation scenarios run N cells under the global router; they have
+# their own runner (chaos/federation.py) — run_scenario dispatches there
+FEDERATION_SCENARIOS = ("cell-partition", "stale-digest",
+                        "split-brain-router")
+
 SCENARIOS = ("conflict-storm", "watch-flap", "node-churn",
              "upgrade-under-fire", "chip-loss", "operand-drift",
              "dag-race", "placement-contention", "placement-storm",
              "slice-migrate", "shard-failover", "operator-crash",
-             "apiserver-brownout", "chip-degrade", "saturation-storm")
+             "apiserver-brownout", "chip-degrade", "saturation-storm"
+             ) + FEDERATION_SCENARIOS
 
 # scenarios that run the placement controller (they create SliceRequests)
 PLACEMENT_SCENARIOS = ("placement-contention", "placement-storm",
@@ -1027,6 +1033,14 @@ def run_scenario(scenario: str, nodes: int = 100, seed: int = 0,
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown chaos scenario {scenario!r}; "
                          f"choose from {', '.join(SCENARIOS)}")
+    if scenario in FEDERATION_SCENARIOS:
+        # N-cell scenarios run the federation plane's own loop; it owns
+        # its globals ctx (and its own restart-coherent wrapper), so it
+        # is imported lazily to keep the module graphs independent
+        from .federation import run_federation_scenario
+
+        return run_federation_scenario(scenario, nodes=nodes, seed=seed,
+                                       steps=steps)
     import logging
 
     # injected faults make the controllers log real ERROR tracebacks by

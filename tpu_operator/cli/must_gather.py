@@ -200,6 +200,22 @@ def gather(client, out_dir: pathlib.Path) -> dict:
     except Exception as e:
         summary["errors"].append(f"quota: {e}")
     try:
+        # the federation picture (the `tpuop-cfg cells -f` input): the
+        # SliceRequest fleet grouped by cell pin. A bundle has no live
+        # GlobalRouter, so breaker states aren't fabricated — the
+        # cluster-derived half (pins, phases, unrouted queue) still
+        # explains where every request is bound
+        from ..federation.router import cells_report
+
+        d = out_dir / "federation"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "cells.json").write_text(
+            json.dumps(cells_report(client, "default"),
+                       indent=2, sort_keys=True))
+        summary["federation_rendered"] = True
+    except Exception as e:
+        summary["errors"].append(f"federation: {e}")
+    try:
         # the informer-cache picture (/debug/cache equivalent): unwrap
         # the client stack the same way Manager.find_cache does
         inner, stats = client, None

@@ -287,7 +287,9 @@ class OperatorMetrics:
         self.snapshot_writes = c(
             "tpu_operator_snapshot_writes_total",
             "Durable cache/index snapshot write attempts by outcome "
-            "(written|failed)",
+            "(written|failed|skipped_degraded — the cache breaker was "
+            "Degraded, so capturing would embalm a stale view under a "
+            "fresh timestamp)",
             labelnames=("outcome",))
         self.snapshot_restores = c(
             "tpu_operator_snapshot_restores_total",
@@ -351,6 +353,40 @@ class OperatorMetrics:
             "Preemption-budget tokens a quota class has left in the "
             "current window (preemptions the class may still suffer)",
             labelnames=("class",))
+        # multi-cluster federation plane (federation/): per-cell breaker
+        # state and digest freshness, global routing decision outcomes
+        # and latency, breaker probes against Open cells, and cross-cell
+        # elastic migrations — the observables behind the
+        # no-lost-work-cross-cell invariant
+        self.federation_cell_state = g(
+            "tpu_operator_federation_cell_state",
+            "Circuit-breaker state of one federation cell "
+            "(0 Healthy / 1 Suspect / 2 Open)",
+            labelnames=("cell",))
+        self.federation_digest_age = g(
+            "tpu_operator_federation_digest_age_seconds",
+            "Age of the newest fleet digest held for one cell "
+            "(-1 when no digest has ever arrived)",
+            labelnames=("cell",))
+        self.federation_route_decisions = c(
+            "tpu_operator_federation_route_decisions_total",
+            "Global router placement decisions, by outcome "
+            "(routed|no-cell)",
+            labelnames=("outcome",))
+        self.federation_route_latency = h(
+            "tpu_operator_federation_route_latency_seconds",
+            "Wall time of one global routing decision (score every "
+            "cell's digest + pick)")
+        self.federation_breaker_probes = c(
+            "tpu_operator_federation_breaker_probes_total",
+            "Backoff probes sent to an Open cell that failed (success "
+            "closes the breaker and ends the series' growth)",
+            labelnames=("cell",))
+        self.federation_cross_cell_migrations = c(
+            "tpu_operator_federation_cross_cell_migrations_total",
+            "Cross-cell elastic migrations of slices out of condemned "
+            "cells, by outcome (migrated|failed|aborted)",
+            labelnames=("outcome",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

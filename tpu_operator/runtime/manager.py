@@ -639,6 +639,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
                                   sort_keys=True).encode()
             code = 200
             ctype = "application/json"
+        elif url.path == "/debug/cells":
+            import json
+
+            fed = self.manager.find_federation()
+            if fed is None:
+                body = b'{"cells": {}, "unrouted": [], "router": null}'
+            else:
+                body = json.dumps(fed.federation_report(),
+                                  sort_keys=True).encode()
+            code = 200
+            ctype = "application/json"
         elif url.path == "/debug/slo":
             import json
 
@@ -743,6 +754,20 @@ class Manager:
                 hops += 1
         return None
 
+    def find_federation(self):
+        """The reconciler carrying the global router (anything with a
+        ``router_snapshot``), if any controller holds one — same
+        unwrap discipline as find_admission (the snapshot federation
+        section and ``tpuop-cfg cells --url`` source)."""
+        for ctrl in self.controllers:
+            r, hops = getattr(ctrl, "reconciler", None), 0
+            while r is not None and hops < 8:
+                if callable(getattr(r, "router_snapshot", None)):
+                    return r
+                r = getattr(r, "inner", None)
+                hops += 1
+        return None
+
     @staticmethod
     def _default_on_lost():  # pragma: no cover - process exit
         import os
@@ -823,6 +848,15 @@ class Manager:
                             seeded += hook(payload.get("objects") or [])
                 if seeded:
                     outcome["requeue_state_seeded"] = seeded
+                # federation router state: breaker ledgers + held
+                # digests, so a router restart mid-partition keeps its
+                # Open/backoff decisions instead of re-hammering a
+                # partitioned cell from a cold breaker
+                fed_state = snapshot_mod.restore_federation(snap)
+                fed = self.find_federation()
+                if fed_state is not None and fed is not None:
+                    if fed.adopt_router_state(fed_state):
+                        outcome["federation_restored"] = True
         except Exception as exc:  # a bad restore must not block startup
             log.exception("snapshot restore failed; cold start")
             outcome["outcome"] = "failed"
@@ -835,14 +869,30 @@ class Manager:
 
     def write_snapshot_now(self) -> Optional[str]:
         """Capture cache + index and persist atomically. Returns the
-        written path, or None when the plane is off / capture failed."""
+        written path, or None when the plane is off / capture failed.
+
+        Refuses to capture while the cache breaker is Degraded: the
+        stores are then a stale view the breaker has already stopped
+        trusting, but a snapshot written from them would carry a *fresh*
+        ``written_at`` — restorable (and trusted) within
+        OPERATOR_SNAPSHOT_MAX_AGE long after the staleness it embalmed.
+        The previous (healthy-epoch) snapshot on disk stays the restore
+        candidate instead."""
         from . import snapshot as snapshot_mod
 
         cache = self.find_cache()
         if self.snapshot_dir is None or cache is None:
             return None
+        if getattr(cache, "degraded", False):
+            OPERATOR_METRICS.snapshot_writes.labels(
+                outcome="skipped_degraded").inc()
+            return None
+        fed = self.find_federation()
         try:
-            snap = snapshot_mod.capture(cache, index=self._snapshot_index())
+            snap = snapshot_mod.capture(
+                cache, index=self._snapshot_index(),
+                federation=fed.router_snapshot() if fed is not None
+                else None)
             path = snapshot_mod.write_snapshot(self.snapshot_dir, snap)
         except Exception:  # pragma: no cover - disk trouble is non-fatal
             log.exception("snapshot write failed")

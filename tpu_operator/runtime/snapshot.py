@@ -46,8 +46,11 @@ logger = logging.getLogger("tpu_operator.snapshot")
 #: tree during the C-driven JSON parse — restore pays no per-object
 #: freeze walk. v3: optional ``admission`` section (per-class deficit
 #: clocks + preemption-budget buckets) so a crash never resets
-#: starvation accounting.
-SCHEMA_VERSION = 3
+#: starvation accounting. v4: optional ``federation`` section (the
+#: global router's per-cell breaker ledgers + held digests) so a router
+#: crash mid-partition restarts with its Open/backoff decisions intact
+#: instead of hammering a partitioned cell from a cold breaker.
+SCHEMA_VERSION = 4
 
 SNAPSHOT_PREFIX = "snapshot-"
 SNAPSHOT_SUFFIX = ".json"
@@ -106,7 +109,8 @@ def _split_gvk(key: str) -> tuple:
 
 def capture(cached, index=None, now: Optional[Callable[[], float]] = None,
             wall: Optional[float] = None,
-            admission: Optional[dict] = None) -> dict:
+            admission: Optional[dict] = None,
+            federation: Optional[dict] = None) -> dict:
     """Distill the live cache (and optionally the placement index) into
     one JSON-serializable snapshot dict. Objects are thawed copies —
     the snapshot must not alias the live frozen stores once serialized.
@@ -153,6 +157,10 @@ def capture(cached, index=None, now: Optional[Callable[[], float]] = None,
         # the placement controller's admission_snapshot(): deficit
         # clocks and preemption-budget token buckets, JSON scalars only
         snap["admission"] = thaw_obj(admission)
+    if federation is not None:
+        # the global router's snapshot(): per-cell breaker ledgers and
+        # held digests (federation/router.py), JSON scalars only
+        snap["federation"] = thaw_obj(federation)
     return snap
 
 
@@ -227,6 +235,17 @@ def restore_admission(snap) -> Optional[dict]:
     carries garbage — a bad section degrades to fresh accounting, never
     a crash."""
     doc = snap.get("admission")
+    if not isinstance(doc, dict):
+        return None
+    return thaw_obj(doc)
+
+
+def restore_federation(snap) -> Optional[dict]:
+    """The snapshot's federation section (the global router's breaker
+    ledgers + held digests) as a plain dict, or None when the snapshot
+    predates it or carries garbage — a bad section degrades to a cold
+    breaker (safe: cells re-prove themselves), never a crash."""
+    doc = snap.get("federation")
     if not isinstance(doc, dict):
         return None
     return thaw_obj(doc)
@@ -384,6 +403,7 @@ def snapshot_metadata(directory: Optional[str],
                         for key, dump in sorted(snap["stores"].items())},
             "has_index": "index_nodes" in snap,
             "has_admission": "admission" in snap,
+            "has_federation": "federation" in snap,
         }
     marker = os.path.join(directory, RESTORE_MARKER)
     try:

@@ -515,6 +515,39 @@ class FleetIndex:
         with self._lock:
             return FleetState.utilization(self)
 
+    def digest_stats(self) -> Dict[str, object]:
+        """The cell-digest source (federation/digest.py): one locked
+        pass distilling this index into the handful of numbers a global
+        router scores cells by — free/placed chips, per-generation
+        free-chip headroom, a fragmentation score (1 - largest
+        contiguous free run / total free: 0.0 is one solid block, →1.0
+        is confetti), and the condemned-node count. O(domains) over the
+        cached free-run structure, cheap enough for a per-publish call."""
+        with self._lock:
+            totals = FleetState.chip_totals(self)
+            free = sum(b["free"] for b in totals.values())
+            placed = sum(b["placed"] for b in totals.values())
+            largest = 0
+            for group in self.slices:
+                for run in self._free_runs(group):
+                    chips = sum(h.chips for h in run)
+                    if chips > largest:
+                        largest = chips
+            condemned = sum(
+                1 for node in self._nodes.values()
+                if not _node_telemetry_ok(node))
+            return {
+                "hosts": len(self._chips),
+                "chips_free": free,
+                "chips_placed": placed,
+                "utilization": (round(placed / (free + placed), 4)
+                                if free + placed else 0.0),
+                "headroom": {g: b["free"] for g, b in sorted(totals.items())},
+                "fragmentation": (round(1.0 - largest / free, 4)
+                                  if free else 0.0),
+                "condemned": condemned,
+            }
+
     # -- queries ------------------------------------------------------------
 
     @staticmethod
