@@ -512,7 +512,10 @@ def _controlplane_doc() -> dict | None:
                 run_migration_bench,
             )
 
-            mg = run_migration_bench(min(100, n))
+            mg = run_migration_bench(
+                min(100, n),
+                include_resize=not os.environ.get(
+                    "TPUOP_BENCH_SKIP_RESHARD"))
             doc["migration"] = {
                 "n_tpu_nodes": mg["n_tpu_nodes"],
                 "n_requests": mg["n_requests"],
@@ -528,6 +531,26 @@ def _controlplane_doc() -> dict | None:
             }
             doc["slice_migration_p95_s"] = round(
                 mg["slice_migration_p95_s"], 2)
+            # live-resharding rider: same-domain resize latency via the
+            # direct shard handoff vs the full-checkpoint path, plus the
+            # byte bill of each (TPUOP_BENCH_SKIP_RESHARD skips it).
+            # resize_p95_s / reshard_bytes_ratio at top level are the
+            # headline figures tests/test_bench_guard.py tracks.
+            if "resize_p95_s" in mg:
+                doc["reshard"] = {
+                    "resizes": mg["resizes"],
+                    "resharded": mg["resharded"],
+                    "fallbacks": mg["reshard_fallbacks"],
+                    "p50_s": round(mg["resize_p50_s"], 2),
+                    "full_p50_s": round(mg["resize_full_p50_s"], 2),
+                    "full_p95_s": round(mg["resize_full_p95_s"], 2),
+                    "speedup_p95": round(mg["resize_speedup_p95"], 2),
+                    "bytes_moved": mg["reshard_bytes_moved"],
+                    "bytes_full": mg["reshard_bytes_full"],
+                }
+                doc["resize_p95_s"] = round(mg["resize_p95_s"], 2)
+                doc["reshard_bytes_ratio"] = round(
+                    mg["reshard_bytes_ratio"], 4)
         except Exception as e:
             doc["migration"] = {"error": f"{type(e).__name__}: {e}"}
         # 10k-node fleet survivability: cache bytes/node (projected, vs

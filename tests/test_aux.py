@@ -171,6 +171,40 @@ class TestMustGather:
         assert summary["kinds"]["PodDisruptionBudget"] == 1
         assert list((out / "upgrade").glob("poddisruptionbudget_*.yaml"))
 
+    def test_reshard_plans_collected_in_bundle(self, tmp_path):
+        """A migrating request's reshard picture (path, byte bill, the
+        acked shard layout) lands in the bundle — the file support
+        needs to answer 'why did this resize move N bytes'."""
+        from tpu_operator.api.slicerequest import new_slice_request
+        from tpu_operator.cli.must_gather import gather
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        cr = new_slice_request("ereq-001", {"chips": 4})
+        cr["metadata"]["namespace"] = "tpu-operator"
+        cr["status"] = {
+            "phase": "Placed", "chips": 4, "nodes": ["n1"],
+            "migrations": 1,
+            "migration": {
+                "phase": "Resharding", "path": "sharded-handoff",
+                "bytesMoved": 4096, "shardsMoved": 2, "ackedStep": 9,
+                "layout": {"version": 1, "shards": {
+                    "0": {"owner": "n1", "bytes": 2048},
+                    "1": {"owner": "n1", "bytes": 2048}}}}}
+        c.create(cr)
+        quiet = new_slice_request("rreq-001", {"chips": 4})
+        quiet["status"] = {"phase": "Placed"}  # no migration: no file
+        c.create(quiet)
+        out = tmp_path / "bundle"
+        summary = gather(c, out)
+        assert summary["reshard_plans"] == 1
+        doc = json.loads(
+            (out / "reshard" / "tpu-operator_ereq-001.json").read_text())
+        assert doc["path"] == "sharded-handoff"
+        assert doc["bytesMoved"] == 4096
+        assert doc["shardsMoved"] == 2
+        assert doc["layout"]["shards"]["0"]["owner"] == "n1"
+
     def test_events_collected_in_bundle(self, tmp_path):
         from tpu_operator.cli.must_gather import gather
         from tpu_operator.runtime import FakeClient

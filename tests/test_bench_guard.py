@@ -421,6 +421,52 @@ def test_federation_quality_bounded():
         f"bar vs the flat plane")
 
 
+def test_resize_p95_not_regressed():
+    """Same contract as the migration guard, for the same-domain resize
+    stall p95 via the direct shard handoff (benchmarks.controlplane.
+    run_resize_bench): the latest round's resize_p95_s may be at most
+    25% above the best on record. Skips until a round carrying the key
+    is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "resize_p95_s")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records resize_p95_s yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    best = min(rounds_with_figure.values())
+    assert latest <= best * REGRESSION_HEADROOM, (
+        f"BENCH_LOCAL_r{latest_round:02d} resize_p95_s={latest:.2f}s "
+        f"regressed >25% vs best on record ({best:.2f}s)")
+
+
+def test_reshard_bytes_ratio_bounded():
+    """Absolute acceptance bar, like the warm_over_cold gate: the latest
+    round carrying ``reshard_bytes_ratio`` (bytes the direct shard
+    handoff moved / bytes the full-checkpoint path re-fetched for the
+    SAME seeded resizes) must stay at or below 0.55 — a same-domain
+    halving moves half the shards, so a ratio drifting above that means
+    the planner stopped keeping surviving hosts' shards in place. Skips
+    until a round carrying the key is committed."""
+    records = _bench_records()
+    if not records:
+        pytest.skip("no BENCH_LOCAL_r*.json records committed")
+    per_round = {rnd: _keyed_figures(doc, "reshard_bytes_ratio")
+                 for rnd, doc in records}
+    rounds_with_figure = {r: min(v) for r, v in per_round.items() if v}
+    if not rounds_with_figure:
+        pytest.skip("no committed round records reshard_bytes_ratio yet")
+    latest_round = max(rounds_with_figure)
+    latest = rounds_with_figure[latest_round]
+    assert latest <= 0.55, (
+        f"BENCH_LOCAL_r{latest_round:02d} reshard_bytes_ratio="
+        f"{latest:.4f} breaks the bytes-moved <= 0.55x full-checkpoint "
+        f"acceptance bar")
+
+
 def test_records_parse_and_carry_controlplane_rider():
     """Sanity on the guard's own inputs: the latest record parses and
     carries a controlplane block somewhere (the rider bench.py attaches

@@ -713,6 +713,58 @@ class TestSlicesView:
         assert doc["requests"] == []
         assert "no kubeconfig anywhere" in doc["error"]
 
+    def _seed_resharded(self):
+        from tpu_operator.api.slicerequest import new_slice_request
+        from tpu_operator.runtime import FakeClient
+
+        c = FakeClient()
+        fast = new_slice_request("ereq-003", {"chips": 4})
+        fast["metadata"]["namespace"] = "tpu-operator"
+        fast["status"] = {
+            "phase": "Placed", "chips": 4, "nodes": ["n1"],
+            "migrations": 1,
+            "migration": {"phase": "Resumed", "intent": "shrink",
+                          "ackedStep": 42, "restoredStep": 42,
+                          "to": ["n1"], "path": "sharded-handoff",
+                          "bytesMoved": 524288, "shardsMoved": 8}}
+        c.create(fast)
+        full = new_slice_request("ereq-004", {"chips": 4})
+        full["metadata"]["namespace"] = "tpu-operator"
+        full["status"] = {
+            "phase": "Placed", "chips": 4, "nodes": ["n9"],
+            "migrations": 1,
+            "migration": {"phase": "Resumed", "intent": "migrate",
+                          "ackedStep": 7, "restoredStep": 7,
+                          "to": ["n9"], "path": "full-checkpoint"}}
+        c.create(full)
+        return c
+
+    def test_report_carries_reshard_path_and_byte_bill(self):
+        from tpu_operator.cli.tpuop_cfg import _slices_report
+
+        rep = _slices_report(self._seed_resharded(), "tpu-operator")
+        fast, full = rep["requests"]
+        assert fast["migration"]["path"] == "sharded-handoff"
+        assert fast["migration"]["bytesMoved"] == 524288
+        assert fast["migration"]["shardsMoved"] == 8
+        assert full["migration"]["path"] == "full-checkpoint"
+        assert full["migration"]["bytesMoved"] is None
+
+    def test_text_renderer_golden_reshard_lines(self, capsys):
+        """Golden check on the --migrations text: the path line shows
+        which road the move took, with the byte/shard bill only on the
+        sharded handoff."""
+        from tpu_operator.cli.tpuop_cfg import (_print_slices_text,
+                                                _slices_report)
+
+        rep = _slices_report(self._seed_resharded(), "tpu-operator")
+        _print_slices_text(rep, migrations=True)
+        out = capsys.readouterr().out
+        assert "  path: sharded-handoff (8 shard(s), 524288 bytes " \
+               "moved)" in out
+        assert "  path: full-checkpoint\n" in out
+        assert "completed migrations: 2" in out
+
 
 class TestQuotaView:
     """`tpuop-cfg quota`: the fair-share admission explainer, live

@@ -203,6 +203,28 @@ class TestSliceMigrateScenario:
         assert faults.get("workload-crash", 0) >= 1
         assert faults.get("slice-resize", 0) >= 1
 
+    def test_reshard_crash_arcs_injected_without_losing_work(self):
+        """The reshard-crash arcs (armed mid-handoff crash + the forced
+        layout-mismatch fallback) fire in the scenario, and the verdict
+        still reports no lost acked work: every row that finished a move
+        carries an explicit path, and the byte bill only ever appears on
+        the sharded one."""
+        v = run_scenario("slice-migrate", nodes=32, seed=7)
+        assert v["ok"] is True
+        assert v["faults_injected"].get("reshard-crash", 0) >= 1
+        mig = v["migrations"]
+        assert mig["resharded"] == sum(
+            1 for r in mig["rows"] if r["path"] == "sharded-handoff")
+        for row in mig["rows"]:
+            if row["phase"] == "Resumed":
+                assert row["path"] in ("sharded-handoff",
+                                       "full-checkpoint")
+            if row["path"] == "sharded-handoff":
+                assert row["bytesMoved"] is not None
+                assert row["shardsMoved"] is not None
+            else:
+                assert row["bytesMoved"] is None
+
 
 class TestFederationScenarios:
     """The federation plane's own acceptance bars, beyond the

@@ -241,3 +241,314 @@ class TestCrashRecovery:
         cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
         assert get_nested(cr, "status", "migration",
                           "restoredStep") >= acked
+
+
+def _shrink_spec(c, name="job", chips=4):
+    from tpu_operator.runtime.objects import set_nested, thaw_obj
+
+    cr = thaw_obj(c.get(V1ALPHA1, KIND_SLICE_REQUEST, name, "default"))
+    set_nested(cr, chips, "spec", "chips")
+    c.update(cr)
+
+
+class TestShardLayout:
+    """Pure layout/planner layer: deterministic, bytes accounted, and
+    minimal — surviving owners keep their shards."""
+
+    def test_build_layout_deterministic_and_bytes_accounted(self):
+        from tpu_operator.workloads.elastic import (
+            LAYOUT_VERSION,
+            build_layout,
+        )
+
+        a = build_layout(["h1", "h0"], 1000, n_shards=16)
+        b = build_layout(["h0", "h1"], 1000, n_shards=16)
+        assert a == b                      # host order never matters
+        assert a["version"] == LAYOUT_VERSION
+        assert sum(s["bytes"] for s in a["shards"].values()) == 1000
+        owners = {s["owner"] for s in a["shards"].values()}
+        assert owners == {"h0", "h1"}
+        per_owner = {}
+        for s in a["shards"].values():
+            per_owner[s["owner"]] = per_owner.get(s["owner"], 0) + 1
+        assert max(per_owner.values()) - min(per_owner.values()) <= 1
+
+    def test_rebalance_moves_only_departed_owners_shards(self):
+        from tpu_operator.workloads.elastic import (
+            build_layout,
+            plan_reshard,
+            rebalance_layout,
+        )
+
+        old = build_layout(["h0", "h1", "h2", "h3"], 1 << 20)
+        new = rebalance_layout(old, ["h0", "h1"])
+        plan = plan_reshard(old, new)
+        assert plan["compatible"]
+        # exactly the departed hosts' shards move, none of the others
+        departed = {sid for sid, s in old["shards"].items()
+                    if s["owner"] in ("h2", "h3")}
+        moved = {m["shard"] for m in plan["moves"]}
+        assert moved == departed
+        assert plan["shardsMoved"] == len(departed)
+        assert plan["bytesMoved"] == sum(
+            int(old["shards"][sid]["bytes"]) for sid in departed)
+        assert plan["bytesTotal"] == 1 << 20
+        # halving the host set moves (about) half the bytes
+        assert plan["bytesMoved"] * 2 == plan["bytesTotal"]
+
+    def test_rebalance_grow_and_identity(self):
+        from tpu_operator.workloads.elastic import (
+            build_layout,
+            plan_reshard,
+            rebalance_layout,
+        )
+
+        old = build_layout(["h0"], 1 << 20)
+        same = rebalance_layout(old, ["h0"])
+        assert plan_reshard(old, same)["shardsMoved"] == 0
+        grown = rebalance_layout(old, ["h0", "h1"])
+        plan = plan_reshard(old, grown)
+        assert plan["compatible"] and plan["shardsMoved"] > 0
+        # h0 keeps at least its fair share in place
+        kept = sum(1 for sid, s in old["shards"].items()
+                   if grown["shards"][sid]["owner"] == s["owner"])
+        assert kept >= len(old["shards"]) // 2
+
+    def test_plan_incompatible_on_version_skew_and_shape(self):
+        from tpu_operator.workloads.elastic import (
+            build_layout,
+            plan_reshard,
+        )
+
+        a = build_layout(["h0"], 100, n_shards=4)
+        b = build_layout(["h0"], 100, n_shards=4, version=2)
+        plan = plan_reshard(a, b)
+        assert not plan["compatible"]
+        assert "version" in plan["reason"]
+        c_ = build_layout(["h0"], 100, n_shards=8)
+        assert not plan_reshard(a, c_)["compatible"]
+        assert not plan_reshard(None, a)["compatible"]
+
+
+class TestShardedStore:
+    """Sharded layout on MemoryCheckpointStore: the manifest IS the
+    commit point — a partial shard set never yields a manifest."""
+
+    def test_finalized_save_exposes_manifest_and_shards(self):
+        from tpu_operator.workloads.elastic import build_layout
+
+        store = MemoryCheckpointStore()
+        lay = build_layout(["h0", "h1"], 1 << 10)
+        store.save(6, payload={"step": 6}, layout=lay)
+        assert store.manifest(6) == lay
+        sids = list(lay["shards"])[:3]
+        payload, fetched = store.restore_shards(6, sids)
+        assert payload["step"] == 6
+        assert fetched == sum(int(lay["shards"][s]["bytes"])
+                              for s in sids)
+
+    def test_partial_save_never_yields_manifest(self):
+        from tpu_operator.workloads.elastic import build_layout
+
+        store = MemoryCheckpointStore()
+        lay = build_layout(["h0", "h1"], 1 << 10)
+        store.save(6, payload={"step": 6}, layout=lay)
+        relay = build_layout(["h0"], 1 << 10)
+        store.save(6, payload={"step": 6}, partial=True, layout=relay)
+        # the torn re-shard neither finalizes nor shadows: the
+        # finalized manifest still describes the ORIGINAL layout
+        assert store.manifest(6) == lay
+        assert store.latest_step() == 6
+        store.save(9, payload={"step": 9}, partial=True, layout=relay)
+        assert store.manifest(9) is None
+        with pytest.raises(FileNotFoundError):
+            store.restore_shards(9, ["0"])
+
+    def test_restore_shards_unknown_shard_raises(self):
+        from tpu_operator.workloads.elastic import build_layout
+
+        store = MemoryCheckpointStore()
+        store.save(3, payload={"step": 3},
+                   layout=build_layout(["h0"], 64, n_shards=4))
+        with pytest.raises(FileNotFoundError):
+            store.restore_shards(3, ["99"])
+
+
+class TestReshardFastPath:
+    """Same-ICI-domain resize rides the direct shard handoff: phase
+    walks Checkpointed -> Resharding -> Resumed, only reassigned shards
+    move, and every mismatch degrades to the full-checkpoint path."""
+
+    def _resize_to_checkpointed(self, wl, rec, c, clock, chips=4):
+        req = Request(name="job", namespace="default")
+        _shrink_spec(c, chips=chips)
+        rec.reconcile(req)               # posts the shrink intent
+        clock.t += 1
+        wl.tick()                        # acks + publishes the layout
+        rec.reconcile(req)               # rebinds (fast or full path)
+        return c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+
+    def test_same_domain_shrink_takes_sharded_handoff(self):
+        from tpu_operator.api.slicerequest import MIG_RESHARDING
+
+        c = two_pool_fleet()
+        clock = Clock()
+        rec, bound = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock,
+                             state_bytes=1 << 20)
+        for _ in range(3):
+            wl.tick()
+            clock.t += 1
+        cr = self._resize_to_checkpointed(wl, rec, c, clock)
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_RESHARDING
+        assert mig["path"] == "sharded-handoff"
+        assert len(get_nested(cr, "status", "nodes")) == 1
+        # the surviving host stays inside the old binding (same domain)
+        assert set(get_nested(cr, "status", "nodes")) < set(bound)
+        acked = mig["ackedStep"]
+        wl.tick()                        # direct handoff restore
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_RESUMED
+        assert mig["restoredStep"] == acked
+        # only the departed host's shards moved: half the bytes
+        assert 0 < mig["bytesMoved"] < 1 << 20
+        assert mig["bytesMoved"] * 2 == 1 << 20
+        assert mig["shardsMoved"] > 0
+
+    def test_reshard_crash_mid_handoff_keeps_acked_work(self):
+        """A kill landing mid-shard-handoff leaves a torn re-shard
+        manifest; it can never shadow the finalized acked step, so the
+        restart restores the acked step (no-lost-work) via the full
+        path."""
+        c = two_pool_fleet()
+        clock = Clock()
+        rec, _ = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock,
+                             state_bytes=1 << 20)
+        for _ in range(3):
+            wl.tick()
+            clock.t += 1
+        wl.arm_reshard_crash()
+        cr = self._resize_to_checkpointed(wl, rec, c, clock)
+        acked = get_nested(cr, "status", "migration", "ackedStep")
+        wl.tick()                        # dies mid-handoff (torn save)
+        assert wl.store.latest_step() == acked   # tear never finalized
+        wl.tick()                        # restart: full-path restore
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_RESUMED
+        assert mig["restoredStep"] == acked
+        assert wl.step == acked
+        wl.tick()
+        assert wl.step > acked           # training moves again
+
+    def test_layout_version_mismatch_falls_back_to_full_path(self):
+        c = two_pool_fleet()
+        clock = Clock()
+        rec, _ = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        for _ in range(3):
+            wl.tick()
+            clock.t += 1
+        wl.force_layout_mismatch()
+        wl.tick()                        # re-checkpoint at the new version
+        clock.t += 1
+        cr = self._resize_to_checkpointed(wl, rec, c, clock)
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_REBOUND
+        assert mig["path"] == "full-checkpoint"
+        acked = mig["ackedStep"]
+        wl.tick()
+        cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        mig = get_nested(cr, "status", "migration")
+        assert mig["phase"] == MIG_RESUMED
+        assert mig["restoredStep"] == acked
+
+    def test_kill_switch_restores_legacy_handshake_parity(self):
+        """OPERATOR_SHARDED_CKPT=0 must reproduce the exact legacy
+        single-blob protocol: run the same seeded resize with the gate
+        on and off and compare every protocol-critical field."""
+        from tpu_operator.workloads.elastic import SHARDED_CKPT_GATE
+
+        def run(gate_on, mode):
+            prev = SHARDED_CKPT_GATE.enabled
+            SHARDED_CKPT_GATE.enabled = gate_on
+            try:
+                c = two_pool_fleet()
+                clock = Clock()
+                rec, bound = place(c, clock)
+                wl = ElasticWorkload(c, "job", "default", clock=clock)
+                for _ in range(3):
+                    wl.tick()
+                    clock.t += 1
+                req = Request(name="job", namespace="default")
+                migrator = SliceMigrator(c, now=clock)
+                if mode == "shrink":
+                    _shrink_spec(c, chips=4)
+                for _ in range(6):
+                    if mode == "shrink":
+                        rec.reconcile(req)
+                    else:
+                        migrator.ready_to_drain(bound, clock.t + 60)
+                    clock.t += 1
+                    wl.tick()
+                cr = c.get(V1ALPHA1, KIND_SLICE_REQUEST, "job",
+                           "default")
+                mig = get_nested(cr, "status", "migration")
+                return {
+                    "phase": mig["phase"],
+                    "ackedStep": mig["ackedStep"],
+                    "restoredStep": mig["restoredStep"],
+                    "migrations": get_nested(cr, "status", "migrations"),
+                    "chips": get_nested(cr, "status", "chips"),
+                    "n_nodes": len(get_nested(cr, "status", "nodes")),
+                    "step": wl.step,
+                    "sharded": wl.sharded,
+                }
+            finally:
+                SHARDED_CKPT_GATE.enabled = prev
+
+        for mode in ("shrink", "migrate"):
+            on = run(True, mode)
+            off = run(False, mode)
+            assert on["sharded"] and not off["sharded"]
+            on.pop("sharded")
+            off.pop("sharded")
+            assert on == off, mode
+            assert on["phase"] == MIG_RESUMED
+
+    def test_env_kill_switch_spellings(self):
+        from tpu_operator.workloads.elastic import (
+            env_sharded_ckpt_enabled,
+        )
+
+        assert env_sharded_ckpt_enabled({})
+        for off in ("0", "false", "No", "OFF"):
+            assert not env_sharded_ckpt_enabled(
+                {"OPERATOR_SHARDED_CKPT": off})
+        assert env_sharded_ckpt_enabled({"OPERATOR_SHARDED_CKPT": "1"})
+
+
+class TestCheckpointAgeCleanup:
+    def test_deleted_request_stops_exporting_checkpoint_age(self):
+        """Regression: the per-request checkpoint-age gauge child must
+        die with its SliceRequest — a deleted request's last age would
+        otherwise export (and climb) forever."""
+        from tpu_operator.metrics.registry import render_prometheus
+
+        c = two_pool_fleet()
+        clock = Clock()
+        rec, _ = place(c, clock)
+        wl = ElasticWorkload(c, "job", "default", clock=clock)
+        for _ in range(3):
+            wl.tick()
+            clock.t += 1
+        assert 'request="default/job"' in render_prometheus()
+        c.delete(V1ALPHA1, KIND_SLICE_REQUEST, "job", "default")
+        rec.reconcile(Request(name="job", namespace="default"))
+        text = render_prometheus()
+        for line in text.splitlines():
+            if line.startswith("tpu_operator_slice_checkpoint_age"):
+                assert 'request="default/job"' not in line

@@ -29,12 +29,17 @@ PHASE_UNSCHEDULABLE = "Unschedulable"
 #   Migrating    intent posted, waiting for the workload to checkpoint
 #   Checkpointed workload acked a durable checkpoint step
 #   Rebound      operator leased replacement capacity and moved the binding
+#   Resharding   same-ICI-domain rebind via direct shard handoff:
+#                surviving hosts keep their shards in place, only the
+#                reassigned shards move (status.migration carries
+#                bytesMoved/shardsMoved and path=sharded-handoff)
 #   Resumed      workload restored the acked step on the new topology
 #   Aborted      deadline passed (or the attempt was superseded); the
 #                operator degraded to the pre-elastic hard-drain behavior
 MIG_MIGRATING = "Migrating"
 MIG_CHECKPOINTED = "Checkpointed"
 MIG_REBOUND = "Rebound"
+MIG_RESHARDING = "Resharding"
 MIG_RESUMED = "Resumed"
 MIG_ABORTED = "Aborted"
 MIG_TERMINAL = ("", MIG_RESUMED, MIG_ABORTED)

@@ -892,7 +892,8 @@ def run_federation_bench(n_cells: int = 5, nodes_per_cell: int = 2000,
 
 
 def run_migration_bench(n_tpu: int = 100, n_requests: int = 6,
-                        pass_budget: int = 300, seed: int = 0) -> Dict:
+                        pass_budget: int = 300, seed: int = 0,
+                        include_resize: bool = True) -> Dict:
     """Workload recovery latency across a full driver rollout: the
     elastic migrate stage (checkpoint-ack-rebind ahead of the drain)
     vs the kill-and-reschedule baseline (migrate stage disabled, the
@@ -1048,7 +1049,7 @@ def run_migration_bench(n_tpu: int = 100, n_requests: int = 6,
 
     el = _mode(elastic=True)
     kl = _mode(elastic=False)
-    return {
+    out = {
         "n_tpu_nodes": n_tpu,
         "n_requests": n_requests,
         "migrations": el["moves"],
@@ -1063,6 +1064,168 @@ def run_migration_bench(n_tpu: int = 100, n_requests: int = 6,
         "kill_lost_steps": kl["lost_steps"],
         "speedup_p95": (kl["p95_s"] / el["p95_s"]
                         if el["p95_s"] else 0.0),
+    }
+    if include_resize:
+        out.update(run_resize_bench(n_tpu=n_tpu, n_requests=n_requests,
+                                    seed=seed))
+    return out
+
+
+def run_resize_bench(n_tpu: int = 60, n_requests: int = 6,
+                     pass_budget: int = 200, seed: int = 0) -> Dict:
+    """Same-ICI-domain resize latency and byte bill: the direct shard
+    handoff (sharded checkpoints — only shards changing owner move,
+    surviving hosts keep theirs in place) vs the SAME seeded resizes
+    forced down the full-checkpoint path (``OPERATOR_SHARDED_CKPT=0``
+    semantics, every byte re-fetched on the new binding).
+
+    Both modes run the REAL placement controller's shrink/grow
+    handshake and the ElasticWorkload shim on a virtual clock; the
+    restore pause is bandwidth-modeled (``state_bytes`` fetched at
+    ``restore_bandwidth`` per tick), so a stalled-training span is
+    deterministic virtual seconds and the bytes-moved figures are
+    exact. The headline pair is ``resize_p95_s`` (fast path) vs
+    ``resize_full_p95_s``, plus ``reshard_bytes_ratio`` = bytes the
+    handoff moved / bytes the full path re-fetched."""
+    from ..api.slicerequest import (
+        KIND_SLICE_REQUEST,
+        MIG_TERMINAL,
+        V1ALPHA1,
+        SliceRequestSpec,
+        new_slice_request,
+    )
+    from ..chaos.faults import VirtualClock
+    from ..controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from ..controllers.placement_controller import PlacementReconciler
+    from ..runtime.objects import get_nested, thaw_obj
+    from ..workloads.elastic import SHARDED_CKPT_GATE, ElasticWorkload
+
+    ns = "tpu-operator"
+    step_dt = 5.0
+    state_bytes = 256 << 20   # one job's checkpoint footprint
+    bandwidth = 64 << 20      # restore fetch per training tick
+
+    def _mode(fast: bool) -> Dict:
+        prev = SHARDED_CKPT_GATE.enabled
+        SHARDED_CKPT_GATE.enabled = fast
+        try:
+            clock = VirtualClock()
+            c = build_cluster(n_tpu)
+            c.create(new_cluster_policy(spec={}))
+            prec = ClusterPolicyReconciler(client=c, namespace=ns)
+            lrec = PlacementReconciler(client=c, namespace=ns, now=clock)
+            req = Request(name="tpu-cluster-policy")
+            names = [f"rsz-{i:03d}" for i in range(n_requests)]
+            for nm in names:
+                c.create(new_slice_request(
+                    nm, spec=SliceRequestSpec(chips=8).to_obj(),
+                    namespace=ns))
+
+            def place_all() -> None:
+                for nm in names:
+                    lrec.reconcile(Request(name=nm, namespace=ns))
+
+            prec.reconcile(req)
+            c.simulate_kubelet(ready=True)
+            prec.reconcile(req)
+            place_all()
+            shims = {nm: ElasticWorkload(c, nm, ns, clock=clock,
+                                         state_bytes=state_bytes,
+                                         restore_bandwidth=bandwidth)
+                     for nm in names}
+            for _ in range(3):  # steady training before the resize
+                for nm in names:
+                    shims[nm].tick()
+                clock.advance(step_dt)
+            # a same-domain shrink on every job (8 -> 4 chips halves the
+            # host set inside the bound pool) — the arc the fast path
+            # exists for; cross-domain arcs are covered by the chaos
+            # scenario and always ride the full path anyway
+            for nm in sorted(names):
+                live = c.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, nm, ns)
+                if live is None:
+                    continue
+                cr = thaw_obj(live)
+                cr["spec"]["chips"] = 4
+                c.update(cr)
+
+            spans: list = []
+            stall: Dict[str, tuple] = {}
+            high = {nm: shims[nm].step for nm in names}
+            for _ in range(pass_budget):
+                place_all()
+                for nm in sorted(shims):
+                    shims[nm].tick()
+                    step_now = shims[nm].step
+                    if nm in stall:
+                        if step_now > stall[nm][1]:
+                            spans.append(clock.t - stall[nm][0])
+                            del stall[nm]
+                    elif step_now <= high[nm]:
+                        stall[nm] = (clock.t, high[nm])
+                    high[nm] = max(high[nm], step_now)
+                clock.advance(step_dt)
+                settled = not stall
+                for nm in names:
+                    live = c.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST,
+                                         nm, ns)
+                    mig = (get_nested(live, "status", "migration",
+                                      default={}) or {}) if live else {}
+                    if (mig.get("phase") or "") not in MIG_TERMINAL:
+                        settled = False
+                if settled:
+                    break
+
+            bytes_moved = resharded = fallbacks = resized = 0
+            for nm in names:
+                live = c.get_or_none(V1ALPHA1, KIND_SLICE_REQUEST, nm, ns)
+                if live is None:
+                    continue
+                if not int(get_nested(live, "status", "migrations",
+                                      default=0) or 0):
+                    continue
+                resized += 1
+                mig = get_nested(live, "status", "migration",
+                                 default={}) or {}
+                if mig.get("path") == "sharded-handoff":
+                    resharded += 1
+                    bytes_moved += int(mig.get("bytesMoved") or 0)
+                else:
+                    # full path: the restore re-fetches the whole blob
+                    fallbacks += 1
+                    bytes_moved += state_bytes
+            spans.sort()
+
+            def pct(p: float) -> float:
+                if not spans:
+                    return 0.0
+                return spans[min(len(spans) - 1, int(p * len(spans)))]
+
+            return {"spans": len(spans), "p50_s": pct(0.50),
+                    "p95_s": pct(0.95), "bytes_moved": bytes_moved,
+                    "resharded": resharded, "fallbacks": fallbacks,
+                    "resized": resized}
+        finally:
+            SHARDED_CKPT_GATE.enabled = prev
+
+    fastd = _mode(fast=True)
+    fulld = _mode(fast=False)
+    return {
+        "resizes": fastd["resized"],
+        "resize_stalls": fastd["spans"],
+        "resize_p50_s": fastd["p50_s"],
+        "resize_p95_s": fastd["p95_s"],
+        "resize_full_p50_s": fulld["p50_s"],
+        "resize_full_p95_s": fulld["p95_s"],
+        "resize_speedup_p95": (fulld["p95_s"] / fastd["p95_s"]
+                               if fastd["p95_s"] else 0.0),
+        "resharded": fastd["resharded"],
+        "reshard_fallbacks": fastd["fallbacks"],
+        "reshard_bytes_moved": fastd["bytes_moved"],
+        "reshard_bytes_full": fulld["bytes_moved"],
+        "reshard_bytes_ratio": (fastd["bytes_moved"]
+                                / fulld["bytes_moved"]
+                                if fulld["bytes_moved"] else 0.0),
     }
 
 

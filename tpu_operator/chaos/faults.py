@@ -60,6 +60,13 @@ ANNOTATION_CLEAR = "annotation-clear"  # strip the spec-hash annotations
 SLICE_REQUEST = "slice-request"    # a SliceRequest lands in the queue
 SLICE_RESIZE = "slice-resize"      # spec.chips edit on a live SliceRequest
 WORKLOAD_CRASH = "workload-crash"  # elastic shim dies mid-save (torn ckpt)
+RESHARD_CRASH = "reshard-crash"    # elastic shim dies mid-shard-handoff
+#                                    (torn re-shard manifest must roll
+#                                    back to the finalized step); arg
+#                                    "name@mismatch" instead bumps the
+#                                    shim's layout version so the next
+#                                    resize exercises the full-checkpoint
+#                                    fallback arc
 SHARD_KILL = "shard-kill"          # a reconcile shard's workers die;
 #                                    queued keys must rehash losslessly
 #                                    onto the survivors (count = shard id)
@@ -510,6 +517,19 @@ class FaultPlan:
                 nodes.remove(victim)
                 out.append(Fault(step, NODE_REMOVE, arg=victim))
                 removed = True
+        # live-resharding arcs (appended AFTER the loop so the rng draw
+        # sequence above is untouched): seeded mid-shard-handoff kills —
+        # the torn re-shard manifest must roll back to the finalized
+        # step — plus one deterministic layout-version mismatch so every
+        # seed also exercises the full-checkpoint fallback path
+        if n_elastic:
+            for step in range(rollout_step + 1, steps):
+                if step % 7 == 6:
+                    out.append(Fault(
+                        step, RESHARD_CRASH,
+                        arg=f"ereq-{rng.randrange(1, n_elastic + 1):03d}"))
+            out.append(Fault(min(rollout_step + 2, steps - 1),
+                             RESHARD_CRASH, arg="ereq-001@mismatch"))
         return out
 
     @classmethod

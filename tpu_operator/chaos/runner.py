@@ -96,6 +96,7 @@ from .faults import (
     OPERAND_DRIFT,
     OPERATOR_CRASH,
     POD_CRASH,
+    RESHARD_CRASH,
     SHARD_KILL,
     SLICE_REQUEST,
     SLICE_RESIZE,
@@ -692,6 +693,21 @@ def _apply_fault(fault: Fault, fake: FakeClient, chaos: ChaosClient,
         if wl is not None:
             wl.crash(partial=True)
             applied = True
+    elif kind == RESHARD_CRASH:
+        # arm a kill landing mid-shard-handoff: the shim's next direct
+        # handoff writes a torn (unfinalized) re-shard manifest and
+        # dies — restore must roll back to the finalized step. The
+        # "@mismatch" mode instead bumps the shim's layout version so
+        # its next resize is ineligible for the fast path and exercises
+        # the full-checkpoint fallback arc
+        name, _, mode = str(fault.arg or "").partition("@")
+        wl = (state.get("shims") or {}).get(name)
+        if wl is not None:
+            if mode == "mismatch":
+                wl.force_layout_mismatch()
+            else:
+                wl.arm_reshard_crash()
+            applied = True
     elif kind == ANNOTATION_CLEAR:
         # strip the hash annotations entirely (a `kubectl annotate ...-`
         # adversary): the skip must fail closed and restore them
@@ -881,11 +897,16 @@ def _migration_summary(fake: FakeClient) -> dict:
             "ackedStep": mig.get("ackedStep"),
             "restoredStep": mig.get("restoredStep"),
             "reason": mig.get("reason"),
+            "path": mig.get("path"),
+            "bytesMoved": mig.get("bytesMoved"),
+            "shardsMoved": mig.get("shardsMoved"),
         })
     return {
         "requests": len(reqs),
         "phases": {k: phases[k] for k in sorted(phases)},
         "completed_moves": completed,
+        "resharded": sum(1 for r in rows
+                         if r["path"] == "sharded-handoff"),
         "rows": rows,
     }
 

@@ -216,6 +216,46 @@ def gather(client, out_dir: pathlib.Path) -> dict:
     except Exception as e:
         summary["errors"].append(f"federation: {e}")
     try:
+        # the live-resharding picture: one file per request with a
+        # non-terminal or byte-accounted migration — the handshake
+        # phase, the path taken (sharded-handoff vs full-checkpoint),
+        # the byte/shard bill, and the acked shard layout the planner
+        # worked from. This is the reshard plan a support bundle needs
+        # to explain "why did this resize move N bytes"
+        from ..api.slicerequest import KIND_SLICE_REQUEST, V1ALPHA1
+        from ..runtime.objects import (
+            get_nested,
+            name_of,
+            namespace_of,
+        )
+
+        d = out_dir / "reshard"
+        plans = 0
+        for cr in sorted(client.list(V1ALPHA1, KIND_SLICE_REQUEST),
+                         key=lambda c: (namespace_of(c), name_of(c))):
+            mig = get_nested(cr, "status", "migration",
+                             default={}) or {}
+            if not mig:
+                continue
+            d.mkdir(parents=True, exist_ok=True)
+            doc = {
+                "namespace": namespace_of(cr) or "default",
+                "name": name_of(cr),
+                "phase": mig.get("phase", ""),
+                "path": mig.get("path", ""),
+                "bytesMoved": mig.get("bytesMoved"),
+                "shardsMoved": mig.get("shardsMoved"),
+                "ackedStep": mig.get("ackedStep"),
+                "restoredStep": mig.get("restoredStep"),
+                "layout": mig.get("layout"),
+            }
+            (d / f"{doc['namespace']}_{doc['name']}.json").write_text(
+                json.dumps(doc, indent=2, sort_keys=True))
+            plans += 1
+        summary["reshard_plans"] = plans
+    except Exception as e:
+        summary["errors"].append(f"reshard: {e}")
+    try:
         # the informer-cache picture (/debug/cache equivalent): unwrap
         # the client stack the same way Manager.find_cache does
         inner, stats = client, None

@@ -328,6 +328,9 @@ def _slices_report(client, namespace: str) -> dict:
                 "from": list(mig.get("from") or []),
                 "to": list(mig.get("to") or []),
                 "reason": mig.get("reason", ""),
+                "path": mig.get("path", ""),
+                "bytesMoved": _num(mig.get("bytesMoved")),
+                "shardsMoved": _num(mig.get("shardsMoved")),
             },
         })
     return report
@@ -358,6 +361,13 @@ def _print_slices_text(report: dict, migrations: bool) -> None:
             if mig["from"] or mig["to"]:
                 print(f"  move: {', '.join(mig['from']) or '-'}"
                       f" -> {', '.join(mig['to']) or '-'}")
+            if mig["path"]:
+                line = f"  path: {mig['path']}"
+                if mig["path"] == "sharded-handoff" \
+                        and mig["bytesMoved"] is not None:
+                    line += (f" ({mig['shardsMoved'] or 0} shard(s), "
+                             f"{mig['bytesMoved']} bytes moved)")
+                print(line)
             if mig["reason"]:
                 print(f"  reason: {mig['reason']}")
     print(f"requests: {len(report['requests'])}, completed migrations: "

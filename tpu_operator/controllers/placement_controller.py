@@ -45,6 +45,7 @@ from ..api.slicerequest import (
     MIG_CHECKPOINTED,
     MIG_MIGRATING,
     MIG_REBOUND,
+    MIG_RESHARDING,
     PHASE_PENDING,
     PHASE_PLACED,
     PHASE_UNSCHEDULABLE,
@@ -362,8 +363,15 @@ class PlacementReconciler(Reconciler):
             V1ALPHA1, KIND_SLICE_REQUEST, request.name,
             request.namespace or None)
         if live is None:
-            # request deleted: return its nodes to the pool
+            # request deleted: return its nodes to the pool, and retire
+            # the per-request checkpoint-age series — a gauge child for
+            # a deleted request would otherwise export its last value
+            # forever (and look like an ever-staler checkpoint)
             self._unsched_attempts.pop(key, None)
+            try:
+                OPERATOR_METRICS.slice_checkpoint_age.remove(key)
+            except KeyError:
+                pass
             if self._release_leases(key):
                 OPERATOR_METRICS.placement_decisions.labels(
                     outcome="released").inc()
@@ -409,7 +417,7 @@ class PlacementReconciler(Reconciler):
             from .slices import clear_intent, migration_of
             mig = migration_of(cr)
             if mig.get("phase") in (MIG_MIGRATING, MIG_CHECKPOINTED,
-                                    MIG_REBOUND):
+                                    MIG_REBOUND, MIG_RESHARDING):
                 # an eviction supersedes any in-flight handshake; the
                 # workload restores from its last durable checkpoint on
                 # the replacement binding, so no ACKED work is lost
@@ -979,9 +987,12 @@ class PlacementReconciler(Reconciler):
         re-posting intents forever."""
         from .slices import (
             abort_migration,
+            handoff_eligible,
             migration_of,
+            plan_handoff,
             post_intent,
             rebind_request,
+            reshard_request,
         )
 
         bound_chips = get_nested(cr, "status", "chips", default=None)
@@ -1011,13 +1022,29 @@ class PlacementReconciler(Reconciler):
         if resizing:
             if phase == MIG_CHECKPOINTED:
                 # acked: move the binding; its own nodes may be reused
-                # (a shrink usually lands inside the old window)
+                # (a shrink usually lands inside the old window). When
+                # the winner stays in the same ICI domain AND the ack
+                # published a compatible shard layout, drive the direct
+                # shard handoff — only shards changing owner travel;
+                # any mismatch rides the full-checkpoint path
                 nodes = [n for n in self.client.list("v1", "Node")]
                 ranked = rank_candidates(spec, FleetState(nodes),
                                          reclaim=key)
                 if ranked:
-                    rebind_request(self.client, cr, live, spec, ranked[0],
-                                   self.now(), outcome="resized")
+                    # prefer a same-domain window when one ranks at all:
+                    # the exact-fit scorer routinely out-ranks the job's
+                    # own pool, but for a resize the shards that DON'T
+                    # move dominate the score margin
+                    cand = next((x for x in ranked
+                                 if handoff_eligible(cr, x)), ranked[0])
+                    plan = plan_handoff(cr, cand)
+                    if plan is not None:
+                        reshard_request(self.client, cr, live, spec,
+                                        cand, self.now(), plan)
+                    else:
+                        rebind_request(self.client, cr, live, spec,
+                                       cand, self.now(),
+                                       outcome="resized")
                     return Result()
             if self.now() > float(mig.get("deadline") or 0):
                 abort_migration(self.client, cr, live,

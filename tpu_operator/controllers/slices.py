@@ -41,6 +41,7 @@ from ..api.slicerequest import (
     MIG_CHECKPOINTED,
     MIG_MIGRATING,
     MIG_REBOUND,
+    MIG_RESHARDING,
     MIG_RESUMED,
     MIG_TERMINAL,
     PHASE_PLACED,
@@ -248,14 +249,17 @@ def abort_migration(client: Client, cr: dict, live: dict, reason: str,
                 request_key(cr), outcome, reason)
 
 
-def rebind_request(client: Client, cr: dict, live: dict,
-                   spec: SliceRequestSpec, candidate, now: float,
-                   outcome: str) -> None:
+def _move_binding(client: Client, cr: dict, live: dict,
+                  spec: SliceRequestSpec, candidate, now: float,
+                  outcome: str, phase: str,
+                  mig_extra: Optional[dict] = None) -> None:
     """Move a Placed binding onto ``candidate``'s window: lease the new
     nodes BEFORE publishing status (placement-sound, same order as the
     initial bind), then release the leases left behind. A crash between
     status and release leaves orphan self-leases, which the placement
-    controller's Placed-sound sweep reclaims."""
+    controller's Placed-sound sweep reclaims. ``phase`` is Rebound for
+    the full-checkpoint path, Resharding for the direct shard handoff —
+    the workload's restore strategy keys off it."""
     key = request_key(cr)
     old = set(get_nested(cr, "status", "nodes", default=[]) or [])
     new = set(candidate.nodes)
@@ -263,9 +267,10 @@ def rebind_request(client: Client, cr: dict, live: dict,
         client.patch("v1", "Node", n,
                      {"metadata": {"annotations": {L.PLACED_BY: key}}})
     mig = migration_of(cr)
-    mig["phase"] = MIG_REBOUND
+    mig["phase"] = phase
     mig["to"] = sorted(new)
     mig.pop("reason", None)
+    mig.update(mig_extra or {})
     set_nested(cr, mig, "status", "migration")
     set_nested(cr, sorted(new), "status", "nodes")
     set_nested(cr, candidate.pool, "status", "pool")
@@ -284,7 +289,7 @@ def rebind_request(client: Client, cr: dict, live: dict,
     clear_intent(client, cr)
     OPERATOR_METRICS.slice_migrations.labels(outcome=outcome).inc()
     if TIMELINE.enabled:
-        TIMELINE.record("SliceRequest", key, "migration:" + MIG_REBOUND,
+        TIMELINE.record("SliceRequest", key, "migration:" + phase,
                         {"outcome": outcome, "pool": candidate.pool,
                          "score": f"{candidate.score:.6f}",
                          "from": sorted(old), "to": sorted(new)})
@@ -294,6 +299,98 @@ def rebind_request(client: Client, cr: dict, live: dict,
             max(0.0, now - float(started)))
     log.info("request %s rebound %s -> %s (%s)", key,
              sorted(old), sorted(new), outcome)
+
+
+def rebind_request(client: Client, cr: dict, live: dict,
+                   spec: SliceRequestSpec, candidate, now: float,
+                   outcome: str) -> None:
+    """The full-checkpoint rebind: every byte of the acked checkpoint is
+    restored on the new binding. Stamps path=full-checkpoint so the CLI
+    can show which road a completed move took."""
+    _move_binding(client, cr, live, spec, candidate, now, outcome,
+                  phase=MIG_REBOUND,
+                  mig_extra={"path": "full-checkpoint"})
+
+
+def _handoff_ineligible(cr: dict, candidate) -> Optional[str]:
+    """None when a direct shard handoff onto ``candidate`` is sound,
+    else the fallback reason. Pure (no metrics, no I/O) so the resize
+    path can also use it to PREFER a same-domain candidate: the exact-
+    fit scorer routinely out-ranks a job's own window with a window in
+    another pool, and for a resize the byte bill dominates the score
+    margin. Sound means:
+
+    - the sharded layout is enabled and the workload's ack published
+      its shard map at the operator's layout version,
+    - the candidate stays in the SAME ICI domain (same pool, at least
+      one surviving host — a cross-domain or cross-cell move shares no
+      interconnect, every shard travels anyway)."""
+    from ..workloads.elastic import LAYOUT_VERSION, SHARDED_CKPT_GATE
+
+    if not SHARDED_CKPT_GATE.enabled:
+        return "disabled"
+    layout = migration_of(cr).get("layout")
+    if not layout or not layout.get("shards"):
+        return "no-layout"
+    if int(layout.get("version", -1)) != LAYOUT_VERSION:
+        return "layout-version"
+    old_nodes = set(get_nested(cr, "status", "nodes", default=[]) or [])
+    if candidate.pool != get_nested(cr, "status", "pool") \
+            or not old_nodes & set(candidate.nodes):
+        return "cross-domain"
+    return None
+
+
+def handoff_eligible(cr: dict, candidate) -> bool:
+    return _handoff_ineligible(cr, candidate) is None
+
+
+def plan_handoff(cr: dict, candidate) -> Optional[dict]:
+    """Fast-path eligibility + shard-movement plan for a resize onto
+    ``candidate``. Returns the plan (bytes/shards accounted) only when
+    the direct handoff is sound (see :func:`_handoff_ineligible`) and
+    the planner can diff the layouts (no version skew, same shard set).
+    Any mismatch returns None (counted by reason) and the caller rides
+    the existing atomic full-checkpoint path — the fast path is an
+    optimization, never a new failure mode."""
+    import time as _time
+
+    from ..workloads.elastic import plan_reshard, rebalance_layout
+
+    def fallback(reason: str) -> None:
+        OPERATOR_METRICS.reshard_fallbacks.labels(reason=reason).inc()
+
+    reason = _handoff_ineligible(cr, candidate)
+    if reason is not None:
+        fallback(reason)
+        return None
+    layout = migration_of(cr).get("layout")
+    t0 = _time.perf_counter()
+    plan = plan_reshard(layout, rebalance_layout(layout, candidate.nodes))
+    OPERATOR_METRICS.reshard_plan_seconds.observe(
+        _time.perf_counter() - t0)
+    if not plan["compatible"]:
+        fallback("incompatible")
+        return None
+    return plan
+
+
+def reshard_request(client: Client, cr: dict, live: dict,
+                    spec: SliceRequestSpec, candidate, now: float,
+                    plan: dict) -> None:
+    """The same-domain direct shard handoff: surviving hosts keep their
+    shards in place, only the planned moves travel. The binding move is
+    the SAME placement-sound lease dance as a full rebind — only the
+    phase (Resharding) and the byte bill differ; the workload's restore
+    fetches exactly the planned shards and falls back to the full
+    restore on any torn manifest."""
+    _move_binding(client, cr, live, spec, candidate, now,
+                  outcome="resharded", phase=MIG_RESHARDING,
+                  mig_extra={"path": "sharded-handoff",
+                             "bytesMoved": int(plan["bytesMoved"]),
+                             "shardsMoved": int(plan["shardsMoved"])})
+    OPERATOR_METRICS.reshard_bytes_moved.inc(int(plan["bytesMoved"]))
+    OPERATOR_METRICS.reshard_shard_handoffs.inc(int(plan["shardsMoved"]))
 
 
 class SliceMigrator:
@@ -352,7 +449,7 @@ class SliceMigrator:
         # spanning two draining units), or a concurrent resize. The SAME
         # phase machine drives all of them off the ANNOTATION's deadline,
         # so two units sharing a request never ping-pong reposts
-        if phase in (MIG_REBOUND, MIG_RESUMED, MIG_ABORTED):
+        if phase in (MIG_REBOUND, MIG_RESHARDING, MIG_RESUMED, MIG_ABORTED):
             return True
         if phase == MIG_CHECKPOINTED:
             from .placement_controller import find_replacement

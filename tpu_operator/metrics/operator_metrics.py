@@ -387,6 +387,26 @@ class OperatorMetrics:
             "Cross-cell elastic migrations of slices out of condemned "
             "cells, by outcome (migrated|failed|aborted)",
             labelnames=("outcome",))
+        # live resharding (sharded checkpoints + direct same-domain
+        # handoff): the byte bill of the fast path vs the full blob,
+        # planner cost, and why resizes fell back to the full path
+        self.reshard_bytes_moved = c(
+            "tpu_operator_reshard_bytes_moved_total",
+            "Checkpoint bytes actually moved by direct shard handoffs "
+            "(shards changing owner; surviving hosts' shards stay put)")
+        self.reshard_shard_handoffs = c(
+            "tpu_operator_reshard_shard_handoffs_total",
+            "Shards reassigned to a new owner by direct handoffs")
+        self.reshard_plan_seconds = h(
+            "tpu_operator_reshard_plan_seconds",
+            "Wall time to diff two shard layouts into a minimal "
+            "movement plan")
+        self.reshard_fallbacks = c(
+            "tpu_operator_reshard_fallbacks_total",
+            "Resizes that fell back to the full-checkpoint path, by "
+            "reason (disabled|no-layout|layout-version|cross-domain|"
+            "incompatible)",
+            labelnames=("reason",))
 
 
 OPERATOR_METRICS = OperatorMetrics()

@@ -52,6 +52,36 @@ class TrainCheckpointer:
     def all_steps(self) -> list:
         return sorted(self._mgr.all_steps())
 
+    def save_manifest(self, step: int, manifest: dict) -> None:
+        """Persist the shard-layout manifest for a FINALIZED step.
+
+        Orbax already owns blob atomicity (finalize-rename of the step
+        directory); the manifest rides the same discipline — written to
+        a tmp name and os.replace'd into place, so a crash mid-write
+        never leaves a readable half-manifest. Only ever called after
+        save() returned (the step is durable), which keeps the ordering
+        invariant: a manifest's existence implies its step is complete.
+        """
+        import json
+        import os
+
+        path = self._dir / f"manifest-{int(step)}.json"
+        tmp = self._dir / f".manifest-{int(step)}.json.tmp"
+        tmp.write_text(json.dumps(manifest, sort_keys=True))
+        os.replace(tmp, path)
+
+    def read_manifest(self, step: int) -> Optional[dict]:
+        """Shard-layout manifest for ``step``, or None when the step was
+        saved pre-sharding (legacy blob) or the manifest is unreadable —
+        callers treat None as 'full restore only'."""
+        import json
+
+        path = self._dir / f"manifest-{int(step)}.json"
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the shardings/dtypes of ``state_like`` (the freshly
         initialized state): each leaf comes back placed exactly where the

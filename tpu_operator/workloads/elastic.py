@@ -28,7 +28,7 @@ Two bindings of the same state machine live here:
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import labels as L
 from ..api.conditions import update_status_with_retry
@@ -37,6 +37,7 @@ from ..api.slicerequest import (
     MIG_CHECKPOINTED,
     MIG_MIGRATING,
     MIG_REBOUND,
+    MIG_RESHARDING,
     MIG_RESUMED,
     V1ALPHA1,
 )
@@ -54,31 +55,198 @@ from ..runtime.timeline import TIMELINE
 log = logging.getLogger("tpu_operator.elastic")
 
 
+def env_sharded_ckpt_enabled(env=None) -> bool:
+    """Sharded checkpoints default ON; OPERATOR_SHARDED_CKPT=0 (or
+    false/no/off) disables them — same spelling as the other kill
+    switches."""
+    import os
+
+    val = (env or os.environ).get("OPERATOR_SHARDED_CKPT", "1")
+    return str(val).strip().lower() not in ("0", "false", "no", "off")
+
+
+class ShardedCkptGate:
+    """Process-wide switch for the sharded checkpoint layout and the
+    same-domain shard handoff built on it. Disabled, every save is the
+    legacy single blob and every resize rides the full
+    checkpoint->rebind->restore path — the debugging escape hatch when a
+    suspected partial handoff masks lost state."""
+
+    def __init__(self):
+        self.enabled = env_sharded_ckpt_enabled()
+
+
+SHARDED_CKPT_GATE = ShardedCkptGate()
+
+
+# --- sharded checkpoint layout + shard-movement planner --------------------
+#
+# Tenplex's observation, applied to the handshake: the train state is a
+# parallelizable collection that can be re-split onto a new host set by
+# moving ONLY the sub-tensors that change owner. The layout below is the
+# manifest's map of that collection: a fixed set of logical shards, each
+# owned by one host. A resize re-partitions ownership; plan_reshard()
+# diffs two layouts into the minimal move set (and its byte bill), and
+# the controllers fall back to the full-checkpoint path whenever the
+# layouts cannot be diffed (version skew, different shard sets).
+
+LAYOUT_VERSION = 1
+DEFAULT_SHARD_COUNT = 16
+
+
+def build_layout(hosts: List[str], total_bytes: int,
+                 n_shards: int = DEFAULT_SHARD_COUNT,
+                 version: int = LAYOUT_VERSION) -> dict:
+    """Fresh layout: ``total_bytes`` of state split into ``n_shards``
+    near-equal logical shards, owners assigned round-robin over the
+    sorted host list. Deterministic — the workload and the controller
+    compute identical layouts from the same inputs."""
+    hosts = sorted(hosts)
+    if not hosts:
+        raise ValueError("a layout needs at least one host")
+    n = max(1, int(n_shards))
+    base, extra = divmod(max(0, int(total_bytes)), n)
+    shards = {}
+    for sid in range(n):
+        shards[str(sid)] = {"owner": hosts[sid % len(hosts)],
+                            "bytes": base + (1 if sid < extra else 0)}
+    return {"version": int(version), "shards": shards}
+
+
+def rebalance_layout(layout: dict, new_hosts: List[str]) -> dict:
+    """Minimal-movement re-split of ``layout`` onto ``new_hosts``: every
+    shard whose owner survives stays put (up to the balanced per-host
+    ceiling); only orphaned shards and overflow move, each to the
+    least-loaded new host. Deterministic: shards walk in numeric order,
+    ties break on host name."""
+    new_hosts = sorted(set(new_hosts))
+    if not new_hosts:
+        raise ValueError("a layout needs at least one host")
+    shards = layout["shards"]
+    cap = -(-len(shards) // len(new_hosts))  # ceil
+    load = {h: 0 for h in new_hosts}
+    out: Dict[str, dict] = {}
+    homeless = []
+    for sid in sorted(shards, key=int):
+        owner = shards[sid]["owner"]
+        if owner in load and load[owner] < cap:
+            out[sid] = {"owner": owner,
+                        "bytes": int(shards[sid]["bytes"])}
+            load[owner] += 1
+        else:
+            homeless.append(sid)
+    for sid in homeless:
+        target = min(new_hosts, key=lambda h: (load[h], h))
+        out[sid] = {"owner": target, "bytes": int(shards[sid]["bytes"])}
+        load[target] += 1
+    return {"version": int(layout.get("version", LAYOUT_VERSION)),
+            "shards": out}
+
+
+def plan_reshard(old_layout: Optional[dict],
+                 new_layout: Optional[dict]) -> dict:
+    """Pure shard-movement planner: the minimal set of shards changing
+    owner between two layouts, bytes accounted. ``compatible`` is False
+    (with the reason) whenever the layouts cannot be diffed — version
+    skew or differing shard sets — which is the controllers' signal to
+    fall back to the full-checkpoint path."""
+    plan = {"moves": [], "shardsMoved": 0, "bytesMoved": 0,
+            "shardsTotal": 0, "bytesTotal": 0,
+            "compatible": True, "reason": ""}
+    olds = (old_layout or {}).get("shards") or {}
+    news = (new_layout or {}).get("shards") or {}
+    if not olds or not news:
+        plan.update(compatible=False, reason="missing layout")
+        return plan
+    old_v = int((old_layout or {}).get("version", -1))
+    new_v = int((new_layout or {}).get("version", -1))
+    if old_v != new_v:
+        plan.update(compatible=False,
+                    reason=f"layout version {old_v} != {new_v}")
+        return plan
+    if set(olds) != set(news):
+        plan.update(compatible=False, reason="shard sets differ")
+        return plan
+    plan["shardsTotal"] = len(news)
+    for sid in sorted(news, key=int):
+        b = int(news[sid]["bytes"])
+        plan["bytesTotal"] += b
+        src, dst = olds[sid]["owner"], news[sid]["owner"]
+        if src != dst:
+            plan["moves"].append(
+                {"shard": sid, "from": src, "to": dst, "bytes": b})
+            plan["shardsMoved"] += 1
+            plan["bytesMoved"] += b
+    return plan
+
+
 class MemoryCheckpointStore:
     """Deterministic stand-in for the orbax CheckpointManager: finalized
     saves are durable, a ``partial=True`` save models a crash mid-write
     (enumerates like a real torn step directory, fails restore), and
     restore falls back past partial steps exactly like
-    ``TrainCheckpointer.restore`` does."""
+    ``TrainCheckpointer.restore`` does.
+
+    A save may carry a sharded ``layout`` (build_layout): the step then
+    holds per-host shards plus a manifest, and the manifest IS the
+    finalize-rename commit point — a ``partial`` sharded save models a
+    crash mid-shard-handoff (shards written, manifest never renamed in),
+    so ``manifest()`` returns None for it and restore falls back exactly
+    like the blob path."""
 
     def __init__(self, max_to_keep: int = 3):
         self.max_to_keep = max_to_keep
         self._steps: Dict[int, dict] = {}
 
     def save(self, step: int, payload: Any = None,
-             partial: bool = False) -> None:
+             partial: bool = False, layout: Optional[dict] = None) -> None:
         step = int(step)
         if partial and step in self._steps \
                 and not self._steps[step]["partial"]:
             # finalize-rename atomicity: a torn write can never replace
-            # an already-finalized step directory
+            # an already-finalized step directory (blob or manifest)
             return
-        self._steps[step] = {"partial": bool(partial),
-                             "payload": payload}
+        rec = {"partial": bool(partial), "payload": payload,
+               "layout": None, "shards": None}
+        if layout is not None:
+            rec["layout"] = layout
+            rec["shards"] = {
+                sid: {"owner": meta["owner"],
+                      "bytes": int(meta["bytes"]),
+                      "payload": payload}
+                for sid, meta in layout["shards"].items()}
+        self._steps[step] = rec
         finalized = sorted(s for s, rec in self._steps.items()
                            if not rec["partial"])
         for stale in finalized[:-self.max_to_keep]:
             del self._steps[stale]
+
+    def manifest(self, step: int) -> Optional[dict]:
+        """The finalized layout manifest of ``step``, or None — for a
+        blob step, a torn sharded step (manifest never renamed in), or
+        an unknown step."""
+        rec = self._steps.get(int(step))
+        if rec is None or rec["partial"]:
+            return None
+        return rec["layout"]
+
+    def restore_shards(self, step: int,
+                       shard_ids: List[str]) -> Tuple[Any, int]:
+        """Fetch ONLY the named shards of a finalized sharded step —
+        the direct-handoff read path. Returns (payload, bytes_fetched);
+        raises FileNotFoundError when the step has no finalized
+        manifest (torn or blob-only), the full-restore fallback."""
+        rec = self._steps.get(int(step))
+        if rec is None or rec["partial"] or not rec["shards"]:
+            raise FileNotFoundError(
+                f"step {step} has no finalized sharded manifest")
+        fetched = 0
+        for sid in shard_ids:
+            if sid not in rec["shards"]:
+                raise FileNotFoundError(
+                    f"step {step} has no shard {sid!r}")
+            fetched += rec["shards"][sid]["bytes"]
+        return rec["payload"], fetched
 
     def all_steps(self) -> list:
         return sorted(self._steps)
@@ -106,7 +274,14 @@ class OrbaxCheckpointStore:
     """The same store interface over a real ``TrainCheckpointer``:
     ``state_fn`` yields the live train state to persist, ``state_like_fn``
     the freshly-initialized template restore reshards into (which is what
-    makes resume-on-a-new-topology work)."""
+    makes resume-on-a-new-topology work).
+
+    With the sharded gate on, a save that carries a ``layout`` also
+    persists the layout manifest next to the step via the
+    checkpointer's atomic tmp+rename write — orbax already stores
+    per-shard files, so the manifest is the only artifact this layer
+    adds, and its rename stays the commit point for the handoff
+    planner."""
 
     def __init__(self, checkpointer, state_fn: Callable[[], Any],
                  state_like_fn: Callable[[], Any]):
@@ -115,8 +290,18 @@ class OrbaxCheckpointStore:
         self._state_like_fn = state_like_fn
 
     def save(self, step: int, payload: Any = None,
-             partial: bool = False) -> None:
+             partial: bool = False, layout: Optional[dict] = None) -> None:
         self._ckpt.save(self._state_fn(), int(step), wait=not partial)
+        if layout is not None and not partial \
+                and hasattr(self._ckpt, "save_manifest"):
+            # manifest AFTER the finalized save: a crash in between
+            # leaves a restorable step that simply planless-falls-back
+            self._ckpt.save_manifest(int(step), layout)
+
+    def manifest(self, step: int) -> Optional[dict]:
+        if hasattr(self._ckpt, "read_manifest"):
+            return self._ckpt.read_manifest(int(step))
+        return None
 
     def latest_step(self) -> Optional[int]:
         return self._ckpt.latest_step()
@@ -146,7 +331,10 @@ class ElasticWorkload:
     def __init__(self, client, name: str, namespace: str = "default",
                  clock: Callable[[], float] = None,
                  store: Optional[MemoryCheckpointStore] = None,
-                 checkpoint_every: int = 6, steps_per_tick: int = 3):
+                 checkpoint_every: int = 6, steps_per_tick: int = 3,
+                 state_bytes: int = 1 << 20,
+                 restore_bandwidth: int = 0,
+                 sharded: Optional[bool] = None):
         import time
 
         self.client = client
@@ -156,16 +344,36 @@ class ElasticWorkload:
         self.store = store if store is not None else MemoryCheckpointStore()
         self.checkpoint_every = checkpoint_every
         self.steps_per_tick = steps_per_tick
+        # synthetic state size for the shard layout's byte accounting,
+        # and the restore-cost model: with restore_bandwidth > 0
+        # (bytes per quantum), a restore stalls extra quanta
+        # proportional to the bytes it fetched — which is what makes
+        # the direct handoff's smaller byte bill measurable on the
+        # virtual clock. 0 bandwidth = instant restores (legacy).
+        self.state_bytes = int(state_bytes)
+        self.restore_bandwidth = int(restore_bandwidth)
+        self._sharded_override = sharded
         self.step = 0
         self.max_acked = -1
+        self.last_reshard: Optional[dict] = None
         self._last_saved: Optional[int] = None
         self._last_save_at: Optional[float] = None
         self._nodes_seen: Optional[tuple] = None
         self._crashed = False
+        self._layout: Optional[dict] = None
+        self._layout_version = LAYOUT_VERSION
+        self._reshard_crash_armed = False
+        self._pause_ticks = 0
 
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    @property
+    def sharded(self) -> bool:
+        if self._sharded_override is not None:
+            return bool(self._sharded_override)
+        return SHARDED_CKPT_GATE.enabled
 
     def crash(self, partial: bool = True) -> None:
         """Chaos hook: the job dies mid-step. ``partial`` leaves a torn
@@ -176,6 +384,19 @@ class ElasticWorkload:
                             partial=True)
         self._crashed = True
 
+    def arm_reshard_crash(self) -> None:
+        """Chaos hook: die mid-shard-handoff. The next direct-handoff
+        restore writes part of the re-shard (an unfinalized manifest —
+        it can never shadow the finalized acked step) and crashes."""
+        self._reshard_crash_armed = True
+
+    def force_layout_mismatch(self) -> None:
+        """Chaos hook: the job's next checkpoints publish an
+        incompatible layout version, forcing every subsequent resize
+        onto the full-checkpoint fallback path."""
+        self._layout_version = LAYOUT_VERSION + 1
+        self._layout = None
+
     def _restore(self) -> int:
         try:
             step, _ = self.store.restore()
@@ -183,10 +404,80 @@ class ElasticWorkload:
             step = 0
         return int(step)
 
+    def _current_layout(self, nodes) -> dict:
+        """The layout for a save on ``nodes``: kept when ownership
+        already matches, minimally rebalanced when the host set moved,
+        built fresh otherwise — always deterministic from (previous
+        layout, sorted hosts)."""
+        hosts = sorted(nodes)
+        if self._layout is not None \
+                and int(self._layout.get("version", -1)) \
+                == self._layout_version:
+            owners = sorted({s["owner"]
+                             for s in self._layout["shards"].values()})
+            if owners == hosts:
+                return self._layout
+            return rebalance_layout(self._layout, hosts)
+        return build_layout(hosts, self.state_bytes,
+                            version=self._layout_version)
+
     def _save(self, step: int) -> None:
-        self.store.save(step, payload={"step": step})
+        layout = None
+        if self.sharded and self._nodes_seen:
+            layout = self._current_layout(self._nodes_seen)
+        self.store.save(step, payload={"step": step}, layout=layout)
+        self._layout = layout
         self._last_saved = step
         self._last_save_at = self.clock()
+
+    def _pause_for(self, fetched_bytes: int) -> None:
+        if self.restore_bandwidth > 0 and fetched_bytes > 0:
+            # the restore's own quantum covers the first bandwidth unit
+            self._pause_ticks = max(
+                0, -(-int(fetched_bytes) // self.restore_bandwidth) - 1)
+
+    def _reshard_restore(self, nodes) -> Optional[Tuple[int, int]]:
+        """Direct shard handoff: restore the acked step by fetching ONLY
+        the shards whose owner changed onto this binding, then commit
+        the re-shard under a fresh finalized manifest. Returns
+        (step, bytes_fetched); None on ANY mismatch — the caller falls
+        back to the full restore. Raises nothing: a mid-handoff crash
+        (armed by chaos) leaves a torn manifest and sets the crashed
+        flag instead."""
+        step = self.store.latest_step()
+        if step is None or not hasattr(self.store, "manifest"):
+            return None
+        manifest = self.store.manifest(step)
+        if manifest is None \
+                or int(manifest.get("version", -1)) != self._layout_version:
+            return None
+        new_layout = rebalance_layout(manifest, sorted(nodes))
+        plan = plan_reshard(manifest, new_layout)
+        if not plan["compatible"]:
+            return None
+        if self._reshard_crash_armed:
+            # die mid-handoff: some shards of the re-shard land, the
+            # manifest rename never happens — the torn save can never
+            # shadow the finalized acked step, so the restart below
+            # restores it and no acked work is lost
+            self.store.save(step, payload={"step": step}, partial=True,
+                            layout=new_layout)
+            self._reshard_crash_armed = False
+            self._crashed = True
+            return None
+        try:
+            _, fetched = self.store.restore_shards(
+                step, [m["shard"] for m in plan["moves"]])
+        except FileNotFoundError:
+            return None
+        # commit the re-shard: the new ownership map becomes the
+        # finalized manifest the NEXT resize plans against
+        self.store.save(step, payload={"step": step}, layout=new_layout)
+        self._layout = new_layout
+        self.last_reshard = {"bytesMoved": plan["bytesMoved"],
+                             "shardsMoved": plan["shardsMoved"],
+                             "bytesTotal": plan["bytesTotal"]}
+        return int(step), int(fetched)
 
     def tick(self) -> None:
         live = self.client.get_or_none(
@@ -200,19 +491,45 @@ class ElasticWorkload:
         phase = mig.get("phase", "")
         if not nodes:
             return  # not placed (or mid-eviction): nothing is running
-        if (self._crashed or phase == MIG_REBOUND
+        if self._pause_ticks > 0:
+            # still fetching checkpoint bytes onto the new binding: the
+            # restore's re-warm stalls training for this quantum
+            self._pause_ticks -= 1
+            return
+        if (self._crashed or phase in (MIG_REBOUND, MIG_RESHARDING)
                 or (self._nodes_seen is not None
                     and nodes != self._nodes_seen)):
             # restart/reshard: restore the newest durable checkpoint on
-            # the (possibly new) topology, losing only un-acked steps
-            restored = self._restore()
+            # the (possibly new) topology, losing only un-acked steps.
+            # A Resharding rebind takes the direct handoff — surviving
+            # hosts keep their shards, only reassigned shards are
+            # fetched; any mismatch (torn manifest, version skew,
+            # crashed peer) degrades to the full restore.
+            restored = fetched = None
+            if (phase == MIG_RESHARDING and not self._crashed
+                    and self.sharded):
+                out = self._reshard_restore(nodes)
+                if self._crashed:
+                    return  # the dying handoff consumed this quantum
+                if out is not None:
+                    restored, fetched = out
+                    mig["bytesMoved"] = self.last_reshard["bytesMoved"]
+                    mig["shardsMoved"] = self.last_reshard["shardsMoved"]
+            if restored is None:
+                restored = self._restore()
+                manifest = (self.store.manifest(restored)
+                            if hasattr(self.store, "manifest") else None)
+                fetched = (sum(int(s["bytes"])
+                               for s in manifest["shards"].values())
+                           if manifest else self.state_bytes)
             self.step = restored
             mig["restoredStep"] = restored
-            if phase == MIG_REBOUND:
+            if phase in (MIG_REBOUND, MIG_RESHARDING):
                 mig["phase"] = MIG_RESUMED
             set_nested(cr, mig, "status", "migration")
             update_status_with_retry(self.client, cr, live=live)
-            if TIMELINE.enabled and phase == MIG_REBOUND:
+            if TIMELINE.enabled and phase in (MIG_REBOUND,
+                                              MIG_RESHARDING):
                 TIMELINE.record("SliceRequest", self.key,
                                 "migration:" + MIG_RESUMED,
                                 {"restoredStep": restored,
@@ -221,6 +538,7 @@ class ElasticWorkload:
                      self.key, restored, len(nodes))
             self._nodes_seen = nodes
             self._crashed = False
+            self._pause_for(fetched or 0)
             return  # the restore consumed this quantum
         self._nodes_seen = nodes
 
@@ -262,6 +580,10 @@ class ElasticWorkload:
                 mig["phase"] = MIG_CHECKPOINTED
                 mig["ackedStep"] = max(
                     int(mig.get("ackedStep", -1) or -1), self.step)
+                if self.sharded and self._layout is not None:
+                    # the acked checkpoint's shard map: the operator's
+                    # input to the same-domain handoff planner
+                    mig["layout"] = self._layout
                 set_nested(cr, mig, "status", "migration")
                 update_status_with_retry(self.client, cr, live=live)
                 saved = False  # the handshake write carried progress too
